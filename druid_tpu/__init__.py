@@ -1,0 +1,24 @@
+"""druid_tpu — a TPU-native, column-oriented distributed OLAP analytics framework.
+
+Brand-new design with the capabilities of Apache Druid (reference:
+foamdino/incubator-druid, pre-0.13), re-architected TPU-first:
+
+- Segments are blocks of dense device arrays (int32 dictionary ids, float32/
+  int32 metrics), padded to static shapes so XLA compiles one program per
+  (query shape, segment schema).
+- Queries compile to jit-ted mask + segmented-reduction programs instead of the
+  reference's per-row cursor hot loop (reference:
+  processing/src/main/java/org/apache/druid/query/timeseries/TimeseriesQueryEngine.java:87).
+- Broker "merge" becomes device collectives (psum/all_gather over ICI via
+  shard_map) instead of Sequence n-way merge (reference:
+  java-util/src/main/java/org/apache/druid/java/util/common/guava/MergeSequence.java).
+- The control plane (timeline, coordinator, metadata) stays host-side,
+  mirroring the reference's semantics (VersionedIntervalTimeline MVCC).
+"""
+
+__version__ = "0.1.0"
+
+from druid_tpu.utils.intervals import Interval
+from druid_tpu.utils.granularity import Granularity
+
+__all__ = ["Interval", "Granularity", "__version__"]

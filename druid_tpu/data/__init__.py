@@ -1,0 +1,13 @@
+from druid_tpu.data.dictionary import Dictionary
+from druid_tpu.data.bitmap import BitmapIndex
+from druid_tpu.data.segment import (
+    Segment, SegmentBuilder, SegmentSchema, ColumnCapabilities, ValueType,
+    SegmentId, DeviceBlock,
+)
+from druid_tpu.data.generator import DataGenerator, ColumnSpec
+
+__all__ = [
+    "Dictionary", "BitmapIndex", "Segment", "SegmentBuilder", "SegmentSchema",
+    "ColumnCapabilities", "ValueType", "SegmentId", "DeviceBlock",
+    "DataGenerator", "ColumnSpec",
+]

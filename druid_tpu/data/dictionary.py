@@ -1,0 +1,97 @@
+"""Sorted string dictionary for dictionary-encoded dimension columns.
+
+Capability parity with the reference's GenericIndexed<String> dictionary
+(processing/src/main/java/org/apache/druid/segment/data/GenericIndexed.java:79
+— binary-searchable sorted value index). TPU-first difference: the dictionary
+lives host-side only; the device only ever sees int32 id columns. All string
+predicates (selector/bound/in/like/regex/search) are evaluated host-side
+against the (small) dictionary to produce a boolean lookup table that the
+device applies via one gather — see druid_tpu/engine/filters.py.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+NULL = ""  # reference treats null and empty string equivalently (pre-0.13 semantics)
+
+
+class Dictionary:
+    """Immutable sorted list of unique strings with O(log n) lookup."""
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, sorted_values: Sequence[str]):
+        self.values: List[str] = list(sorted_values)
+        self._index = {v: i for i, v in enumerate(self.values)}
+
+    @staticmethod
+    def from_values(values: Iterable[Optional[str]]) -> "Dictionary":
+        uniq = {NULL if v is None else str(v) for v in values}
+        return Dictionary(sorted(uniq))
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    def id_of(self, value: Optional[str]) -> int:
+        """id of value, or -1 if absent."""
+        if value is None:
+            value = NULL
+        return self._index.get(value, -1)
+
+    def value_of(self, idx: int) -> str:
+        return self.values[idx]
+
+    def encode(self, values: Iterable[Optional[str]]) -> np.ndarray:
+        """Encode values to int32 ids (must all be present)."""
+        idx = self._index
+        return np.fromiter(
+            (idx[NULL if v is None else str(v)] for v in values),
+            dtype=np.int32,
+        )
+
+    def id_range(self, lower: Optional[str], upper: Optional[str],
+                 lower_strict: bool = False, upper_strict: bool = False):
+        """[lo, hi) id range for a lexicographic bound — bound filters on
+        sorted dictionaries become id-range predicates (the same trick as
+        the reference's BoundFilter + GenericIndexed.indexOf)."""
+        lo = 0
+        hi = len(self.values)
+        if lower is not None:
+            lo = (bisect.bisect_right if lower_strict else bisect.bisect_left)(
+                self.values, lower)
+        if upper is not None:
+            hi = (bisect.bisect_left if upper_strict else bisect.bisect_right)(
+                self.values, upper)
+        return lo, max(hi, lo)
+
+    def __len__(self):
+        return len(self.values)
+
+    def __iter__(self):
+        return iter(self.values)
+
+    def __contains__(self, v):
+        return v in self._index
+
+    def __eq__(self, other):
+        return isinstance(other, Dictionary) and self.values == other.values
+
+    def __hash__(self):
+        return hash(tuple(self.values))
+
+
+def merge_dictionaries(dicts: Sequence[Dictionary]):
+    """Merge per-segment dictionaries into one global dictionary plus per-input
+    id remap tables (old_id -> new_id), the role DimensionMergerV9 plays during
+    segment merge (reference: processing/.../segment/DimensionMergerV9.java).
+    """
+    merged = sorted(set().union(*[set(d.values) for d in dicts])) if dicts else []
+    out = Dictionary(merged)
+    remaps = []
+    for d in dicts:
+        remaps.append(np.asarray([out.id_of(v) for v in d.values], dtype=np.int32))
+    return out, remaps

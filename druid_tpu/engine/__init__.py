@@ -1,0 +1,14 @@
+"""Query engines: queries compile to jit-ted mask + segmented-reduce programs.
+
+x64 is enabled globally: OLAP long sums must not overflow int32, and
+timestamps are int64 host-side. Device kernels still use int32/float32 where
+hot (time offsets, dictionary ids, float metrics); int64 work on TPU lowers
+to emulated 32-bit pairs only where a query actually asks for longs.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from druid_tpu.engine.executor import QueryExecutor  # noqa: E402
+
+__all__ = ["QueryExecutor"]

@@ -1,0 +1,629 @@
+"""Per-query-type engines over the unified grouped-aggregate program.
+
+Reference analogs:
+  timeseries — query/timeseries/TimeseriesQueryEngine.java:40
+  topN       — query/topn/TopNQueryEngine.java:48 (+PooledTopNAlgorithm)
+  groupBy    — query/groupby/epinephelinae/GroupByQueryEngineV2.java:91
+  scan       — query/scan/ScanQueryEngine.java:55
+  select     — query/select/SelectQueryEngine.java
+  search     — query/search/SearchQueryRunnerFactory.java (UseIndexesStrategy)
+  timeBoundary / segmentMetadata / dataSourceMetadata —
+      query/timeboundary/, query/metadata/SegmentAnalyzer.java,
+      query/datasourcemetadata/
+
+Result row shapes mirror the reference's JSON wire format (timestamps kept as
+epoch millis ints; the HTTP layer renders ISO strings).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from druid_tpu.data.segment import Segment, ValueType
+from druid_tpu.engine.filters import host_mask
+from druid_tpu.engine.grouping import KeyDim, run_grouped_aggregate
+from druid_tpu.engine.merge import merge_partials
+from druid_tpu.query.model import (DefaultLimitSpec, DimensionSpec, GroupByQuery,
+                                   ListFilteredDimensionSpec, ScanQuery,
+                                   SearchQuery, SegmentMetadataQuery, SelectQuery,
+                                   TimeBoundaryQuery, TimeseriesQuery, TopNQuery,
+                                   DataSourceMetadataQuery)
+from druid_tpu.query.postaggs import compute_postaggs
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval, condense
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers
+# ---------------------------------------------------------------------------
+
+def _segments_for(segments: Sequence[Segment],
+                  intervals: Sequence[Interval]) -> List[Segment]:
+    return [s for s in segments
+            if any(s.interval.overlaps(iv) for iv in intervals)]
+
+
+def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str]]:
+    """Build a KeyDim (+ local id -> output value list) for one dimension spec.
+
+    Extraction fns and listFiltered run host-side over the dictionary,
+    producing an id remap table (cached per segment) — the analog of the
+    reference applying ExtractionFn per row, at O(cardinality) instead of
+    O(rows)."""
+    col = segment.dims.get(spec.dimension)
+    if col is None:
+        return KeyDim(None, 1, None), [""]
+
+    fn = spec.extraction_fn
+    whitelist = None
+    is_white = True
+    if isinstance(spec, ListFilteredDimensionSpec):
+        whitelist = set(spec.values)
+        is_white = spec.is_whitelist
+
+    if fn is None and whitelist is None:
+        return KeyDim(spec.dimension, col.cardinality, None), col.dictionary.values
+
+    cache_key = ("keydim", spec.dimension,
+                 json.dumps(fn.to_json(), sort_keys=True) if fn else None,
+                 tuple(sorted(whitelist)) if whitelist is not None else None,
+                 is_white)
+
+    def _compute():
+        outs = []
+        for v in col.dictionary.values:
+            o = fn.apply(v) if fn else v
+            o = "" if o is None else str(o)
+            outs.append(o)
+        keep = [True] * len(outs)
+        if whitelist is not None:
+            for i, o in enumerate(outs):
+                inside = o in whitelist
+                keep[i] = inside if is_white else not inside
+        uniq = sorted({o for o, k in zip(outs, keep) if k})
+        index = {v: i for i, v in enumerate(uniq)}
+        remap = np.asarray(
+            [index[o] if k else -1 for o, k in zip(outs, keep)], dtype=np.int32)
+        return remap, uniq
+
+    remap, uniq = segment.aux_cached(cache_key, _compute)
+    return KeyDim(spec.dimension, max(len(uniq), 1), remap), (uniq or [""])
+
+
+def _bucket_starts(granularity: Granularity,
+                   intervals: Sequence[Interval]) -> np.ndarray:
+    if granularity.is_all:
+        # single global bucket (matches grouping.make_group_spec)
+        first = min((iv.start for iv in intervals), default=0)
+        return np.asarray([first], dtype=np.int64) if intervals \
+            else np.zeros(0, dtype=np.int64)
+    parts = [granularity.bucket_starts(iv) for iv in intervals]
+    return np.concatenate(parts) if parts else np.zeros(0, dtype=np.int64)
+
+
+def _covered_buckets(granularity: Granularity, starts: np.ndarray,
+                     segments: Sequence[Segment],
+                     intervals: Sequence[Interval]) -> np.ndarray:
+    """Buckets whose span intersects actual segment data (mirrors the
+    reference emitting one row per cursor bucket)."""
+    if len(starts) == 0:
+        return np.zeros(0, dtype=bool)
+    spans = []
+    for s in segments:
+        for iv in intervals:
+            lo = max(s.min_time, iv.start)
+            hi = min(s.max_time + 1, iv.end)
+            if lo < hi:
+                spans.append((lo, hi))
+    if not spans:
+        return np.zeros(len(starts), dtype=bool)
+    if granularity.is_all:
+        return np.ones(len(starts), dtype=bool)
+    if granularity.is_uniform:
+        ends = starts + granularity.period_ms
+    else:
+        ends = np.asarray([granularity.next_bucket(int(st)) for st in starts],
+                          dtype=np.int64)
+    los = np.asarray([lo for lo, _ in spans], dtype=np.int64)
+    his = np.asarray([hi for _, hi in spans], dtype=np.int64)
+    # bucket i covered iff any span overlaps [starts[i], ends[i])
+    return ((starts[:, None] < his[None, :])
+            & (ends[:, None] > los[None, :])).any(axis=1)
+
+
+def _vectorized_postaggs(postaggs, value_arrays: Dict[str, np.ndarray]):
+    out = dict(value_arrays)
+    for pa in postaggs:
+        out[pa.name] = pa.compute(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Timeseries
+# ---------------------------------------------------------------------------
+
+def run_timeseries(query: TimeseriesQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    starts = _bucket_starts(query.granularity, intervals)
+    if not segs or len(starts) == 0:
+        return []
+
+    partials = [run_grouped_aggregate(s, intervals, query.granularity, [],
+                                      query.aggregations, query.filter,
+                                      virtual_columns=query.virtual_columns)
+                for s in segs]
+    buckets, _, counts, states, kernels = merge_partials(
+        partials, [[] for _ in partials])
+    finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
+
+    covered = _covered_buckets(query.granularity, starts, segs, intervals)
+    empty_defaults = {k.name: k.finalize_array(k.empty_state(1))[0]
+                      for k in kernels}
+
+    by_bucket = {int(b): i for i, b in enumerate(buckets)}
+    rows = []
+    for bi, st in enumerate(starts):
+        gi = by_bucket.get(bi)
+        if gi is None:
+            if not covered[bi] or query.skip_empty_buckets:
+                continue
+            vals = {name: _scalar(v) for name, v in empty_defaults.items()}
+        else:
+            if query.skip_empty_buckets and counts[gi] == 0:
+                continue
+            vals = {k.name: _scalar(finalized[k.name][gi]) for k in kernels}
+        vals = compute_postaggs(query.post_aggregations, vals)
+        rows.append({"timestamp": int(st), "result": vals})
+    if query.descending:
+        rows.reverse()
+    return rows
+
+
+def _scalar(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray) and v.ndim == 0:
+        return v.item()
+    return v
+
+
+# ---------------------------------------------------------------------------
+# TopN
+# ---------------------------------------------------------------------------
+
+def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    starts = _bucket_starts(query.granularity, intervals)
+    if not segs or len(starts) == 0:
+        return []
+
+    partials = []
+    dim_values = []
+    for s in segs:
+        kd, values = _keydim_for(s, query.dimension)
+        partials.append(run_grouped_aggregate(
+            s, intervals, query.granularity, [kd], query.aggregations,
+            query.filter, virtual_columns=query.virtual_columns))
+        dim_values.append([values])
+
+    buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
+    finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
+    arrays = _vectorized_postaggs(query.post_aggregations, finalized)
+    values = dim_vals[0] if dim_vals else np.zeros(0, dtype=object)
+    out_name = query.dimension.output_name
+
+    # live groups only
+    live = counts > 0
+    buckets, values = buckets[live], values[live]
+    arrays = {k: np.asarray(v)[live] for k, v in arrays.items()}
+
+    ordering = query.metric_ordering
+    rows = []
+    covered = _covered_buckets(query.granularity, starts, segs, intervals)
+    for bi, st in enumerate(starts):
+        sel = buckets == bi
+        if not sel.any():
+            if covered[bi]:
+                rows.append({"timestamp": int(st), "result": []})
+            continue
+        idx = np.flatnonzero(sel)
+        if ordering in ("lexicographic",):
+            order = np.argsort(values[idx].astype(str))
+        elif ordering == "inverted_lexicographic":
+            order = np.argsort(values[idx].astype(str))[::-1]
+        elif ordering == "strlen":
+            order = np.argsort([len(str(v)) for v in values[idx]])
+        else:
+            metric_arr = np.asarray(arrays[query.metric], dtype=np.float64)
+            order = np.argsort(-metric_arr[idx], kind="stable")
+            if ordering == "inverted":
+                order = order[::-1]
+        top = idx[order[: query.threshold]]
+        result = []
+        for gi in top:
+            entry = {out_name: values[gi]}
+            for name, arr in arrays.items():
+                entry[name] = _scalar(np.asarray(arr)[gi])
+            result.append(entry)
+        rows.append({"timestamp": int(st), "result": result})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# GroupBy
+# ---------------------------------------------------------------------------
+
+def run_groupby(query: GroupByQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    starts = _bucket_starts(query.granularity, intervals)
+    if not segs or len(starts) == 0:
+        return []
+
+    partials = []
+    dim_values = []
+    for s in segs:
+        kds, vals = [], []
+        for d in query.dimensions:
+            kd, v = _keydim_for(s, d)
+            kds.append(kd)
+            vals.append(v)
+        partials.append(run_grouped_aggregate(
+            s, intervals, query.granularity, kds, query.aggregations,
+            query.filter, virtual_columns=query.virtual_columns))
+        dim_values.append(vals)
+
+    buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
+    finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
+    arrays = _vectorized_postaggs(query.post_aggregations, finalized)
+
+    live = counts > 0
+    out_names = [d.output_name for d in query.dimensions]
+    rows = _emit_groupby_rows(starts, buckets, dim_vals, arrays, live, out_names,
+                              kernels, query)
+
+    if query.subtotals:
+        rows = rows + _subtotal_rows(query, starts, buckets, dim_vals, counts,
+                                     states, kernels)
+
+    if query.having is not None:
+        rows = [r for r in rows if query.having.evaluate(r["event"])]
+    rows = _apply_limit_spec(rows, query.limit_spec, out_names)
+    return rows
+
+
+def _emit_groupby_rows(starts, buckets, dim_vals, arrays, live, out_names,
+                       kernels, query) -> List[dict]:
+    rows = []
+    idxs = np.flatnonzero(live)
+    agg_names = [k.name for k in kernels] + [p.name for p in query.post_aggregations]
+    for gi in idxs:
+        event = {}
+        for name, vals in zip(out_names, dim_vals):
+            event[name] = vals[gi]
+        for name in agg_names:
+            event[name] = _scalar(np.asarray(arrays[name])[gi])
+        rows.append({"version": "v1",
+                     "timestamp": int(starts[buckets[gi]]) if len(starts) else 0,
+                     "event": event})
+    return rows
+
+
+def _subtotal_rows(query, starts, buckets, dim_vals, counts, states,
+                   kernels) -> List[dict]:
+    """Re-group merged results for each subtotal spec (reference:
+    GroupByStrategyV2.processSubtotalsSpec)."""
+    out_names = [d.output_name for d in query.dimensions]
+    rows = []
+    live = np.flatnonzero(counts > 0)
+    for subset in query.subtotals:
+        keep = [i for i, n in enumerate(out_names) if n in subset]
+        groups: Dict[tuple, dict] = {}
+        for gi in live:
+            key = (int(buckets[gi]),) + tuple(dim_vals[i][gi] for i in keep)
+            g = groups.get(key)
+            if g is None:
+                g = {"states": {k.name: _state_at(states[k.name], gi)
+                                for k in kernels}}
+                groups[key] = g
+            else:
+                for k in kernels:
+                    g["states"][k.name] = k.combine(
+                        g["states"][k.name], _state_at(states[k.name], gi))
+        for key, g in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            event = {}
+            for j, i in enumerate(keep):
+                event[out_names[i]] = key[1 + j]
+            vals = {k.name: _scalar(k.finalize_array(g["states"][k.name])[0])
+                    for k in kernels}
+            event.update(compute_postaggs(query.post_aggregations, vals))
+            rows.append({"version": "v1",
+                         "timestamp": int(starts[key[0]]) if len(starts) else 0,
+                         "event": event})
+    return rows
+
+
+def _state_at(state, gi):
+    if isinstance(state, dict):
+        return {k: _state_at(v, gi) for k, v in state.items()}
+    return np.asarray(state)[gi:gi + 1]
+
+
+def _apply_limit_spec(rows: List[dict], limit_spec: Optional[DefaultLimitSpec],
+                      dim_names: List[str]) -> List[dict]:
+    if limit_spec is None:
+        return rows
+    if limit_spec.columns:
+        # stable multi-column sort: apply columns in reverse significance order
+        for c in reversed(limit_spec.columns):
+            descending = c.direction == "descending"
+
+            def one_key(row, col=c):
+                v = row["event"].get(col.dimension)
+                if col.dimension_order == "numeric" or not isinstance(v, str):
+                    try:
+                        v = float(v)
+                    except (TypeError, ValueError):
+                        v = float("-inf")
+                return v
+            rows = sorted(rows, key=one_key, reverse=descending)
+    start = limit_spec.offset
+    end = None if limit_spec.limit is None else start + limit_spec.limit
+    return rows[start:end]
+
+
+# ---------------------------------------------------------------------------
+# Scan / Select (raw row export, host-side)
+# ---------------------------------------------------------------------------
+
+def _masked_row_ids(segment: Segment, query) -> np.ndarray:
+    intervals = condense(query.intervals)
+    t = segment.time_ms
+    m = np.zeros(segment.n_rows, dtype=bool)
+    for iv in intervals:
+        m |= (t >= iv.start) & (t < iv.end)
+    m &= host_mask(query.filter, segment,
+                   getattr(query, "virtual_columns", ()))
+    return np.flatnonzero(m)
+
+
+def _decode_rows(segment: Segment, row_ids: np.ndarray,
+                 columns: Sequence[str]) -> List[dict]:
+    cols: Dict[str, np.ndarray] = {}
+    for c in columns:
+        if c == "__time":
+            cols[c] = segment.time_ms[row_ids]
+        elif c in segment.dims:
+            col = segment.dims[c]
+            vals = np.asarray(col.dictionary.values, dtype=object)
+            cols[c] = vals[col.ids[row_ids]] if col.cardinality else \
+                np.full(len(row_ids), "", dtype=object)
+        elif c in segment.metrics:
+            cols[c] = segment.metrics[c].values[row_ids]
+    out = []
+    for i in range(len(row_ids)):
+        out.append({c: _scalar(v[i]) for c, v in cols.items()})
+    return out
+
+
+def run_scan(query: ScanQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    if query.order == "descending":
+        segs = sorted(segs, key=lambda s: s.min_time, reverse=True)
+    else:
+        segs = sorted(segs, key=lambda s: s.min_time)
+    remaining = query.limit if query.limit is not None else None
+    to_skip = query.offset
+    results = []
+    for s in segs:
+        if remaining is not None and remaining <= 0:
+            break
+        row_ids = _masked_row_ids(s, query)
+        if query.order == "descending":
+            row_ids = row_ids[::-1]
+        if to_skip:
+            if to_skip >= len(row_ids):
+                to_skip -= len(row_ids)
+                continue
+            row_ids = row_ids[to_skip:]
+            to_skip = 0
+        if remaining is not None:
+            row_ids = row_ids[:remaining]
+            remaining -= len(row_ids)
+        columns = list(query.columns) or (
+            ["__time"] + list(s.dims.keys()) + list(s.metrics.keys()))
+        events = _decode_rows(s, row_ids, columns)
+        if events:
+            results.append({"segmentId": str(s.id), "columns": columns,
+                            "events": events})
+    return results
+
+
+def run_select(query: SelectQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    segs = sorted(segs, key=lambda s: s.min_time, reverse=query.descending)
+    paging = dict(query.paging_spec)
+    threshold = query.threshold
+    events = []
+    new_paging: Dict[str, int] = {}
+    for s in segs:
+        if threshold <= 0:
+            break
+        row_ids = _masked_row_ids(s, query)
+        if query.descending:
+            row_ids = row_ids[::-1]
+        start = paging.get(str(s.id), -1) + 1
+        row_ids = row_ids[start:start + threshold]
+        threshold -= len(row_ids)
+        columns = (["__time"] + (list(query.dimensions) or list(s.dims.keys()))
+                   + (list(query.metrics) or list(s.metrics.keys())))
+        for off, ev in zip(range(start, start + len(row_ids)),
+                           _decode_rows(s, row_ids, columns)):
+            events.append({"segmentId": str(s.id), "offset": off, "event": ev})
+            new_paging[str(s.id)] = off
+    ts = int(min((s.min_time for s in segs), default=0))
+    return [{"timestamp": ts,
+             "result": {"pagingIdentifiers": new_paging, "events": events}}]
+
+
+# ---------------------------------------------------------------------------
+# Search
+# ---------------------------------------------------------------------------
+
+def run_search(query: SearchQuery, segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    if not segs:
+        return []
+    needle = query.value if query.case_sensitive else query.value.lower()
+
+    def matches(v: str) -> bool:
+        h = v if query.case_sensitive else v.lower()
+        return needle in h
+
+    hits: Dict[Tuple[str, str], int] = {}
+    for s in segs:
+        row_ids = _masked_row_ids(s, query)
+        dims = list(query.search_dimensions) or list(s.dims.keys())
+        for d in dims:
+            col = s.dims.get(d)
+            if col is None:
+                continue
+            cnt = np.bincount(col.ids[row_ids], minlength=col.cardinality)
+            for vid, c in enumerate(cnt):
+                if c > 0 and matches(col.dictionary.values[vid]):
+                    key = (d, col.dictionary.values[vid])
+                    hits[key] = hits.get(key, 0) + int(c)
+
+    entries = [{"dimension": d, "value": v, "count": c}
+               for (d, v), c in hits.items()]
+    if query.sort == "strlen":
+        entries.sort(key=lambda e: (len(e["value"]), e["value"], e["dimension"]))
+    else:
+        entries.sort(key=lambda e: (e["value"], e["dimension"]))
+    entries = entries[: query.limit]
+    ts = int(min(iv.start for iv in intervals))
+    return [{"timestamp": ts, "result": entries}]
+
+
+# ---------------------------------------------------------------------------
+# TimeBoundary / SegmentMetadata / DataSourceMetadata
+# ---------------------------------------------------------------------------
+
+def run_time_boundary(query: TimeBoundaryQuery,
+                      segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    min_t, max_t = None, None
+    for s in segs:
+        if query.filter is None and len(intervals) == 1 \
+                and intervals[0].contains_interval(Interval(s.min_time, s.max_time + 1)):
+            lo, hi = s.min_time, s.max_time
+        else:
+            row_ids = _masked_row_ids(s, query)
+            if len(row_ids) == 0:
+                continue
+            t = s.time_ms[row_ids]
+            lo, hi = int(t.min()), int(t.max())
+        min_t = lo if min_t is None else min(min_t, lo)
+        max_t = hi if max_t is None else max(max_t, hi)
+    if min_t is None:
+        return []
+    result = {}
+    if query.bound in (None, "minTime"):
+        result["minTime"] = min_t
+    if query.bound in (None, "maxTime"):
+        result["maxTime"] = max_t
+    ts = min_t if query.bound != "maxTime" else max_t
+    return [{"timestamp": ts, "result": result}]
+
+
+def _analyze_segment(segment: Segment, query: SegmentMetadataQuery) -> dict:
+    """reference: query/metadata/SegmentAnalyzer.java"""
+    cols = {}
+    names = list(query.to_include) or (
+        ["__time"] + list(segment.dims.keys()) + list(segment.metrics.keys()))
+    want = set(query.analysis_types)
+    for c in names:
+        info: Dict[str, object] = {"hasMultipleValues": False,
+                                   "errorMessage": None}
+        if c == "__time":
+            info["type"] = "LONG"
+            if "size" in want:
+                info["size"] = int(segment.time_ms.nbytes)
+            if "minmax" in want:
+                info["minValue"] = segment.min_time
+                info["maxValue"] = segment.max_time
+        elif c in segment.dims:
+            col = segment.dims[c]
+            info["type"] = "STRING"
+            if "cardinality" in want:
+                info["cardinality"] = col.cardinality
+            if "size" in want:
+                info["size"] = int(col.ids.nbytes)
+            if "minmax" in want and col.cardinality:
+                info["minValue"] = col.dictionary.values[0]
+                info["maxValue"] = col.dictionary.values[-1]
+        elif c in segment.metrics:
+            m = segment.metrics[c]
+            info["type"] = m.type.value.upper()
+            if "size" in want:
+                info["size"] = int(m.values.nbytes)
+            if "minmax" in want and segment.n_rows:
+                info["minValue"] = _scalar(m.values.min())
+                info["maxValue"] = _scalar(m.values.max())
+        else:
+            continue
+        cols[c] = info
+    return {"id": str(segment.id),
+            "intervals": [str(segment.interval)] if "interval" in want else None,
+            "columns": cols,
+            "size": segment.size_bytes(),
+            "numRows": segment.n_rows}
+
+
+def run_segment_metadata(query: SegmentMetadataQuery,
+                         segments: Sequence[Segment]) -> List[dict]:
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    analyses = [_analyze_segment(s, query) for s in segs]
+    if not query.merge or not analyses:
+        return analyses
+    merged = analyses[0]
+    for a in analyses[1:]:
+        merged["size"] += a["size"]
+        merged["numRows"] += a["numRows"]
+        if merged["intervals"] is not None and a["intervals"]:
+            merged["intervals"] = sorted(set(merged["intervals"] + a["intervals"]))
+        for c, info in a["columns"].items():
+            if c not in merged["columns"]:
+                merged["columns"][c] = info
+            else:
+                tgt = merged["columns"][c]
+                for k in ("size",):
+                    if k in info and k in tgt:
+                        tgt[k] += info[k]
+                for k in ("cardinality",):
+                    if k in info and k in tgt:
+                        tgt[k] = max(tgt[k], info[k])
+                if "minValue" in info and "minValue" in tgt:
+                    tgt["minValue"] = min(tgt["minValue"], info["minValue"])
+                    tgt["maxValue"] = max(tgt["maxValue"], info["maxValue"])
+    merged["id"] = "merged"
+    return [merged]
+
+
+def run_datasource_metadata(query: DataSourceMetadataQuery,
+                            segments: Sequence[Segment]) -> List[dict]:
+    if not segments:
+        return []
+    mx = max(s.max_time for s in segments)
+    return [{"timestamp": mx, "result": {"maxIngestedEventTime": mx}}]

@@ -1,0 +1,79 @@
+"""Per-host query executor: dispatch queries over local segments.
+
+Reference analog: the historical's ServerManager + QueryRunnerFactory stack
+(server/src/main/java/org/apache/druid/server/coordination/ServerManager.java:207
+— timeline lookup, per-segment runners, mergeRunners on the processing pool).
+
+TPU-first: no thread-pool of per-segment runners — each segment executes as
+one device program (already internally parallel on the chip), results merge
+vectorized on host (druid_tpu/engine/merge.py) or via collectives
+(druid_tpu/parallel/). The executor owns the jit cache implicitly via
+grouping._JIT_CACHE (specialization-by-shape, the reference's
+SpecializationService analog).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from druid_tpu.data.segment import Segment
+from druid_tpu.engine import engines
+from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery, Query,
+                                   ScanQuery, SearchQuery, SegmentMetadataQuery,
+                                   SelectQuery, TimeBoundaryQuery,
+                                   TimeseriesQuery, TopNQuery, query_from_json)
+
+
+class QueryExecutor:
+    """Runs queries over an in-process set of segments, grouped by datasource."""
+
+    def __init__(self, segments: Optional[Sequence[Segment]] = None):
+        self._by_ds: Dict[str, List[Segment]] = {}
+        for s in segments or ():
+            self.add_segment(s)
+
+    # ---- segment management (ServerManager.loadSegment/dropSegment analog)
+    def add_segment(self, segment: Segment):
+        self._by_ds.setdefault(segment.id.datasource, []).append(segment)
+
+    def drop_segment(self, segment_id) -> bool:
+        for ds, segs in self._by_ds.items():
+            for s in list(segs):
+                if s.id == segment_id or str(s.id) == str(segment_id):
+                    segs.remove(s)
+                    return True
+        return False
+
+    def segments_of(self, datasource: str) -> List[Segment]:
+        return list(self._by_ds.get(datasource, ()))
+
+    @property
+    def datasources(self) -> List[str]:
+        return sorted(self._by_ds)
+
+    # ---- execution -----------------------------------------------------
+    def run(self, query: Query, segments: Optional[Sequence[Segment]] = None):
+        segs = list(segments) if segments is not None \
+            else self._by_ds.get(query.datasource, [])
+        if isinstance(query, TimeseriesQuery):
+            return engines.run_timeseries(query, segs)
+        if isinstance(query, TopNQuery):
+            return engines.run_topn(query, segs)
+        if isinstance(query, GroupByQuery):
+            return engines.run_groupby(query, segs)
+        if isinstance(query, ScanQuery):
+            return engines.run_scan(query, segs)
+        if isinstance(query, SelectQuery):
+            return engines.run_select(query, segs)
+        if isinstance(query, SearchQuery):
+            return engines.run_search(query, segs)
+        if isinstance(query, TimeBoundaryQuery):
+            return engines.run_time_boundary(query, segs)
+        if isinstance(query, SegmentMetadataQuery):
+            return engines.run_segment_metadata(query, segs)
+        if isinstance(query, DataSourceMetadataQuery):
+            return engines.run_datasource_metadata(query, segs)
+        raise ValueError(f"unsupported query type {type(query).__name__}")
+
+    def run_json(self, query_json: dict):
+        """Execute a reference-wire-format JSON query."""
+        return self.run(query_from_json(query_json))

@@ -1,0 +1,399 @@
+"""The unified grouped-aggregate device program.
+
+One XLA program implements all three aggregating engines of the reference:
+  * timeseries  — key = time bucket                (TimeseriesQueryEngine.java:87)
+  * topN        — key = bucket × cardinality + id  (PooledTopNAlgorithm.java:111)
+  * groupBy     — key = fused dim ids              (GroupByQueryEngineV2.java:413)
+
+The program is: mask = valid ∧ time-in-intervals ∧ filter; key = fused
+(bucket, dim ids); for each aggregator a segmented reduction over key. The
+per-(structure) jitted callable is cached — XLA recompiles only when shapes
+change, playing the role of the reference's SpecializationService bytecode
+cache and of GroupBy's ByteBufferHashTable (dense keys replace open-addressing
+hashing, the BufferArrayGrouper insight generalized).
+
+Two key modes:
+  * dense   — group space B × ∏cardinalities small enough for a dense grid;
+    dim id columns fuse on device (optionally through remap tables, which
+    implement extraction fns, listFiltered, and cross-segment dictionary
+    unification).
+  * host    — high-cardinality fallback: the fused key column is compacted
+    host-side with np.unique (cached per segment, the analog of the
+    reference's per-segment dictionaries) and the device reduces over compact
+    ids. Plays the role of GroupBy's SpillingGrouper for cardinalities that
+    would not fit a dense grid.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from druid_tpu.data.segment import DeviceBlock, Segment
+from druid_tpu.engine.filters import (ConstNode, FilterNode, plan_filter,
+                                      simplify_node)
+from druid_tpu.engine.kernels import AggKernel, make_kernel
+from druid_tpu.query.aggregators import AggregatorSpec
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval
+
+DENSE_GROUP_LIMIT = 1 << 21  # max dense key space per (bucket × groups) grid
+
+
+def pad_pow2(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << max(0, math.ceil(math.log2(max(n, 1)))))
+
+
+@dataclass
+class KeyDim:
+    """One grouping dimension: ids column (+ optional remap) with cardinality.
+
+    column=None means the dimension is absent from the segment — it
+    contributes a constant id 0 (value "" at decode time), matching the
+    reference's treatment of missing columns as null.
+    """
+    column: Optional[str]
+    cardinality: int             # output cardinality (after remap)
+    remap: Optional[np.ndarray]  # int32[input_card] -> output id or -1
+
+
+@dataclass
+class GroupSpec:
+    """Bucketing + grouping config for one segment execution."""
+    bucket_starts: np.ndarray          # int64 [B] bucket start timestamps
+    bucket_mode: str                   # "all" | "uniform" | "host"
+    uniform_period: int = 0
+    uniform_first_offset: int = 0      # first bucket start - segment time0
+    host_bucket_ids: Optional[np.ndarray] = None  # int32 [padded]
+    key_mode: str = "dense"            # "dense" | "host"
+    dims: Tuple[KeyDim, ...] = ()
+    host_keys: Optional[np.ndarray] = None        # int32 [padded] compact ids
+    host_unique: Optional[np.ndarray] = None      # raw fused keys per compact id
+    num_total: int = 1                 # padded dense key-space size
+
+    @property
+    def num_buckets(self) -> int:
+        return int(len(self.bucket_starts))
+
+
+@dataclass
+class SegmentPartial:
+    """Per-segment partial aggregation result (host-side)."""
+    segment: Segment
+    spec: GroupSpec
+    counts: np.ndarray                    # int64 [num_total]
+    states: Dict[str, object]             # agg name -> host state
+    kernels: List[AggKernel]
+
+
+# ---------------------------------------------------------------------------
+# Plan construction helpers
+# ---------------------------------------------------------------------------
+
+def make_group_spec(segment: Segment, intervals: Sequence[Interval],
+                    granularity: Granularity,
+                    dims: Sequence[KeyDim]) -> GroupSpec:
+    """Choose bucket mode + key mode for this (segment, query) pair."""
+    if granularity.is_all:
+        # one global bucket across all query intervals (AllGranularity)
+        first = min((iv.start for iv in intervals), default=0)
+        bucket_starts_list = [np.asarray([first], dtype=np.int64)]
+        bucket_starts = bucket_starts_list[0]
+    else:
+        bucket_starts_list = [granularity.bucket_starts(iv) for iv in intervals]
+        bucket_starts = (np.concatenate(bucket_starts_list)
+                         if bucket_starts_list else np.zeros(0, dtype=np.int64))
+    B = max(int(len(bucket_starts)), 1)
+
+    if granularity.is_all:
+        bucket_mode, period, first_off, host_bucket = "all", 0, 0, None
+    elif (granularity.is_uniform and len(intervals) == 1):
+        bucket_mode = "uniform"
+        period = granularity.period_ms
+        first_off = int(bucket_starts[0] - segment.interval.start)
+        host_bucket = None
+    else:
+        bucket_mode, period, first_off = "host", 0, 0
+        key = ("bucket_ids", str(granularity),
+               tuple((iv.start, iv.end) for iv in intervals))
+
+        def _compute():
+            ids_parts = []
+            offset = 0
+            out = np.full(segment.n_rows, -1, dtype=np.int32)
+            for iv, starts in zip(intervals, bucket_starts_list):
+                ids = granularity.bucket_ids(segment.time_ms, iv)
+                sel = ids >= 0
+                out[sel] = ids[sel] + offset
+                offset += len(starts)
+            return out
+        host_bucket = segment.aux_cached(key, _compute)
+
+    dims = tuple(dims)
+    group_card = 1
+    for d in dims:
+        group_card *= max(d.cardinality, 1)
+    dense_total = B * group_card
+
+    if not dims or dense_total <= DENSE_GROUP_LIMIT:
+        return GroupSpec(bucket_starts=bucket_starts, bucket_mode=bucket_mode,
+                         uniform_period=period, uniform_first_offset=first_off,
+                         host_bucket_ids=host_bucket, key_mode="dense",
+                         dims=dims, num_total=pad_pow2(dense_total))
+
+    # host-compacted key path: fuse (bucket, dim ids) host-side and np.unique
+    cache_key = ("fused_keys", str(granularity),
+                 tuple((iv.start, iv.end) for iv in intervals),
+                 tuple((d.column, d.cardinality,
+                        None if d.remap is None else d.remap.tobytes())
+                       for d in dims))
+
+    def _compute_keys():
+        if bucket_mode == "all":
+            b = np.zeros(segment.n_rows, dtype=np.int64)
+        elif bucket_mode == "uniform":
+            b = (segment.time_ms - int(bucket_starts[0])) // period
+            b = np.where((b < 0) | (b >= B), -1, b)
+        else:
+            b = host_bucket.astype(np.int64)
+        key = b
+        valid = b >= 0
+        for d in dims:
+            if d.column is None:
+                continue
+            ids = segment.dims[d.column].ids
+            if d.remap is not None:
+                ids = d.remap[ids]
+            valid &= ids >= 0
+            key = key * d.cardinality + ids
+        key = np.where(valid, key, -1)
+        uniq, compact = np.unique(key, return_inverse=True)
+        # drop the -1 group if present by remapping it to an unused slot
+        if len(uniq) and uniq[0] == -1:
+            compact = compact - 1  # -1 rows get id -1
+            uniq = uniq[1:]
+        return uniq, compact.astype(np.int32)
+
+    uniq, compact = segment.aux_cached(cache_key, _compute_keys)
+    return GroupSpec(bucket_starts=bucket_starts, bucket_mode=bucket_mode,
+                     uniform_period=period, uniform_first_offset=first_off,
+                     host_bucket_ids=host_bucket, key_mode="host", dims=dims,
+                     host_keys=compact, host_unique=uniq,
+                     num_total=pad_pow2(max(len(uniq), 1)))
+
+
+# ---------------------------------------------------------------------------
+# Device program assembly + jit cache
+# ---------------------------------------------------------------------------
+
+_JIT_CACHE: Dict[str, object] = {}
+
+
+def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
+                   virtual_columns) -> str:
+    dims_sig = ",".join(
+        f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in spec.dims)
+    vc_sig = ";".join(f"{v.name}={v.expression}:{v.output_type}"
+                      for v in virtual_columns)
+    return "|".join([
+        f"bucket={spec.bucket_mode}",
+        f"key={spec.key_mode}",
+        f"dims={dims_sig}",
+        f"iv={n_intervals}",
+        f"vc={vc_sig}",
+        f"filt={filter_node.signature() if filter_node else 'none'}",
+        f"aggs={';'.join(k.signature() for k in kernels)}",
+        f"total={spec.num_total}",
+    ])
+
+
+def _build_device_fn(spec: GroupSpec, n_intervals: int,
+                     filter_node: Optional[FilterNode],
+                     kernels: List[AggKernel],
+                     virtual_columns: Sequence = ()):
+    """Build the traced program. Structure-only closure: every segment-specific
+    constant arrives via `aux` (device arrays), so one jitted callable serves
+    every segment with the same structure."""
+    import jax
+    import jax.numpy as jnp
+
+    bucket_mode, key_mode = spec.bucket_mode, spec.key_mode
+    num_total = spec.num_total
+    n_dims = len(spec.dims)
+    dim_cols = tuple(d.column for d in spec.dims)
+    has_remap = tuple(d.remap is not None for d in spec.dims)
+
+    vc_exprs = tuple((v.name, v.expression, v.output_type) for v in virtual_columns)
+
+    def fn(arrays: Dict[str, object], aux: Tuple):
+        it = iter(aux)
+        t = arrays["__time_offset"]
+        mask = arrays["__valid"]
+
+        # expression virtual columns (reference: ExpressionVirtualColumn) —
+        # traced to fused XLA elementwise ops over the staged columns
+        if vc_exprs:
+            from druid_tpu.utils.expression import parse_expression
+            time0 = next(it)
+            bindings = dict(arrays)
+            bindings["__time"] = t.astype(jnp.int64) + time0
+            arrays = dict(arrays)
+            for name, expr_s, out_type in vc_exprs:
+                val = parse_expression(expr_s).evaluate(bindings)
+                dt = {"long": jnp.int64, "double": jnp.float64,
+                      "float": jnp.float32}.get(out_type, jnp.float64)
+                arrays[name] = jnp.asarray(val).astype(dt)
+                bindings[name] = arrays[name]
+
+        # time-in-intervals
+        iv = next(it)  # int32 [k, 2]
+        within = (t[:, None] >= iv[None, :, 0]) & (t[:, None] < iv[None, :, 1])
+        mask = mask & jnp.any(within, axis=1)
+
+        # bucket ids
+        if key_mode == "host":
+            key = arrays["__key"]
+            mask = mask & (key >= 0)
+        else:
+            if bucket_mode == "all":
+                key = jnp.zeros(t.shape, dtype=jnp.int32)
+            elif bucket_mode == "uniform":
+                first_off = next(it)
+                period = next(it)
+                b = (t.astype(jnp.int64) - first_off) // period
+                nb = next(it)  # num buckets as device scalar
+                mask = mask & (b >= 0) & (b < nb)
+                key = b.astype(jnp.int32)
+            else:
+                key = arrays["__bucket"]
+                mask = mask & (key >= 0)
+            for i in range(n_dims):
+                if dim_cols[i] is None:
+                    continue
+                ids = arrays[dim_cols[i]]
+                if has_remap[i]:
+                    remap = next(it)
+                    ids = remap[ids]
+                    mask = mask & (ids >= 0)
+                card = next(it)
+                key = key * card + jnp.maximum(ids, 0)
+
+        if filter_node is not None:
+            mask = mask & filter_node.build(arrays, it)
+
+        key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
+        counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
+                                     num_segments=num_total)
+        # positional states: the jit cache is shared across queries whose
+        # aggregators differ only by output name
+        states = tuple(k.update(arrays, mask, key, num_total, it)
+                       for k in kernels)
+        return counts, states
+
+    return jax.jit(fn)
+
+
+def _assemble_aux(spec: GroupSpec, segment: Segment, intervals: Sequence[Interval],
+                  filter_node: Optional[FilterNode],
+                  kernels: List[AggKernel],
+                  virtual_columns: Sequence = ()) -> Tuple:
+    t0 = segment.interval.start
+    clip_lo, clip_hi = -(2**31) + 1, 2**31 - 1
+    iv = np.asarray(
+        [[min(max(ivl.start - t0, clip_lo), clip_hi),
+          min(max(ivl.end - t0, clip_lo), clip_hi)] for ivl in intervals],
+        dtype=np.int64).astype(np.int32)
+    # order must match the reads in _build_device_fn: vc time0 (if any), then
+    # interval bounds, then bucket/dim/filter/kernel aux
+    aux: List[np.ndarray] = []
+    if virtual_columns:
+        aux.append(np.asarray(t0, dtype=np.int64))
+    aux.append(iv)
+    if spec.key_mode == "dense":
+        if spec.bucket_mode == "uniform":
+            aux.append(np.asarray(spec.uniform_first_offset, dtype=np.int64))
+            aux.append(np.asarray(spec.uniform_period, dtype=np.int64))
+            aux.append(np.asarray(spec.num_buckets, dtype=np.int64))
+        for d in spec.dims:
+            if d.column is None:
+                continue
+            if d.remap is not None:
+                aux.append(d.remap.astype(np.int32))
+            aux.append(np.asarray(d.cardinality, dtype=np.int32))
+    if filter_node is not None:
+        aux.extend(filter_node.aux_arrays())
+    for k in kernels:
+        aux.extend(k.aux_arrays())
+    return tuple(aux)
+
+
+def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
+                          granularity: Granularity, dims: Sequence[KeyDim],
+                          aggs: Sequence[AggregatorSpec],
+                          flt, extra_columns: Sequence[str] = (),
+                          virtual_columns: Sequence = ()) -> SegmentPartial:
+    """Execute the grouped aggregation for one segment; returns host partials."""
+    from druid_tpu.utils.expression import parse_expression
+
+    spec = make_group_spec(segment, intervals, granularity, dims)
+    filter_node = simplify_node(plan_filter(flt, segment, virtual_columns))
+    kernels = [make_kernel(a, segment) for a in aggs]
+
+    if isinstance(filter_node, ConstNode) and not filter_node.value:
+        # constant-false filter: nothing matches — skip the device entirely
+        return SegmentPartial(
+            segment=segment, spec=spec,
+            counts=np.zeros(spec.num_total, dtype=np.int64),
+            states={k.name: k.empty_state(spec.num_total) for k in kernels},
+            kernels=kernels)
+
+    vc_names = {v.name for v in virtual_columns}
+    needed = set(extra_columns)
+    for d in spec.dims:
+        if spec.key_mode == "dense" and d.column is not None:
+            needed.add(d.column)
+    if flt is not None:
+        needed |= flt.required_columns()
+    for a in aggs:
+        needed |= a.required_columns()
+    for v in virtual_columns:
+        needed |= parse_expression(v.expression).required_columns()
+    needed -= vc_names
+    needed = {c for c in needed if c in segment.dims or c in segment.metrics}
+    block = segment.device_block(sorted(needed))
+
+    arrays = dict(block.arrays)
+    if spec.key_mode == "host":
+        arrays["__key"] = _pad_device(spec.host_keys, block.padded_rows, -1)
+    elif spec.bucket_mode == "host":
+        arrays["__bucket"] = _pad_device(spec.host_bucket_ids, block.padded_rows, -1)
+
+    sig = _structure_sig(spec, len(intervals), filter_node, kernels, virtual_columns)
+    fn = _JIT_CACHE.get(sig)
+    if fn is None:
+        fn = _build_device_fn(spec, len(intervals), filter_node, kernels,
+                              virtual_columns)
+        _JIT_CACHE[sig] = fn
+    aux = _assemble_aux(spec, segment, intervals, filter_node, kernels,
+                        virtual_columns)
+    counts, states = fn(arrays, aux)
+
+    host_states = {k.name: k.host_post(st, segment)
+                   for k, st in zip(kernels, states)}
+    return SegmentPartial(segment=segment, spec=spec,
+                          counts=np.asarray(counts, dtype=np.int64),
+                          states=host_states, kernels=kernels)
+
+
+def _pad_device(arr: np.ndarray, padded: int, fill) -> object:
+    import jax
+    out = np.full((padded,), fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return jax.device_put(out)
+
+
+def combine_states(kernels: List[AggKernel], a: Dict[str, object],
+                   b: Dict[str, object]) -> Dict[str, object]:
+    return {k.name: k.combine(a[k.name], b[k.name]) for k in kernels}

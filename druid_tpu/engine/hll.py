@@ -1,0 +1,180 @@
+"""HyperLogLog on device: registers as int32 arrays, merges as elementwise max.
+
+Capability parity with the reference's HyperLogLogCollector
+(hll/src/main/java/org/apache/druid/hll/HyperLogLogCollector.java:53 — dense
+register arrays in ByteBuffers, fold = per-register max, harmonic estimator).
+
+TPU-first reformulation (SURVEY §2.9): the branchy per-row register update
+becomes a vectorized scatter-max — rows map to (bucket, register) pairs and
+one `segment_max` updates a [num_buckets * m] register grid. String values
+are hashed host-side *per dictionary entry* (cardinality-sized work, cached
+per segment) so the device only gathers (register, rho) by dictionary id;
+numeric columns hash on device with splitmix64.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+DEFAULT_LOG2M = 11
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+def _splitmix64_np(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer (host, numpy uint64)."""
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def hash_strings(values) -> np.ndarray:
+    """Deterministic 64-bit hashes of strings (FNV-1a + splitmix finalizer)."""
+    out = np.empty(len(values), dtype=np.uint64)
+    FNV_OFFSET = 0xCBF29CE484222325
+    FNV_PRIME = 0x100000001B3
+    MASK = 0xFFFFFFFFFFFFFFFF
+    for i, v in enumerate(values):
+        h = FNV_OFFSET
+        for b in v.encode("utf-8"):
+            h = ((h ^ b) * FNV_PRIME) & MASK
+        out[i] = h
+    return _splitmix64_np(out)
+
+
+def hash_to_register(hashes: np.ndarray, log2m: int) -> Tuple[np.ndarray, np.ndarray]:
+    """hash -> (register index, rho) where rho = 1 + leading-zero count of the
+    remaining (64 - log2m) bits, capped for int register storage."""
+    m = 1 << log2m
+    reg = (hashes & np.uint64(m - 1)).astype(np.int32)
+    rest = (hashes >> np.uint64(log2m)).astype(np.uint64)
+    width = 64 - log2m
+    # leading zeros of `rest` within `width` bits
+    rho = np.zeros(rest.shape, dtype=np.int32)
+    x = rest.copy()
+    # position of highest set bit via float log2 is unsafe; do bit halving
+    hb = np.zeros(rest.shape, dtype=np.int32)
+    for shift in (32, 16, 8, 4, 2, 1):
+        mask_bits = x >= (np.uint64(1) << np.uint64(shift))
+        hb = np.where(mask_bits, hb + shift, hb)
+        x = np.where(mask_bits, x >> np.uint64(shift), x)
+    nonzero = rest != 0
+    rho = np.where(nonzero, width - 1 - hb + 1, width + 1).astype(np.int32)
+    return reg, rho
+
+
+def dim_register_tables(dictionary, log2m: int = DEFAULT_LOG2M):
+    """Per-dictionary-id (register, rho) tables for device gather."""
+    hashes = hash_strings(dictionary.values)
+    return hash_to_register(hashes, log2m)
+
+
+def dim_hash_table(dictionary) -> np.ndarray:
+    """Per-dictionary-id raw 64-bit hashes (for byRow combined hashing)."""
+    return hash_strings(dictionary.values)
+
+
+# ---------------------------------------------------------------------------
+# Device-side pieces (traced under jit)
+# ---------------------------------------------------------------------------
+
+def splitmix64_device(x):
+    """splitmix64 under jit (uint64; x64 enabled)."""
+    import jax.numpy as jnp
+    x = x.astype(jnp.uint64)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def register_of_device(hashes, log2m: int):
+    """Device analog of hash_to_register."""
+    import jax.numpy as jnp
+    m = 1 << log2m
+    reg = (hashes & jnp.uint64(m - 1)).astype(jnp.int32)
+    rest = (hashes >> jnp.uint64(log2m))
+    width = 64 - log2m
+    # highest-set-bit via progressive halving (branch-free)
+    hb = jnp.zeros(rest.shape, dtype=jnp.int32)
+    x = rest
+    for shift in (32, 16, 8, 4, 2, 1):
+        big = x >= (jnp.uint64(1) << jnp.uint64(shift))
+        hb = jnp.where(big, hb + shift, hb)
+        x = jnp.where(big, x >> jnp.uint64(shift), x)
+    rho = jnp.where(rest != 0, width - hb, width + 1).astype(jnp.int32)
+    return reg, rho
+
+
+def update_registers(registers, rho, reg_idx, bucket_ids, mask, num_buckets: int,
+                     log2m: int):
+    """segment-max scatter of rho into a [num_buckets, m] register grid."""
+    import jax
+    import jax.numpy as jnp
+    m = 1 << log2m
+    safe_b = jnp.clip(bucket_ids, 0, num_buckets - 1)
+    seg = safe_b.astype(jnp.int32) * m + reg_idx
+    rho_m = jnp.where(mask, rho, 0)
+    upd = jax.ops.segment_max(rho_m, seg, num_segments=num_buckets * m)
+    upd = jnp.maximum(upd, 0).reshape(num_buckets, m)
+    if registers is None:
+        return upd.astype(jnp.int32)
+    return jnp.maximum(registers, upd.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Estimation (host)
+# ---------------------------------------------------------------------------
+
+def estimate(registers: np.ndarray, log2m: int = DEFAULT_LOG2M) -> float:
+    """Classic HLL estimator with small/large-range corrections
+    (semantics-parity with HyperLogLogCollector.estimateCardinality)."""
+    regs = np.asarray(registers)
+    if regs.ndim > 1:
+        regs = regs.reshape(-1)
+    m = 1 << log2m
+    assert regs.shape[0] == m, f"expected {m} registers, got {regs.shape}"
+    alpha = 0.7213 / (1 + 1.079 / m)
+    power = np.power(2.0, -regs.astype(np.float64))
+    raw = alpha * m * m / power.sum()
+    if raw <= 2.5 * m:
+        zeros = int((regs == 0).sum())
+        if zeros:
+            return m * np.log(m / zeros)
+    two64 = 2.0 ** 64
+    if raw > two64 / 30.0:
+        return -two64 * np.log(1.0 - raw / two64)
+    return float(raw)
+
+
+def combine_registers(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """fold = elementwise max (HyperLogLogCollector.fold)."""
+    return np.maximum(a, b)
+
+
+def estimate_array(registers: np.ndarray, log2m: int = DEFAULT_LOG2M) -> np.ndarray:
+    """Vectorized estimator over a [G, m] register grid -> float64[G]."""
+    regs = np.asarray(registers)
+    if regs.ndim == 1:
+        regs = regs[None, :]
+    m = 1 << log2m
+    assert regs.shape[-1] == m
+    alpha = 0.7213 / (1 + 1.079 / m)
+    power = np.power(2.0, -regs.astype(np.float64))
+    raw = alpha * m * m / power.sum(axis=-1)
+    zeros = (regs == 0).sum(axis=-1)
+    small = raw <= 2.5 * m
+    with np.errstate(divide="ignore"):
+        lin = np.where(zeros > 0, m * np.log(m / np.maximum(zeros, 1)), raw)
+    out = np.where(small & (zeros > 0), lin, raw)
+    two64 = 2.0 ** 64
+    big = out > two64 / 30.0
+    out = np.where(big, -two64 * np.log1p(-out / two64), out)
+    return out

@@ -1,0 +1,24 @@
+from druid_tpu.query.filters import (
+    DimFilter, SelectorFilter, InFilter, BoundFilter, LikeFilter, RegexFilter,
+    AndFilter, OrFilter, NotFilter, IntervalFilter, SearchFilter,
+    ColumnComparisonFilter, TrueFilter, FalseFilter, JavaScriptFilter,
+    ExpressionFilter, filter_from_json,
+)
+from druid_tpu.query.aggregators import (
+    AggregatorSpec, CountAggregator, LongSumAggregator, DoubleSumAggregator,
+    FloatSumAggregator, LongMinAggregator, LongMaxAggregator,
+    DoubleMinAggregator, DoubleMaxAggregator, FloatMinAggregator,
+    FloatMaxAggregator, FirstAggregator, LastAggregator, FilteredAggregator,
+    HyperUniqueAggregator, CardinalityAggregator, agg_from_json,
+)
+from druid_tpu.query.postaggs import (
+    PostAggregator, ArithmeticPostAgg, FieldAccessPostAgg, ConstantPostAgg,
+    FinalizingFieldAccessPostAgg, GreatestPostAgg, LeastPostAgg,
+    HyperUniqueFinalizingPostAgg,
+)
+from druid_tpu.query.model import (
+    Query, TimeseriesQuery, TopNQuery, GroupByQuery, ScanQuery,
+    TimeBoundaryQuery, SegmentMetadataQuery, SearchQuery, SelectQuery,
+    DataSourceMetadataQuery, DefaultDimensionSpec, ExtractionDimensionSpec,
+    DefaultLimitSpec, OrderByColumnSpec, HavingSpec, query_from_json,
+)

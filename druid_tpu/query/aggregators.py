@@ -1,0 +1,268 @@
+"""Aggregator specs (the query-model side of aggregation).
+
+Capability parity with the reference's AggregatorFactory SPI
+(processing/src/main/java/org/apache/druid/query/aggregation/AggregatorFactory.java:44-161
+— factorize / combine / getCombiningFactory / finalizeComputation).
+
+TPU-first split: an AggregatorSpec here is pure metadata; the device
+implementation is a *vectorized segmented reduction* chosen in
+druid_tpu/engine/kernels.py — (update over a masked block → per-bucket
+partial state) + (host/device combine) + (finalize). There is no per-row
+Aggregator object: the whole block aggregates in one XLA op, which is the
+replacement for BufferAggregator's per-row ByteBuffer updates
+(query/aggregation/BufferAggregator.java:54-144).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class AggregatorSpec:
+    name: str
+
+    @property
+    def field_name(self) -> Optional[str]:
+        return getattr(self, "field", None)
+
+    def required_columns(self) -> set:
+        f = self.field_name
+        return {f} if f else set()
+
+    # combining factory: the agg used to merge partial results
+    # (reference AggregatorFactory.getCombiningFactory)
+    def combining(self) -> "AggregatorSpec":
+        cls = type(self)
+        try:
+            return cls(self.name, self.name)  # type: ignore[call-arg]
+        except TypeError:
+            return self
+
+    def finalize(self, value):
+        return value
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class CountAggregator(AggregatorSpec):
+    name: str = "count"
+
+    def combining(self):
+        return LongSumAggregator(self.name, self.name)
+
+    def to_json(self):
+        return {"type": "count", "name": self.name}
+
+
+@dataclass(frozen=True)
+class LongSumAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "longSum", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class DoubleSumAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "doubleSum", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FloatSumAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "floatSum", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class LongMinAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "longMin", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class LongMaxAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "longMax", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class DoubleMinAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "doubleMin", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class DoubleMaxAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "doubleMax", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FloatMinAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "floatMin", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FloatMaxAggregator(AggregatorSpec):
+    name: str
+    field: str
+
+    def to_json(self):
+        return {"type": "floatMax", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FirstAggregator(AggregatorSpec):
+    """Value at min __time (reference: query/aggregation/first/)."""
+    name: str
+    field: str
+    kind: str = "double"  # long|double|float
+
+    def to_json(self):
+        return {"type": f"{self.kind}First", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class LastAggregator(AggregatorSpec):
+    """Value at max __time (reference: query/aggregation/last/)."""
+    name: str
+    field: str
+    kind: str = "double"
+
+    def to_json(self):
+        return {"type": f"{self.kind}Last", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FilteredAggregator(AggregatorSpec):
+    """Delegate aggregator gated by a filter
+    (reference: query/aggregation/FilteredAggregatorFactory.java)."""
+    name: str
+    delegate: AggregatorSpec = None
+    filter: object = None  # DimFilter
+
+    def required_columns(self):
+        return self.delegate.required_columns() | self.filter.required_columns()
+
+    def combining(self):
+        return self.delegate.combining()
+
+    def finalize(self, value):
+        return self.delegate.finalize(value)
+
+    def to_json(self):
+        return {"type": "filtered", "name": self.name,
+                "aggregator": self.delegate.to_json(),
+                "filter": self.filter.to_json()}
+
+
+@dataclass(frozen=True)
+class HyperUniqueAggregator(AggregatorSpec):
+    """HLL cardinality over a precomputed HLL metric column or a dimension
+    (reference: query/aggregation/hyperloglog/HyperUniquesAggregatorFactory.java:51).
+    State = int8 register array (2^log2m buckets); merge = elementwise max;
+    see druid_tpu/engine/hll.py for the device kernel."""
+    name: str
+    field: str
+    log2m: int = 11
+    round: bool = False
+
+    def finalize(self, value):
+        from druid_tpu.engine.hll import estimate
+        est = estimate(value, self.log2m)
+        return int(round(est)) if self.round else est
+
+    def to_json(self):
+        return {"type": "hyperUnique", "name": self.name, "fieldName": self.field,
+                "round": self.round}
+
+
+@dataclass(frozen=True)
+class CardinalityAggregator(AggregatorSpec):
+    """HLL over dimension values at query time
+    (reference: query/aggregation/cardinality/CardinalityAggregator.java)."""
+    name: str
+    fields: Tuple[str, ...] = ()
+    by_row: bool = False
+    log2m: int = 11
+    round: bool = False
+
+    def required_columns(self):
+        return set(self.fields)
+
+    def combining(self):
+        return HyperUniqueAggregator(self.name, self.name, self.log2m, self.round)
+
+    def finalize(self, value):
+        from druid_tpu.engine.hll import estimate
+        est = estimate(value, self.log2m)
+        return int(round(est)) if self.round else est
+
+    def to_json(self):
+        return {"type": "cardinality", "name": self.name,
+                "fields": list(self.fields), "byRow": self.by_row,
+                "round": self.round}
+
+
+_SIMPLE = {
+    "count": lambda j: CountAggregator(j["name"]),
+    "longSum": lambda j: LongSumAggregator(j["name"], j["fieldName"]),
+    "doubleSum": lambda j: DoubleSumAggregator(j["name"], j["fieldName"]),
+    "floatSum": lambda j: FloatSumAggregator(j["name"], j["fieldName"]),
+    "longMin": lambda j: LongMinAggregator(j["name"], j["fieldName"]),
+    "longMax": lambda j: LongMaxAggregator(j["name"], j["fieldName"]),
+    "doubleMin": lambda j: DoubleMinAggregator(j["name"], j["fieldName"]),
+    "doubleMax": lambda j: DoubleMaxAggregator(j["name"], j["fieldName"]),
+    "floatMin": lambda j: FloatMinAggregator(j["name"], j["fieldName"]),
+    "floatMax": lambda j: FloatMaxAggregator(j["name"], j["fieldName"]),
+    "hyperUnique": lambda j: HyperUniqueAggregator(
+        j["name"], j["fieldName"], round=j.get("round", False)),
+    "cardinality": lambda j: CardinalityAggregator(
+        j["name"], tuple(j["fields"]), j.get("byRow", False),
+        round=j.get("round", False)),
+}
+
+
+def agg_from_json(j: dict) -> AggregatorSpec:
+    t = j["type"]
+    if t in _SIMPLE:
+        return _SIMPLE[t](j)
+    for kind in ("long", "double", "float"):
+        if t == f"{kind}First":
+            return FirstAggregator(j["name"], j["fieldName"], kind)
+        if t == f"{kind}Last":
+            return LastAggregator(j["name"], j["fieldName"], kind)
+    if t == "filtered":
+        from druid_tpu.query.filters import filter_from_json
+        return FilteredAggregator(j.get("name") or j["aggregator"]["name"],
+                                  agg_from_json(j["aggregator"]),
+                                  filter_from_json(j["filter"]))
+    raise ValueError(f"unknown aggregator type {t!r}")

@@ -1,0 +1,351 @@
+"""Dimension filter model (the query-layer JSON filter tree).
+
+Capability parity with the reference's DimFilter hierarchy
+(processing/src/main/java/org/apache/druid/query/filter/DimFilter.java and the
+19 impls under segment/filter/). The *planning* of a filter (bitmap path vs
+device-predicate path, CNF conversion, dictionary LUT construction) lives in
+druid_tpu/engine/filters.py; this module is the pure data model + JSON serde.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from druid_tpu.utils.intervals import Interval, normalize_intervals
+
+
+class DimFilter:
+    """Base filter node."""
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+    # -- tree utilities ------------------------------------------------
+    def required_columns(self) -> set:
+        return set()
+
+    def optimize(self) -> "DimFilter":
+        return self
+
+
+@dataclass(frozen=True)
+class TrueFilter(DimFilter):
+    def to_json(self):
+        return {"type": "true"}
+
+
+@dataclass(frozen=True)
+class FalseFilter(DimFilter):
+    def to_json(self):
+        return {"type": "false"}
+
+
+@dataclass(frozen=True)
+class SelectorFilter(DimFilter):
+    """dimension == value (reference: query/filter/SelectorDimFilter.java)."""
+    dimension: str
+    value: Optional[str]
+
+    def to_json(self):
+        return {"type": "selector", "dimension": self.dimension, "value": self.value}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class InFilter(DimFilter):
+    """dimension IN (values) (reference: query/filter/InDimFilter.java)."""
+    dimension: str
+    values: Tuple[Optional[str], ...]
+
+    def to_json(self):
+        return {"type": "in", "dimension": self.dimension, "values": list(self.values)}
+
+    def required_columns(self):
+        return {self.dimension}
+
+    def optimize(self):
+        if len(self.values) == 1:
+            return SelectorFilter(self.dimension, self.values[0])
+        return self
+
+
+@dataclass(frozen=True)
+class BoundFilter(DimFilter):
+    """Range filter, lexicographic or numeric ordering
+    (reference: query/filter/BoundDimFilter.java)."""
+    dimension: str
+    lower: Optional[str] = None
+    upper: Optional[str] = None
+    lower_strict: bool = False
+    upper_strict: bool = False
+    ordering: str = "lexicographic"  # or "numeric"
+
+    def to_json(self):
+        return {"type": "bound", "dimension": self.dimension, "lower": self.lower,
+                "upper": self.upper, "lowerStrict": self.lower_strict,
+                "upperStrict": self.upper_strict, "ordering": self.ordering}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class LikeFilter(DimFilter):
+    """SQL LIKE (reference: query/filter/LikeDimFilter.java)."""
+    dimension: str
+    pattern: str
+    escape: Optional[str] = None
+
+    def regex(self) -> str:
+        out, i = [], 0
+        esc = self.escape
+        p = self.pattern
+        while i < len(p):
+            c = p[i]
+            if esc and c == esc and i + 1 < len(p):
+                out.append(re.escape(p[i + 1])); i += 2; continue
+            if c == "%":
+                out.append(".*")
+            elif c == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(c))
+            i += 1
+        return "^" + "".join(out) + "$"
+
+    def to_json(self):
+        return {"type": "like", "dimension": self.dimension,
+                "pattern": self.pattern, "escape": self.escape}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class RegexFilter(DimFilter):
+    dimension: str
+    pattern: str
+
+    def to_json(self):
+        return {"type": "regex", "dimension": self.dimension, "pattern": self.pattern}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class SearchFilter(DimFilter):
+    """contains/insensitive_contains/fragment search on dim values
+    (reference: query/filter/SearchQueryDimFilter.java)."""
+    dimension: str
+    value: str
+    case_sensitive: bool = False
+
+    def to_json(self):
+        return {"type": "search", "dimension": self.dimension,
+                "query": {"type": "contains", "value": self.value,
+                          "caseSensitive": self.case_sensitive}}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class IntervalFilter(DimFilter):
+    """__time (or numeric dim) within intervals
+    (reference: query/filter/IntervalDimFilter.java)."""
+    dimension: str
+    intervals: Tuple[Interval, ...]
+
+    def to_json(self):
+        return {"type": "interval", "dimension": self.dimension,
+                "intervals": [str(iv) for iv in self.intervals]}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class ColumnComparisonFilter(DimFilter):
+    """dimA == dimB row-wise (reference: query/filter/ColumnComparisonDimFilter.java)."""
+    dimensions: Tuple[str, ...]
+
+    def to_json(self):
+        return {"type": "columnComparison", "dimensions": list(self.dimensions)}
+
+    def required_columns(self):
+        return set(self.dimensions)
+
+
+@dataclass(frozen=True)
+class ExpressionFilter(DimFilter):
+    """Expression-language predicate (reference: query/filter/ExpressionDimFilter.java)."""
+    expression: str
+
+    def to_json(self):
+        return {"type": "expression", "expression": self.expression}
+
+    def required_columns(self):
+        from druid_tpu.utils.expression import parse_expression
+        return set(parse_expression(self.expression).required_columns())
+
+
+@dataclass(frozen=True)
+class JavaScriptFilter(DimFilter):
+    """Reference has a Rhino JS filter (query/filter/JavaScriptDimFilter.java).
+    The TPU framework has no embedded JS engine; accepts a python callable
+    evaluated host-side over dictionary values instead (gated, like the
+    reference's JavaScriptConfig enable flag)."""
+    dimension: str
+    predicate: object  # Callable[[str], bool]
+
+    def to_json(self):
+        return {"type": "javascript", "dimension": self.dimension,
+                "function": "<python-callable>"}
+
+    def required_columns(self):
+        return {self.dimension}
+
+
+@dataclass(frozen=True)
+class AndFilter(DimFilter):
+    fields: Tuple[DimFilter, ...]
+
+    def to_json(self):
+        return {"type": "and", "fields": [f.to_json() for f in self.fields]}
+
+    def required_columns(self):
+        out = set()
+        for f in self.fields:
+            out |= f.required_columns()
+        return out
+
+    def optimize(self):
+        flat: List[DimFilter] = []
+        for f in self.fields:
+            f = f.optimize()
+            if isinstance(f, AndFilter):
+                flat.extend(f.fields)
+            elif isinstance(f, TrueFilter):
+                continue
+            elif isinstance(f, FalseFilter):
+                return FalseFilter()
+            else:
+                flat.append(f)
+        if not flat:
+            return TrueFilter()
+        if len(flat) == 1:
+            return flat[0]
+        return AndFilter(tuple(flat))
+
+
+@dataclass(frozen=True)
+class OrFilter(DimFilter):
+    fields: Tuple[DimFilter, ...]
+
+    def to_json(self):
+        return {"type": "or", "fields": [f.to_json() for f in self.fields]}
+
+    def required_columns(self):
+        out = set()
+        for f in self.fields:
+            out |= f.required_columns()
+        return out
+
+    def optimize(self):
+        flat: List[DimFilter] = []
+        for f in self.fields:
+            f = f.optimize()
+            if isinstance(f, OrFilter):
+                flat.extend(f.fields)
+            elif isinstance(f, FalseFilter):
+                continue
+            elif isinstance(f, TrueFilter):
+                return TrueFilter()
+            else:
+                flat.append(f)
+        if not flat:
+            return FalseFilter()
+        if len(flat) == 1:
+            return flat[0]
+        return OrFilter(tuple(flat))
+
+
+@dataclass(frozen=True)
+class NotFilter(DimFilter):
+    field: DimFilter
+
+    def to_json(self):
+        return {"type": "not", "field": self.field.to_json()}
+
+    def required_columns(self):
+        return self.field.required_columns()
+
+    def optimize(self):
+        f = self.field.optimize()
+        if isinstance(f, NotFilter):
+            return f.field
+        if isinstance(f, TrueFilter):
+            return FalseFilter()
+        if isinstance(f, FalseFilter):
+            return TrueFilter()
+        return NotFilter(f)
+
+
+# convenience constructors mirroring Druids builders
+def and_(*fs: DimFilter) -> DimFilter:
+    return AndFilter(tuple(fs)).optimize()
+
+
+def or_(*fs: DimFilter) -> DimFilter:
+    return OrFilter(tuple(fs)).optimize()
+
+
+def not_(f: DimFilter) -> DimFilter:
+    return NotFilter(f).optimize()
+
+
+def filter_from_json(j: Optional[dict]) -> Optional[DimFilter]:
+    """JSON-polymorphic deserialization, mirroring the reference's Jackson
+    @JsonSubTypes registration on DimFilter."""
+    if j is None:
+        return None
+    t = j["type"]
+    if t == "selector":
+        return SelectorFilter(j["dimension"], j.get("value"))
+    if t == "in":
+        return InFilter(j["dimension"], tuple(j["values"]))
+    if t == "bound":
+        return BoundFilter(j["dimension"], j.get("lower"), j.get("upper"),
+                           j.get("lowerStrict", False), j.get("upperStrict", False),
+                           j.get("ordering", "lexicographic"))
+    if t == "like":
+        return LikeFilter(j["dimension"], j["pattern"], j.get("escape"))
+    if t == "regex":
+        return RegexFilter(j["dimension"], j["pattern"])
+    if t == "search":
+        q = j.get("query", {})
+        return SearchFilter(j["dimension"], q.get("value", ""),
+                            q.get("caseSensitive", False))
+    if t == "interval":
+        return IntervalFilter(j["dimension"],
+                              tuple(normalize_intervals(j["intervals"])))
+    if t == "columnComparison":
+        return ColumnComparisonFilter(tuple(j["dimensions"]))
+    if t == "expression":
+        return ExpressionFilter(j["expression"])
+    if t == "and":
+        return AndFilter(tuple(filter_from_json(f) for f in j["fields"]))
+    if t == "or":
+        return OrFilter(tuple(filter_from_json(f) for f in j["fields"]))
+    if t == "not":
+        return NotFilter(filter_from_json(j["field"]))
+    if t == "true":
+        return TrueFilter()
+    if t == "false":
+        return FalseFilter()
+    raise ValueError(f"unknown filter type {t!r}")

@@ -1,0 +1,803 @@
+"""The polymorphic query model: 9 query types.
+
+Capability parity with the reference's Query registration
+(processing/src/main/java/org/apache/druid/query/Query.java:61-69):
+timeseries, search, timeBoundary, groupBy, scan, segmentMetadata, select,
+topN, dataSourceMetadata. Queries are frozen dataclasses; JSON serde mirrors
+the reference's Jackson wire format so native-query payloads translate 1:1.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from druid_tpu.query.aggregators import AggregatorSpec, agg_from_json
+from druid_tpu.query.filters import DimFilter, filter_from_json
+from druid_tpu.query.postaggs import PostAggregator, postagg_from_json
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval, normalize_intervals
+
+
+# ---------------------------------------------------------------------------
+# Dimension specs + extraction fns (reference: query/dimension/, query/extraction/)
+# ---------------------------------------------------------------------------
+
+class ExtractionFn:
+    """Host-side value transform applied to dictionary values at plan time
+    (reference: query/extraction/ExtractionFn.java). Because dictionaries are
+    small relative to rows, extraction is O(cardinality) host work producing
+    an id remap table — never a per-row device op."""
+
+    def apply(self, value: Optional[str]) -> Optional[str]:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class SubstringExtractionFn(ExtractionFn):
+    index: int
+    length: Optional[int] = None
+
+    def apply(self, value):
+        if value is None or value == "":
+            return None
+        if self.index >= len(value):
+            return None
+        end = None if self.length is None else self.index + self.length
+        return value[self.index:end]
+
+    def to_json(self):
+        return {"type": "substring", "index": self.index, "length": self.length}
+
+
+@dataclass(frozen=True)
+class RegexExtractionFn(ExtractionFn):
+    expr: str
+    index: int = 1
+    replace_missing: bool = False
+    replacement: Optional[str] = None
+
+    def apply(self, value):
+        m = re.search(self.expr, value or "")
+        if m and m.groups():
+            return m.group(self.index)
+        if m and self.index == 0:
+            return m.group(0)
+        return self.replacement if self.replace_missing else value
+
+    def to_json(self):
+        return {"type": "regex", "expr": self.expr, "index": self.index,
+                "replaceMissingValue": self.replace_missing,
+                "replaceMissingValueWith": self.replacement}
+
+
+@dataclass(frozen=True)
+class UpperExtractionFn(ExtractionFn):
+    def apply(self, value):
+        return value.upper() if value else value
+
+    def to_json(self):
+        return {"type": "upper"}
+
+
+@dataclass(frozen=True)
+class LowerExtractionFn(ExtractionFn):
+    def apply(self, value):
+        return value.lower() if value else value
+
+    def to_json(self):
+        return {"type": "lower"}
+
+
+@dataclass(frozen=True)
+class LookupExtractionFn(ExtractionFn):
+    """key→value map extraction (reference: query/lookup/LookupExtractionFn.java)."""
+    lookup: Tuple[Tuple[str, str], ...]
+    retain_missing: bool = True
+    replace_missing: Optional[str] = None
+
+    def apply(self, value):
+        m = dict(self.lookup)
+        if value in m:
+            return m[value]
+        return value if self.retain_missing else self.replace_missing
+
+    def to_json(self):
+        return {"type": "lookup", "lookup": {"type": "map", "map": dict(self.lookup)},
+                "retainMissingValue": self.retain_missing}
+
+
+class DimensionSpec:
+    dimension: str
+    output_name: str
+
+    @property
+    def extraction_fn(self) -> Optional[ExtractionFn]:
+        return None
+
+
+@dataclass(frozen=True)
+class DefaultDimensionSpec(DimensionSpec):
+    dimension: str
+    output_name: str = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.output_name is None:
+            object.__setattr__(self, "output_name", self.dimension)
+
+    def to_json(self):
+        return {"type": "default", "dimension": self.dimension,
+                "outputName": self.output_name}
+
+
+@dataclass(frozen=True)
+class ExtractionDimensionSpec(DimensionSpec):
+    dimension: str
+    output_name: str
+    fn: ExtractionFn = None
+
+    @property
+    def extraction_fn(self):
+        return self.fn
+
+    def to_json(self):
+        return {"type": "extraction", "dimension": self.dimension,
+                "outputName": self.output_name, "extractionFn": self.fn.to_json()}
+
+
+@dataclass(frozen=True)
+class ListFilteredDimensionSpec(DimensionSpec):
+    """reference: query/dimension/ListFilteredDimensionSpec.java"""
+    delegate: DimensionSpec = None
+    values: Tuple[str, ...] = ()
+    is_whitelist: bool = True
+
+    @property
+    def dimension(self):
+        return self.delegate.dimension
+
+    @property
+    def output_name(self):
+        return self.delegate.output_name
+
+    @property
+    def extraction_fn(self):
+        return self.delegate.extraction_fn
+
+    def to_json(self):
+        return {"type": "listFiltered", "delegate": self.delegate.to_json(),
+                "values": list(self.values), "isWhitelist": self.is_whitelist}
+
+
+def dimspec_from_json(j) -> DimensionSpec:
+    if isinstance(j, str):
+        return DefaultDimensionSpec(j, j)
+    t = j.get("type", "default")
+    if t == "default":
+        return DefaultDimensionSpec(j["dimension"], j.get("outputName") or j["dimension"])
+    if t == "extraction":
+        return ExtractionDimensionSpec(j["dimension"],
+                                       j.get("outputName") or j["dimension"],
+                                       extractionfn_from_json(j["extractionFn"]))
+    if t == "listFiltered":
+        return ListFilteredDimensionSpec(dimspec_from_json(j["delegate"]),
+                                         tuple(j["values"]),
+                                         j.get("isWhitelist", True))
+    raise ValueError(f"unknown dimension spec {t!r}")
+
+
+def extractionfn_from_json(j) -> ExtractionFn:
+    t = j["type"]
+    if t == "substring":
+        return SubstringExtractionFn(j["index"], j.get("length"))
+    if t == "regex":
+        return RegexExtractionFn(j["expr"], j.get("index", 1),
+                                 j.get("replaceMissingValue", False),
+                                 j.get("replaceMissingValueWith"))
+    if t == "upper":
+        return UpperExtractionFn()
+    if t == "lower":
+        return LowerExtractionFn()
+    if t == "lookup":
+        return LookupExtractionFn(tuple(j["lookup"]["map"].items()),
+                                  j.get("retainMissingValue", True),
+                                  j.get("replaceMissingValueWith"))
+    raise ValueError(f"unknown extraction fn {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Limit / having specs (reference: query/groupby/orderby/, query/groupby/having/)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OrderByColumnSpec:
+    dimension: str
+    direction: str = "ascending"   # ascending | descending
+    dimension_order: str = "lexicographic"  # lexicographic | numeric
+
+    def to_json(self):
+        return {"dimension": self.dimension, "direction": self.direction,
+                "dimensionOrder": self.dimension_order}
+
+
+@dataclass(frozen=True)
+class DefaultLimitSpec:
+    columns: Tuple[OrderByColumnSpec, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+    def to_json(self):
+        return {"type": "default",
+                "columns": [c.to_json() for c in self.columns],
+                "limit": self.limit, "offset": self.offset}
+
+
+class HavingSpec:
+    def evaluate(self, row: Dict[str, object]) -> bool:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class GreaterThanHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def evaluate(self, row):
+        return float(row.get(self.aggregation, 0)) > self.value
+
+    def to_json(self):
+        return {"type": "greaterThan", "aggregation": self.aggregation,
+                "value": self.value}
+
+
+@dataclass(frozen=True)
+class LessThanHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def evaluate(self, row):
+        return float(row.get(self.aggregation, 0)) < self.value
+
+    def to_json(self):
+        return {"type": "lessThan", "aggregation": self.aggregation,
+                "value": self.value}
+
+
+@dataclass(frozen=True)
+class EqualToHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def evaluate(self, row):
+        return float(row.get(self.aggregation, 0)) == self.value
+
+    def to_json(self):
+        return {"type": "equalTo", "aggregation": self.aggregation,
+                "value": self.value}
+
+
+@dataclass(frozen=True)
+class AndHaving(HavingSpec):
+    specs: Tuple[HavingSpec, ...]
+
+    def evaluate(self, row):
+        return all(s.evaluate(row) for s in self.specs)
+
+    def to_json(self):
+        return {"type": "and", "havingSpecs": [s.to_json() for s in self.specs]}
+
+
+@dataclass(frozen=True)
+class OrHaving(HavingSpec):
+    specs: Tuple[HavingSpec, ...]
+
+    def evaluate(self, row):
+        return any(s.evaluate(row) for s in self.specs)
+
+    def to_json(self):
+        return {"type": "or", "havingSpecs": [s.to_json() for s in self.specs]}
+
+
+@dataclass(frozen=True)
+class NotHaving(HavingSpec):
+    spec: HavingSpec
+
+    def evaluate(self, row):
+        return not self.spec.evaluate(row)
+
+    def to_json(self):
+        return {"type": "not", "havingSpec": self.spec.to_json()}
+
+
+@dataclass(frozen=True)
+class DimSelectorHaving(HavingSpec):
+    dimension: str
+    value: Optional[str]
+
+    def evaluate(self, row):
+        return row.get(self.dimension) == self.value
+
+    def to_json(self):
+        return {"type": "dimSelector", "dimension": self.dimension,
+                "value": self.value}
+
+
+@dataclass(frozen=True)
+class FilterHaving(HavingSpec):
+    """reference: query/groupby/having/DimFilterHavingSpec.java — evaluated
+    host-side over result rows."""
+    filter: DimFilter
+
+    def evaluate(self, row):
+        from druid_tpu.engine.filters import evaluate_filter_on_row
+        return evaluate_filter_on_row(self.filter, row)
+
+    def to_json(self):
+        return {"type": "filter", "filter": self.filter.to_json()}
+
+
+def having_from_json(j) -> Optional[HavingSpec]:
+    if j is None:
+        return None
+    t = j["type"]
+    if t == "greaterThan":
+        return GreaterThanHaving(j["aggregation"], j["value"])
+    if t == "lessThan":
+        return LessThanHaving(j["aggregation"], j["value"])
+    if t == "equalTo":
+        return EqualToHaving(j["aggregation"], j["value"])
+    if t == "and":
+        return AndHaving(tuple(having_from_json(s) for s in j["havingSpecs"]))
+    if t == "or":
+        return OrHaving(tuple(having_from_json(s) for s in j["havingSpecs"]))
+    if t == "not":
+        return NotHaving(having_from_json(j["havingSpec"]))
+    if t == "dimSelector":
+        return DimSelectorHaving(j["dimension"], j.get("value"))
+    if t == "filter":
+        return FilterHaving(filter_from_json(j["filter"]))
+    raise ValueError(f"unknown having spec {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Virtual columns (reference: segment/VirtualColumns.java, ExpressionVirtualColumn)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ExpressionVirtualColumn:
+    name: str
+    expression: str
+    output_type: str = "double"  # long | double | float | string
+
+    def to_json(self):
+        return {"type": "expression", "name": self.name,
+                "expression": self.expression, "outputType": self.output_type}
+
+
+def virtualcolumn_from_json(j) -> ExpressionVirtualColumn:
+    if j["type"] != "expression":
+        raise ValueError(f"unknown virtual column {j['type']!r}")
+    return ExpressionVirtualColumn(j["name"], j["expression"],
+                                   j.get("outputType", "double"))
+
+
+# ---------------------------------------------------------------------------
+# Queries
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Query:
+    datasource: str = ""
+    intervals: Tuple[Interval, ...] = ()
+    filter: Optional[DimFilter] = None
+    granularity: Granularity = Granularity.ALL
+    virtual_columns: Tuple[ExpressionVirtualColumn, ...] = ()
+    context: Tuple[Tuple[str, object], ...] = ()
+
+    query_type: str = "base"
+
+    @property
+    def context_map(self) -> Dict[str, object]:
+        return dict(self.context)
+
+    def required_columns(self) -> set:
+        out = set()
+        if self.filter is not None:
+            out |= self.filter.required_columns()
+        return out
+
+    def base_json(self) -> dict:
+        return {
+            "queryType": self.query_type,
+            "dataSource": self.datasource,
+            "intervals": [str(iv) for iv in self.intervals],
+            "filter": self.filter.to_json() if self.filter else None,
+            "granularity": str(self.granularity),
+            "virtualColumns": [v.to_json() for v in self.virtual_columns],
+            "context": dict(self.context),
+        }
+
+    def to_json(self) -> dict:
+        return self.base_json()
+
+
+def _mk(datasource, intervals, flt, granularity, virtual_columns, context):
+    return dict(
+        datasource=datasource,
+        intervals=tuple(normalize_intervals(intervals)),
+        filter=flt,
+        granularity=Granularity.of(granularity),
+        virtual_columns=tuple(virtual_columns or ()),
+        context=tuple(sorted((context or {}).items())),
+    )
+
+
+@dataclass(frozen=True)
+class TimeseriesQuery(Query):
+    """reference: query/timeseries/TimeseriesQuery.java"""
+    aggregations: Tuple[AggregatorSpec, ...] = ()
+    post_aggregations: Tuple[PostAggregator, ...] = ()
+    descending: bool = False
+    skip_empty_buckets: bool = False
+    query_type: str = "timeseries"
+
+    @staticmethod
+    def of(datasource, intervals, aggregations, granularity="all", filter=None,
+           post_aggregations=(), descending=False, skip_empty_buckets=False,
+           virtual_columns=(), context=None) -> "TimeseriesQuery":
+        return TimeseriesQuery(
+            aggregations=tuple(aggregations),
+            post_aggregations=tuple(post_aggregations),
+            descending=descending, skip_empty_buckets=skip_empty_buckets,
+            **_mk(datasource, intervals, filter, granularity, virtual_columns,
+                  context))
+
+    def required_columns(self):
+        out = super().required_columns()
+        for a in self.aggregations:
+            out |= a.required_columns()
+        return out
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(aggregations=[a.to_json() for a in self.aggregations],
+                 postAggregations=[p.to_json() for p in self.post_aggregations],
+                 descending=self.descending)
+        return j
+
+
+@dataclass(frozen=True)
+class TopNQuery(Query):
+    """reference: query/topn/TopNQuery.java"""
+    dimension: DimensionSpec = None
+    metric: str = ""               # ordering metric name (agg or postagg)
+    metric_ordering: str = "numeric"  # numeric | lexicographic | inverted(...)
+    threshold: int = 10
+    aggregations: Tuple[AggregatorSpec, ...] = ()
+    post_aggregations: Tuple[PostAggregator, ...] = ()
+    query_type: str = "topN"
+
+    @staticmethod
+    def of(datasource, intervals, dimension, metric, threshold, aggregations,
+           granularity="all", filter=None, post_aggregations=(),
+           metric_ordering="numeric", virtual_columns=(), context=None) -> "TopNQuery":
+        dim = dimension if isinstance(dimension, DimensionSpec) \
+            else DefaultDimensionSpec(dimension, dimension)
+        return TopNQuery(
+            dimension=dim, metric=metric, metric_ordering=metric_ordering,
+            threshold=threshold, aggregations=tuple(aggregations),
+            post_aggregations=tuple(post_aggregations),
+            **_mk(datasource, intervals, filter, granularity, virtual_columns,
+                  context))
+
+    def required_columns(self):
+        out = super().required_columns() | {self.dimension.dimension}
+        for a in self.aggregations:
+            out |= a.required_columns()
+        return out
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(dimension=self.dimension.to_json(), metric=self.metric,
+                 threshold=self.threshold,
+                 aggregations=[a.to_json() for a in self.aggregations],
+                 postAggregations=[p.to_json() for p in self.post_aggregations])
+        return j
+
+
+@dataclass(frozen=True)
+class GroupByQuery(Query):
+    """reference: query/groupby/GroupByQuery.java"""
+    dimensions: Tuple[DimensionSpec, ...] = ()
+    aggregations: Tuple[AggregatorSpec, ...] = ()
+    post_aggregations: Tuple[PostAggregator, ...] = ()
+    having: Optional[HavingSpec] = None
+    limit_spec: Optional[DefaultLimitSpec] = None
+    subtotals: Tuple[Tuple[str, ...], ...] = ()
+    query_type: str = "groupBy"
+
+    @staticmethod
+    def of(datasource, intervals, dimensions, aggregations, granularity="all",
+           filter=None, post_aggregations=(), having=None, limit_spec=None,
+           subtotals=(), virtual_columns=(), context=None) -> "GroupByQuery":
+        dims = tuple(d if isinstance(d, DimensionSpec)
+                     else DefaultDimensionSpec(d, d) for d in dimensions)
+        return GroupByQuery(
+            dimensions=dims, aggregations=tuple(aggregations),
+            post_aggregations=tuple(post_aggregations), having=having,
+            limit_spec=limit_spec,
+            subtotals=tuple(tuple(s) for s in subtotals),
+            **_mk(datasource, intervals, filter, granularity, virtual_columns,
+                  context))
+
+    def required_columns(self):
+        out = super().required_columns()
+        out |= {d.dimension for d in self.dimensions}
+        for a in self.aggregations:
+            out |= a.required_columns()
+        return out
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(dimensions=[d.to_json() for d in self.dimensions],
+                 aggregations=[a.to_json() for a in self.aggregations],
+                 postAggregations=[p.to_json() for p in self.post_aggregations],
+                 having=self.having.to_json() if self.having else None,
+                 limitSpec=self.limit_spec.to_json() if self.limit_spec else None,
+                 subtotalsSpec=[list(s) for s in self.subtotals] or None)
+        return j
+
+
+@dataclass(frozen=True)
+class ScanQuery(Query):
+    """reference: query/scan/ScanQuery.java — streaming raw-row export."""
+    columns: Tuple[str, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+    order: str = "none"  # none | ascending | descending (by __time)
+    batch_size: int = 20480
+    query_type: str = "scan"
+
+    @staticmethod
+    def of(datasource, intervals, columns=(), limit=None, offset=0, order="none",
+           filter=None, virtual_columns=(), context=None) -> "ScanQuery":
+        return ScanQuery(
+            columns=tuple(columns), limit=limit, offset=offset, order=order,
+            **_mk(datasource, intervals, filter, "all", virtual_columns, context))
+
+    def required_columns(self):
+        return super().required_columns() | set(self.columns)
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(columns=list(self.columns), limit=self.limit,
+                 offset=self.offset, order=self.order)
+        return j
+
+
+@dataclass(frozen=True)
+class SelectQuery(Query):
+    """reference: query/select/SelectQuery.java — legacy paged scan."""
+    dimensions: Tuple[str, ...] = ()
+    metrics: Tuple[str, ...] = ()
+    paging_spec: Tuple[Tuple[str, int], ...] = ()
+    threshold: int = 100
+    descending: bool = False
+    query_type: str = "select"
+
+    @staticmethod
+    def of(datasource, intervals, dimensions=(), metrics=(), threshold=100,
+           paging_spec=None, descending=False, filter=None, granularity="all",
+           context=None) -> "SelectQuery":
+        return SelectQuery(
+            dimensions=tuple(dimensions), metrics=tuple(metrics),
+            paging_spec=tuple(sorted((paging_spec or {}).items())),
+            threshold=threshold, descending=descending,
+            **_mk(datasource, intervals, filter, granularity, (), context))
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(dimensions=list(self.dimensions), metrics=list(self.metrics),
+                 pagingSpec={"pagingIdentifiers": dict(self.paging_spec),
+                             "threshold": self.threshold},
+                 descending=self.descending)
+        return j
+
+
+@dataclass(frozen=True)
+class SearchQuery(Query):
+    """reference: query/search/SearchQuery.java — find dim values matching."""
+    search_dimensions: Tuple[str, ...] = ()   # empty = all dims
+    value: str = ""
+    case_sensitive: bool = False
+    limit: int = 1000
+    sort: str = "lexicographic"  # lexicographic | alphanumeric | strlen
+    query_type: str = "search"
+
+    @staticmethod
+    def of(datasource, intervals, value, search_dimensions=(), limit=1000,
+           case_sensitive=False, filter=None, granularity="all", sort="lexicographic",
+           context=None) -> "SearchQuery":
+        return SearchQuery(
+            search_dimensions=tuple(search_dimensions), value=value,
+            case_sensitive=case_sensitive, limit=limit, sort=sort,
+            **_mk(datasource, intervals, filter, granularity, (), context))
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(searchDimensions=list(self.search_dimensions),
+                 query={"type": "contains", "value": self.value,
+                        "caseSensitive": self.case_sensitive},
+                 limit=self.limit, sort={"type": self.sort})
+        return j
+
+
+@dataclass(frozen=True)
+class TimeBoundaryQuery(Query):
+    """reference: query/timeboundary/TimeBoundaryQuery.java"""
+    bound: Optional[str] = None  # None | minTime | maxTime
+    query_type: str = "timeBoundary"
+
+    @staticmethod
+    def of(datasource, intervals=None, bound=None, filter=None,
+           context=None) -> "TimeBoundaryQuery":
+        return TimeBoundaryQuery(
+            bound=bound,
+            **_mk(datasource, intervals, filter, "all", (), context))
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(bound=self.bound)
+        return j
+
+
+@dataclass(frozen=True)
+class SegmentMetadataQuery(Query):
+    """reference: query/metadata/SegmentMetadataQuery.java"""
+    to_include: Tuple[str, ...] = ()  # empty = all columns
+    analysis_types: Tuple[str, ...] = ("cardinality", "size", "interval", "minmax")
+    merge: bool = False
+    query_type: str = "segmentMetadata"
+
+    @staticmethod
+    def of(datasource, intervals=None, to_include=(), merge=False,
+           analysis_types=("cardinality", "size", "interval", "minmax"),
+           context=None) -> "SegmentMetadataQuery":
+        return SegmentMetadataQuery(
+            to_include=tuple(to_include), merge=merge,
+            analysis_types=tuple(analysis_types),
+            **_mk(datasource, intervals, None, "all", (), context))
+
+    def to_json(self):
+        j = self.base_json()
+        j.update(toInclude={"type": "list", "columns": list(self.to_include)}
+                 if self.to_include else {"type": "all"},
+                 analysisTypes=list(self.analysis_types), merge=self.merge)
+        return j
+
+
+@dataclass(frozen=True)
+class DataSourceMetadataQuery(Query):
+    """reference: query/datasourcemetadata/DataSourceMetadataQuery.java —
+    max ingested event time."""
+    query_type: str = "dataSourceMetadata"
+
+    @staticmethod
+    def of(datasource, context=None) -> "DataSourceMetadataQuery":
+        return DataSourceMetadataQuery(
+            **_mk(datasource, None, None, "all", (), context))
+
+
+def query_from_json(j: dict) -> Query:
+    """Wire-format deserialization (reference: Jackson polymorphic Query)."""
+    t = j["queryType"]
+    ds = j["dataSource"]["name"] if isinstance(j.get("dataSource"), dict) \
+        else j.get("dataSource", "")
+    ivs = j.get("intervals")
+    if isinstance(ivs, dict):  # {"type": "intervals", "intervals": [...]}
+        ivs = ivs.get("intervals")
+    common = dict(
+        intervals=ivs,
+        filter=filter_from_json(j.get("filter")),
+        granularity=j.get("granularity", "all"),
+        context=j.get("context"),
+    )
+    vcs = tuple(virtualcolumn_from_json(v) for v in j.get("virtualColumns", []))
+    if t == "timeseries":
+        ctx = j.get("context") or {}
+        return TimeseriesQuery.of(
+            ds, aggregations=[agg_from_json(a) for a in j.get("aggregations", [])],
+            post_aggregations=[postagg_from_json(p)
+                               for p in j.get("postAggregations", [])],
+            descending=j.get("descending", False),
+            skip_empty_buckets=bool(ctx.get("skipEmptyBuckets", False)),
+            virtual_columns=vcs, **common)
+    if t == "topN":
+        m = j["metric"]
+        if isinstance(m, str):
+            metric, ordering = m, "numeric"
+        else:
+            mt = m.get("type", "numeric")
+            if mt == "numeric":
+                metric, ordering = m.get("metric", ""), "numeric"
+            elif mt == "inverted":
+                inner = m.get("metric", "")
+                if isinstance(inner, dict):
+                    metric = inner.get("metric", "")
+                    ordering = ("inverted_lexicographic"
+                                if inner.get("type") in ("dimension", "lexicographic")
+                                else "inverted")
+                else:
+                    metric, ordering = inner, "inverted"
+            elif mt in ("dimension", "lexicographic", "alphaNumeric"):
+                metric, ordering = "", "lexicographic"
+            else:
+                raise ValueError(f"unknown topN metric spec type {mt!r}")
+        return TopNQuery.of(
+            ds, dimension=dimspec_from_json(j["dimension"]),
+            metric=metric, metric_ordering=ordering,
+            threshold=j["threshold"],
+            aggregations=[agg_from_json(a) for a in j.get("aggregations", [])],
+            post_aggregations=[postagg_from_json(p)
+                               for p in j.get("postAggregations", [])],
+            virtual_columns=vcs, **common)
+    if t == "groupBy":
+        ls = j.get("limitSpec")
+        limit_spec = None
+        if ls:
+            limit_spec = DefaultLimitSpec(
+                tuple(OrderByColumnSpec(c["dimension"], c.get("direction", "ascending"),
+                                        c.get("dimensionOrder", "lexicographic"))
+                      if isinstance(c, dict) else OrderByColumnSpec(c)
+                      for c in ls.get("columns", [])),
+                ls.get("limit"), ls.get("offset", 0))
+        return GroupByQuery.of(
+            ds, dimensions=[dimspec_from_json(d) for d in j.get("dimensions", [])],
+            aggregations=[agg_from_json(a) for a in j.get("aggregations", [])],
+            post_aggregations=[postagg_from_json(p)
+                               for p in j.get("postAggregations", [])],
+            having=having_from_json(j.get("having")),
+            limit_spec=limit_spec,
+            subtotals=j.get("subtotalsSpec") or (), virtual_columns=vcs, **common)
+    if t == "scan":
+        common.pop("granularity")
+        return ScanQuery.of(ds, columns=j.get("columns", ()),
+                            limit=j.get("limit"), offset=j.get("offset", 0),
+                            order=j.get("order", "none"), virtual_columns=vcs,
+                            **common)
+    if t == "select":
+        ps = j.get("pagingSpec", {})
+        return SelectQuery.of(ds, dimensions=j.get("dimensions", ()),
+                              metrics=j.get("metrics", ()),
+                              threshold=ps.get("threshold", 100),
+                              paging_spec=ps.get("pagingIdentifiers"),
+                              descending=j.get("descending", False), **common)
+    if t == "search":
+        q = j.get("query", {})
+        return SearchQuery.of(ds, value=q.get("value", ""),
+                              search_dimensions=j.get("searchDimensions", ()),
+                              limit=j.get("limit", 1000),
+                              case_sensitive=q.get("caseSensitive", False),
+                              sort=(j.get("sort") or {}).get("type", "lexicographic"),
+                              **common)
+    if t == "timeBoundary":
+        common.pop("granularity")
+        return TimeBoundaryQuery.of(ds, bound=j.get("bound"), **common)
+    if t == "segmentMetadata":
+        inc = j.get("toInclude") or {}
+        return SegmentMetadataQuery.of(
+            ds, intervals=common["intervals"],
+            to_include=inc.get("columns", ()) if inc.get("type") == "list" else (),
+            merge=j.get("merge", False),
+            analysis_types=tuple(j.get("analysisTypes",
+                                       ("cardinality", "size", "interval", "minmax"))),
+            context=j.get("context"))
+    if t == "dataSourceMetadata":
+        return DataSourceMetadataQuery.of(ds, context=j.get("context"))
+    raise ValueError(f"unknown query type {t!r}")

@@ -1,0 +1,176 @@
+"""Post-aggregators: arithmetic over finalized aggregate values.
+
+Capability parity with the reference's PostAggregator hierarchy
+(processing/src/main/java/org/apache/druid/query/aggregation/post/ —
+arithmetic, fieldAccess, constant, greatest/least, hyperUniqueCardinality,
+finalizingFieldAccess). Evaluated host-side over result rows (result sets are
+small; device work is done by then).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+class PostAggregator:
+    name: str
+
+    def compute(self, row: Dict[str, object]) -> object:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class FieldAccessPostAgg(PostAggregator):
+    name: str
+    field: str
+
+    def compute(self, row):
+        return row.get(self.field)
+
+    def to_json(self):
+        return {"type": "fieldAccess", "name": self.name, "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class FinalizingFieldAccessPostAgg(PostAggregator):
+    name: str
+    field: str
+
+    def compute(self, row):
+        return row.get(self.field)
+
+    def to_json(self):
+        return {"type": "finalizingFieldAccess", "name": self.name,
+                "fieldName": self.field}
+
+
+@dataclass(frozen=True)
+class ConstantPostAgg(PostAggregator):
+    name: str
+    value: float
+
+    def compute(self, row):
+        return self.value
+
+    def to_json(self):
+        return {"type": "constant", "name": self.name, "value": self.value}
+
+
+def _safe_div(a, b, zero):
+    """Array-safe division (reference: division by zero -> 0)."""
+    import numpy as np
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        b_arr = np.asarray(b, dtype=np.float64)
+        return np.where(b_arr != 0, np.asarray(a, dtype=np.float64)
+                        / np.where(b_arr != 0, b_arr, 1.0), zero)
+    return (a / b) if b else zero
+
+
+_OPS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: _safe_div(a, b, 0.0),
+    "quotient": lambda a, b: _safe_div(a, b, math.nan),
+}
+
+
+@dataclass(frozen=True)
+class ArithmeticPostAgg(PostAggregator):
+    name: str
+    fn: str
+    fields: Tuple[PostAggregator, ...]
+
+    def compute(self, row):
+        # works both per-row (scalars) and vectorized (numpy arrays)
+        op = _OPS[self.fn]
+        vals = [f.compute(row) for f in self.fields]
+        vals = [0.0 if v is None else v for v in vals]
+        import numpy as np
+        vals = [v if isinstance(v, np.ndarray) else float(v) for v in vals]
+        out = vals[0]
+        for v in vals[1:]:
+            out = op(out, v)
+        return out
+
+    def to_json(self):
+        return {"type": "arithmetic", "name": self.name, "fn": self.fn,
+                "fields": [f.to_json() for f in self.fields]}
+
+
+@dataclass(frozen=True)
+class GreatestPostAgg(PostAggregator):
+    name: str
+    fields: Tuple[PostAggregator, ...]
+    kind: str = "double"
+
+    def compute(self, row):
+        return max(float(f.compute(row) or 0.0) for f in self.fields)
+
+    def to_json(self):
+        return {"type": f"{self.kind}Greatest", "name": self.name,
+                "fields": [f.to_json() for f in self.fields]}
+
+
+@dataclass(frozen=True)
+class LeastPostAgg(PostAggregator):
+    name: str
+    fields: Tuple[PostAggregator, ...]
+    kind: str = "double"
+
+    def compute(self, row):
+        return min(float(f.compute(row) or 0.0) for f in self.fields)
+
+    def to_json(self):
+        return {"type": f"{self.kind}Least", "name": self.name,
+                "fields": [f.to_json() for f in self.fields]}
+
+
+@dataclass(frozen=True)
+class HyperUniqueFinalizingPostAgg(PostAggregator):
+    """Reference: hyperloglog/HyperUniqueFinalizingPostAggregator.java —
+    in this framework HLL states are finalized by their AggregatorSpec before
+    post-agg evaluation, so this is a pass-through field access."""
+    name: str
+    field: str
+
+    def compute(self, row):
+        return row.get(self.field)
+
+    def to_json(self):
+        return {"type": "hyperUniqueCardinality", "name": self.name,
+                "fieldName": self.field}
+
+
+def postagg_from_json(j: dict) -> PostAggregator:
+    t = j["type"]
+    if t == "fieldAccess":
+        return FieldAccessPostAgg(j["name"], j["fieldName"])
+    if t == "finalizingFieldAccess":
+        return FinalizingFieldAccessPostAgg(j["name"], j["fieldName"])
+    if t == "constant":
+        return ConstantPostAgg(j["name"], j["value"])
+    if t == "arithmetic":
+        return ArithmeticPostAgg(j["name"], j["fn"],
+                                 tuple(postagg_from_json(f) for f in j["fields"]))
+    if t == "hyperUniqueCardinality":
+        return HyperUniqueFinalizingPostAgg(j["name"], j["fieldName"])
+    for kind in ("double", "long"):
+        if t == f"{kind}Greatest":
+            return GreatestPostAgg(j["name"],
+                                   tuple(postagg_from_json(f) for f in j["fields"]), kind)
+        if t == f"{kind}Least":
+            return LeastPostAgg(j["name"],
+                                tuple(postagg_from_json(f) for f in j["fields"]), kind)
+    raise ValueError(f"unknown post-aggregator type {t!r}")
+
+
+def compute_postaggs(postaggs, row: Dict[str, object]) -> Dict[str, object]:
+    out = dict(row)
+    for pa in postaggs:
+        out[pa.name] = pa.compute(out)
+    return out

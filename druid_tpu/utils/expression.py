@@ -1,0 +1,301 @@
+"""Druid-style scalar expression language: parser + vectorized evaluator.
+
+Capability parity with the reference's math expression language
+(common/src/main/java/org/apache/druid/math/expr/Parser.java, Expr.java,
+Function.java — ANTLR grammar over typed long/double/string exprs, used by
+expression virtual columns and expression filters).
+
+TPU-first difference: instead of a per-row interpreter, an expression
+evaluates over whole columns at once — numpy arrays host-side or jax.numpy
+arrays on device (the evaluator is backend-agnostic; under jit it traces to
+fused XLA elementwise ops, which is strictly better than the reference's
+boxed per-row eval).
+
+Grammar (precedence low→high):
+  || ; && ; ==, != ; <, <=, >, >= ; +, - ; *, /, % ; ^ ; unary -, ! ;
+  literals (long, double, 'string'), identifiers, function calls.
+"""
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+)
+    | (?P<int>\d+)
+    | (?P<str>'(?:[^'\\]|\\.)*')
+    | (?P<id>[A-Za-z_][A-Za-z0-9_.$]*)
+    | (?P<op>\|\||&&|==|!=|<=|>=|[-+*/%^()!<>,])
+    )""", re.VERBOSE)
+
+
+def _tokenize(s: str) -> List[Tuple[str, str]]:
+    out, pos = [], 0
+    while pos < len(s):
+        m = _TOKEN_RE.match(s, pos)
+        if not m or m.end() == pos:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"bad token at {s[pos:]!r}")
+        pos = m.end()
+        for kind in ("num", "int", "str", "id", "op"):
+            v = m.group(kind)
+            if v is not None:
+                out.append((kind, v))
+                break
+    out.append(("eof", ""))
+    return out
+
+
+class Expr:
+    def evaluate(self, bindings: Dict[str, object]):
+        raise NotImplementedError
+
+    def required_columns(self) -> set:
+        return set()
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object
+
+    def evaluate(self, bindings):
+        return self.value
+
+
+@dataclass(frozen=True)
+class Identifier(Expr):
+    name: str
+
+    def evaluate(self, bindings):
+        if self.name not in bindings:
+            raise KeyError(f"unbound identifier {self.name!r}")
+        return bindings[self.name]
+
+    def required_columns(self):
+        return {self.name}
+
+
+def _xp(*vals):
+    """Pick the array module (jnp if any input is a jax array, else numpy)."""
+    for v in vals:
+        if type(v).__module__.startswith("jax"):
+            import jax.numpy as jnp
+            return jnp
+    return np
+
+
+def _to_num(v):
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    def evaluate(self, b):
+        l = _to_num(self.left.evaluate(b))
+        r = _to_num(self.right.evaluate(b))
+        op = self.op
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            xp = _xp(l, r)
+            if isinstance(l, (int, np.integer)) and isinstance(r, (int, np.integer)):
+                return l // r if r else 0
+            return xp.where(r != 0, l / xp.where(r != 0, r, 1), 0.0) \
+                if not np.isscalar(r) or hasattr(r, "shape") else (l / r if r else 0.0)
+        if op == "%":
+            return l % r
+        if op == "^":
+            xp = _xp(l, r)
+            return xp.power(l, r) if hasattr(l, "shape") or hasattr(r, "shape") \
+                else l ** r
+        if op == "==":
+            return l == r
+        if op == "!=":
+            return l != r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        if op == "&&":
+            xp = _xp(l, r)
+            return xp.logical_and(xp.asarray(l, dtype=bool) if hasattr(l, "shape") else bool(l),
+                                  xp.asarray(r, dtype=bool) if hasattr(r, "shape") else bool(r))
+        if op == "||":
+            xp = _xp(l, r)
+            return xp.logical_or(xp.asarray(l, dtype=bool) if hasattr(l, "shape") else bool(l),
+                                 xp.asarray(r, dtype=bool) if hasattr(r, "shape") else bool(r))
+        raise ValueError(op)
+
+    def required_columns(self):
+        return self.left.required_columns() | self.right.required_columns()
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str
+    operand: Expr
+
+    def evaluate(self, b):
+        v = _to_num(self.operand.evaluate(b))
+        if self.op == "-":
+            return -v
+        xp = _xp(v)
+        return xp.logical_not(v) if hasattr(v, "shape") else (not v)
+
+    def required_columns(self):
+        return self.operand.required_columns()
+
+
+def _fn_if(cond, a, b):
+    xp = _xp(cond, a, b)
+    if hasattr(cond, "shape"):
+        return xp.where(cond, a, b)
+    return a if cond else b
+
+
+_FUNCTIONS: Dict[str, Callable] = {
+    "abs": lambda x: _xp(x).abs(x) if hasattr(x, "shape") else abs(x),
+    "ceil": lambda x: _xp(x).ceil(x) if hasattr(x, "shape") else math.ceil(x),
+    "floor": lambda x: _xp(x).floor(x) if hasattr(x, "shape") else math.floor(x),
+    "exp": lambda x: _xp(x).exp(x) if hasattr(x, "shape") else math.exp(x),
+    "log": lambda x: _xp(x).log(x) if hasattr(x, "shape") else math.log(x),
+    "log10": lambda x: _xp(x).log10(x) if hasattr(x, "shape") else math.log10(x),
+    "sqrt": lambda x: _xp(x).sqrt(x) if hasattr(x, "shape") else math.sqrt(x),
+    "sin": lambda x: _xp(x).sin(x) if hasattr(x, "shape") else math.sin(x),
+    "cos": lambda x: _xp(x).cos(x) if hasattr(x, "shape") else math.cos(x),
+    "tan": lambda x: _xp(x).tan(x) if hasattr(x, "shape") else math.tan(x),
+    "min": lambda a, b: _xp(a, b).minimum(a, b)
+        if hasattr(a, "shape") or hasattr(b, "shape") else min(a, b),
+    "max": lambda a, b: _xp(a, b).maximum(a, b)
+        if hasattr(a, "shape") or hasattr(b, "shape") else max(a, b),
+    "pow": lambda a, b: _xp(a, b).power(a, b)
+        if hasattr(a, "shape") or hasattr(b, "shape") else a ** b,
+    "if": _fn_if,
+    "nvl": lambda a, b: b if a is None else a,
+    "cast": lambda x, t: x,  # typing handled by output column dtype
+}
+
+
+@dataclass(frozen=True)
+class FunctionCall(Expr):
+    name: str
+    args: Tuple[Expr, ...]
+
+    def evaluate(self, b):
+        fn = _FUNCTIONS.get(self.name)
+        if fn is None:
+            raise ValueError(f"unknown function {self.name!r}")
+        return fn(*[a.evaluate(b) for a in self.args])
+
+    def required_columns(self):
+        out = set()
+        for a in self.args:
+            out |= a.required_columns()
+        return out
+
+
+class _Parser:
+    _BINARY = [
+        {"||"}, {"&&"}, {"==", "!="}, {"<", "<=", ">", ">="},
+        {"+", "-"}, {"*", "/", "%"}, {"^"},
+    ]
+
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, op):
+        k, v = self.next()
+        if k != "op" or v != op:
+            raise ValueError(f"expected {op!r}, got {v!r}")
+
+    def parse(self) -> Expr:
+        e = self.parse_level(0)
+        if self.peek()[0] != "eof":
+            raise ValueError(f"trailing tokens: {self.toks[self.i:]}")
+        return e
+
+    def parse_level(self, level) -> Expr:
+        if level >= len(self._BINARY):
+            return self.parse_unary()
+        left = self.parse_level(level + 1)
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in self._BINARY[level]:
+                self.next()
+                right = self.parse_level(level + 1)
+                left = BinaryOp(v, left, right)
+            else:
+                return left
+
+    def parse_unary(self) -> Expr:
+        k, v = self.peek()
+        if k == "op" and v in ("-", "!"):
+            self.next()
+            return UnaryOp(v, self.parse_unary())
+        return self.parse_atom()
+
+    def parse_atom(self) -> Expr:
+        k, v = self.next()
+        if k == "int":
+            return Literal(int(v))
+        if k == "num":
+            return Literal(float(v))
+        if k == "str":
+            return Literal(v[1:-1].replace("\\'", "'"))
+        if k == "id":
+            if self.peek() == ("op", "("):
+                self.next()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.parse_level(0))
+                    while self.peek() == ("op", ","):
+                        self.next()
+                        args.append(self.parse_level(0))
+                self.expect(")")
+                return FunctionCall(v, tuple(args))
+            return Identifier(v)
+        if k == "op" and v == "(":
+            e = self.parse_level(0)
+            self.expect(")")
+            return e
+        raise ValueError(f"unexpected token {v!r}")
+
+
+_CACHE: Dict[str, Expr] = {}
+
+
+def parse_expression(s: str) -> Expr:
+    e = _CACHE.get(s)
+    if e is None:
+        e = _Parser(_tokenize(s)).parse()
+        _CACHE[s] = e
+    return e

@@ -1,0 +1,57 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports,
+mirroring the reference's single-JVM simulated-cluster testing strategy
+(SURVEY §4: CachingClusteredClientTest-style tests without sockets)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import druid_tpu.engine  # noqa: F401  (enables x64 before any jax use)
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.utils.intervals import Interval
+
+DAY = Interval.of("2026-01-01", "2026-01-02")
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+
+TEST_SCHEMA = (
+    ColumnSpec("dimA", "string", cardinality=10, distribution="uniform"),
+    ColumnSpec("dimB", "string", cardinality=100, distribution="zipf"),
+    ColumnSpec("dimHi", "string", cardinality=5000, distribution="uniform"),
+    ColumnSpec("metLong", "long", low=0, high=100),
+    ColumnSpec("metFloat", "float", distribution="normal", mean=10.0, std=3.0),
+    ColumnSpec("metDouble", "double", low=0.0, high=1.0),
+)
+
+
+@pytest.fixture(scope="session")
+def generator():
+    return DataGenerator(TEST_SCHEMA, seed=42)
+
+
+@pytest.fixture(scope="session")
+def segment(generator):
+    return generator.segment(20_000, DAY, datasource="test")
+
+
+@pytest.fixture(scope="session")
+def segments(generator):
+    """4 segments over a 4-day range sharing dictionaries."""
+    return generator.segments(4, 5_000, Interval.of("2026-01-01", "2026-01-05"),
+                              datasource="test")
+
+
+def rows_as_frame(segment):
+    """Decode a segment to python-level rows for golden-result computation."""
+    out = {"__time": segment.time_ms.copy()}
+    for name, col in segment.dims.items():
+        vals = np.asarray(col.dictionary.values, dtype=object)
+        out[name] = vals[col.ids]
+    for name, m in segment.metrics.items():
+        out[name] = m.values.copy()
+    return out

@@ -1,0 +1,108 @@
+"""Foundations: intervals, granularities, dictionaries, bitmaps, expressions."""
+import numpy as np
+import pytest
+
+from druid_tpu.data.bitmap import Bitmap, BitmapIndex
+from druid_tpu.data.dictionary import Dictionary, merge_dictionaries
+from druid_tpu.utils.expression import parse_expression
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval, condense, parse_ts
+
+
+def test_interval_parse_and_ops():
+    iv = Interval.parse("2026-01-01/2026-01-02")
+    assert iv.width == 86400000
+    assert iv.contains(parse_ts("2026-01-01T12:00:00Z"))
+    assert not iv.contains(parse_ts("2026-01-02"))
+    other = Interval.of("2026-01-01T18:00:00Z", "2026-01-03")
+    assert iv.overlaps(other)
+    assert iv.intersect(other).width == 6 * 3600 * 1000
+
+
+def test_condense():
+    a = Interval.of("2026-01-01", "2026-01-03")
+    b = Interval.of("2026-01-02", "2026-01-04")
+    c = Interval.of("2026-01-05", "2026-01-06")
+    out = condense([c, a, b])
+    assert out == [Interval.of("2026-01-01", "2026-01-04"), c]
+
+
+def test_granularity_uniform():
+    g = Granularity.of("hour")
+    ts = parse_ts("2026-01-01T05:30:12Z")
+    assert g.bucket_start(ts) == parse_ts("2026-01-01T05:00:00Z")
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    assert g.num_buckets(iv) == 24
+    ids = g.bucket_ids(np.asarray([ts, parse_ts("2026-01-02T00:00:00Z")]), iv)
+    assert list(ids) == [5, -1]
+
+
+def test_granularity_calendar():
+    g = Granularity.of("month")
+    ts = parse_ts("2026-03-15T10:00:00Z")
+    assert g.bucket_start(ts) == parse_ts("2026-03-01")
+    assert g.next_bucket(parse_ts("2026-12-01")) == parse_ts("2027-01-01")
+    q = Granularity.of("quarter")
+    assert q.bucket_start(ts) == parse_ts("2026-01-01")
+    y = Granularity.of("year")
+    iv = Interval.of("2025-06-01", "2027-02-01")
+    assert list(y.bucket_starts(iv)) == [parse_ts("2025-01-01"),
+                                         parse_ts("2026-01-01"),
+                                         parse_ts("2027-01-01")]
+
+
+def test_granularity_week_starts_monday():
+    g = Granularity.of("week")
+    # 2026-01-01 is a Thursday; its week starts Monday 2025-12-29
+    assert g.bucket_start(parse_ts("2026-01-01")) == parse_ts("2025-12-29")
+
+
+def test_dictionary():
+    d = Dictionary.from_values(["b", "a", "c", "a", None])
+    assert d.values == ["", "a", "b", "c"]
+    assert d.id_of("b") == 2
+    assert d.id_of("zzz") == -1
+    ids = d.encode(["a", "c", None])
+    assert list(ids) == [1, 3, 0]
+    lo, hi = d.id_range("a", "b")
+    assert (lo, hi) == (1, 3)
+    lo, hi = d.id_range("a", "b", lower_strict=True)
+    assert (lo, hi) == (2, 3)
+
+
+def test_merge_dictionaries():
+    d1 = Dictionary(["a", "c"])
+    d2 = Dictionary(["b", "c"])
+    merged, remaps = merge_dictionaries([d1, d2])
+    assert merged.values == ["a", "b", "c"]
+    assert list(remaps[0]) == [0, 2]
+    assert list(remaps[1]) == [1, 2]
+
+
+def test_bitmap_algebra():
+    a = Bitmap.from_indices(np.asarray([0, 5, 9]), 10)
+    b = Bitmap.from_indices(np.asarray([5, 6]), 10)
+    assert sorted((a & b).to_indices()) == [5]
+    assert sorted((a | b).to_indices()) == [0, 5, 6, 9]
+    assert sorted((~a).to_indices()) == [1, 2, 3, 4, 6, 7, 8]
+    assert a.cardinality() == 3
+
+
+def test_bitmap_index():
+    ids = np.asarray([0, 1, 2, 1, 0, 2, 2], dtype=np.int32)
+    idx = BitmapIndex.build(ids, 3)
+    assert sorted(idx.bitmap(2).to_indices()) == [2, 5, 6]
+    assert idx.union_of(np.asarray([0, 1])).cardinality() == 4
+
+
+def test_expression_eval():
+    e = parse_expression("metA * 2 + 1")
+    out = e.evaluate({"metA": np.asarray([1.0, 2.0])})
+    assert list(out) == [3.0, 5.0]
+    e2 = parse_expression("(a > 2) && (b < 1)")
+    out2 = e2.evaluate({"a": np.asarray([1, 3, 5]), "b": np.asarray([0, 0, 2])})
+    assert list(out2) == [False, True, False]
+    e3 = parse_expression("max(a, 3)")
+    assert list(e3.evaluate({"a": np.asarray([1, 5])})) == [3, 5]
+    assert parse_expression("if(1 > 0, 'yes', 'no')").evaluate({}) == "yes"
+    assert parse_expression("abs(0 - 7) % 3").evaluate({}) == 1

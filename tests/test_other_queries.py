@@ -1,0 +1,279 @@
+"""Scan, select, search, timeBoundary, segmentMetadata, dataSourceMetadata,
+HLL cardinality, and JSON wire-format round trips."""
+import numpy as np
+import pytest
+
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.query import (CardinalityAggregator, CountAggregator,
+                             FilteredAggregator, HyperUniqueAggregator,
+                             LongSumAggregator, SelectorFilter, agg_from_json,
+                             filter_from_json)
+from druid_tpu.query.model import (DataSourceMetadataQuery, ScanQuery,
+                                   SearchQuery, SegmentMetadataQuery,
+                                   SelectQuery, TimeBoundaryQuery,
+                                   TimeseriesQuery, TopNQuery, GroupByQuery,
+                                   query_from_json)
+from druid_tpu.utils.intervals import Interval
+
+from conftest import DAY, rows_as_frame
+
+
+def test_scan_basic(segment):
+    ex = QueryExecutor([segment])
+    q = ScanQuery.of("test", DAY, columns=["__time", "dimA", "metLong"], limit=100)
+    out = ex.run(q)
+    assert out
+    events = [e for batch in out for e in batch["events"]]
+    assert len(events) == 100
+    frame = rows_as_frame(segment)
+    assert events[0]["dimA"] == frame["dimA"][0]
+    assert events[0]["metLong"] == int(frame["metLong"][0])
+    assert events[0]["__time"] == int(frame["__time"][0])
+
+
+def test_scan_filtered_and_offset(segment):
+    ex = QueryExecutor([segment])
+    q = ScanQuery.of("test", DAY, columns=["dimA"], limit=10, offset=5,
+                     filter=SelectorFilter("dimA", "v00000004"))
+    out = ex.run(q)
+    events = [e for batch in out for e in batch["events"]]
+    assert len(events) == 10
+    assert all(e["dimA"] == "v00000004" for e in events)
+
+
+def test_select_paging(segment):
+    ex = QueryExecutor([segment])
+    q = SelectQuery.of("test", DAY, dimensions=["dimA"], metrics=["metLong"],
+                       threshold=50)
+    out = ex.run(q)
+    res = out[0]["result"]
+    assert len(res["events"]) == 50
+    pid = res["pagingIdentifiers"]
+    q2 = SelectQuery.of("test", DAY, dimensions=["dimA"], metrics=["metLong"],
+                        threshold=50, paging_spec=pid)
+    res2 = ex.run(q2)[0]["result"]
+    assert len(res2["events"]) == 50
+    assert res2["events"][0]["offset"] == res["events"][-1]["offset"] + 1
+
+
+def test_search(segment):
+    ex = QueryExecutor([segment])
+    q = SearchQuery.of("test", DAY, value="0003",
+                       search_dimensions=["dimA", "dimB"])
+    out = ex.run(q)
+    entries = out[0]["result"]
+    assert {e["value"] for e in entries if e["dimension"] == "dimA"} == {"v00000003"}
+    frame = rows_as_frame(segment)
+    for e in entries:
+        expected = int((frame[e["dimension"]] == e["value"]).sum())
+        assert e["count"] == expected
+
+
+def test_time_boundary(segments):
+    ex = QueryExecutor(segments)
+    out = ex.run(TimeBoundaryQuery.of("test"))
+    res = out[0]["result"]
+    assert res["minTime"] == min(s.min_time for s in segments)
+    assert res["maxTime"] == max(s.max_time for s in segments)
+    out2 = ex.run(TimeBoundaryQuery.of("test", bound="maxTime"))
+    assert out2[0]["result"] == {"maxTime": res["maxTime"]}
+
+
+def test_segment_metadata(segment):
+    ex = QueryExecutor([segment])
+    out = ex.run(SegmentMetadataQuery.of("test"))
+    assert len(out) == 1
+    a = out[0]
+    assert a["numRows"] == segment.n_rows
+    assert a["columns"]["dimA"]["cardinality"] == 10
+    assert a["columns"]["metLong"]["type"] == "LONG"
+    assert a["columns"]["__time"]["minValue"] == segment.min_time
+
+
+def test_segment_metadata_merge(segments):
+    ex = QueryExecutor(segments)
+    out = ex.run(SegmentMetadataQuery.of("test", merge=True))
+    assert len(out) == 1
+    assert out[0]["numRows"] == sum(s.n_rows for s in segments)
+
+
+def test_datasource_metadata(segments):
+    ex = QueryExecutor(segments)
+    out = ex.run(DataSourceMetadataQuery.of("test"))
+    assert out[0]["result"]["maxIngestedEventTime"] == max(
+        s.max_time for s in segments)
+
+
+def test_cardinality_agg(segment):
+    ex = QueryExecutor([segment])
+    q = TimeseriesQuery.of("test", DAY, [
+        CardinalityAggregator("cardB", ("dimB",)),
+        CardinalityAggregator("cardHi", ("dimHi",)),
+    ])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    truth_b = len(set(frame["dimB"]))
+    truth_hi = len(set(frame["dimHi"]))
+    assert rows[0]["result"]["cardB"] == pytest.approx(truth_b, rel=0.05)
+    assert rows[0]["result"]["cardHi"] == pytest.approx(truth_hi, rel=0.05)
+
+
+def test_cardinality_multi_segment_fold(segments):
+    """HLL registers must fold across segments without double counting —
+    the same value in two segments counts once (hashes are value-based)."""
+    ex = QueryExecutor(segments)
+    iv = Interval.of("2026-01-01", "2026-01-05")
+    q = TimeseriesQuery.of("test", iv, [CardinalityAggregator("card", ("dimB",))])
+    rows = ex.run(q)
+    truth = len({v for s in segments for v in
+                 np.asarray(s.dims["dimB"].dictionary.values, dtype=object)[
+                     np.unique(s.dims["dimB"].ids)]})
+    assert rows[0]["result"]["card"] == pytest.approx(truth, rel=0.05)
+
+
+def test_cardinality_by_row(segment):
+    ex = QueryExecutor([segment])
+    q = TimeseriesQuery.of("test", DAY, [
+        CardinalityAggregator("c", ("dimA", "dimB"), by_row=True)])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    truth = len(set(zip(frame["dimA"], frame["dimB"])))
+    assert rows[0]["result"]["c"] == pytest.approx(truth, rel=0.07)
+
+
+def test_filtered_aggregator(segment):
+    ex = QueryExecutor([segment])
+    agg = FilteredAggregator("f", LongSumAggregator("f", "metLong"),
+                             SelectorFilter("dimA", "v00000001"))
+    q = TimeseriesQuery.of("test", DAY, [CountAggregator("rows"), agg])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    mask = frame["dimA"] == "v00000001"
+    assert rows[0]["result"]["f"] == int(frame["metLong"][mask].sum())
+    assert rows[0]["result"]["rows"] == segment.n_rows
+
+
+def test_query_json_roundtrip(segment):
+    ex = QueryExecutor([segment])
+    q = GroupByQuery.of("test", DAY, ["dimA"], [
+        CountAggregator("rows"), LongSumAggregator("s", "metLong")],
+        filter=SelectorFilter("dimB", "v00000001"), granularity="hour")
+    j = q.to_json()
+    q2 = query_from_json(j)
+    assert ex.run(q) == ex.run(q2)
+
+
+def test_filter_json_roundtrip():
+    j = {"type": "and", "fields": [
+        {"type": "selector", "dimension": "d", "value": "x"},
+        {"type": "or", "fields": [
+            {"type": "bound", "dimension": "m", "lower": "1", "upper": "2",
+             "lowerStrict": True, "upperStrict": False, "ordering": "numeric"},
+            {"type": "not", "field": {"type": "in", "dimension": "d",
+                                      "values": ["a", "b"]}},
+        ]},
+        {"type": "like", "dimension": "d", "pattern": "foo%"},
+        {"type": "regex", "dimension": "d", "pattern": "^x"},
+    ]}
+    f = filter_from_json(j)
+    assert filter_from_json(f.to_json()) == f
+
+
+def test_agg_json_roundtrip():
+    specs = [
+        {"type": "count", "name": "n"},
+        {"type": "longSum", "name": "a", "fieldName": "m"},
+        {"type": "doubleMax", "name": "b", "fieldName": "m"},
+        {"type": "doubleFirst", "name": "c", "fieldName": "m"},
+        {"type": "hyperUnique", "name": "d", "fieldName": "m"},
+        {"type": "cardinality", "name": "e", "fields": ["x", "y"], "byRow": True},
+        {"type": "filtered", "name": "f",
+         "aggregator": {"type": "count", "name": "f"},
+         "filter": {"type": "selector", "dimension": "d", "value": "v"}},
+    ]
+    for j in specs:
+        a = agg_from_json(j)
+        assert agg_from_json(a.to_json()).to_json() == a.to_json()
+
+
+def test_topn_inverted_metric_spec_json(segment):
+    """Wire-format {"metric": {"type": "inverted", ...}} returns bottom-N."""
+    ex = QueryExecutor([segment])
+    base = {"queryType": "topN", "dataSource": "test",
+            "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+            "dimension": "dimA", "threshold": 3,
+            "aggregations": [{"type": "count", "name": "cnt"}]}
+    top = ex.run_json({**base, "metric": "cnt"})[0]["result"]
+    bottom = ex.run_json({**base, "metric": {"type": "inverted",
+                                             "metric": "cnt"}})[0]["result"]
+    tops = [e["cnt"] for e in top]
+    bots = [e["cnt"] for e in bottom]
+    assert tops == sorted(tops, reverse=True)
+    assert bots == sorted(bots)
+    assert max(bots) <= min(tops)
+    dim_sorted = ex.run_json({**base, "metric": {"type": "dimension"}})[0]["result"]
+    vals = [e["dimA"] for e in dim_sorted]
+    assert vals == sorted(vals)
+
+
+def test_time_bound_filter_outside_segment(segment):
+    """__time bound far outside the segment interval must not overflow int32."""
+    ex = QueryExecutor([segment])
+    from druid_tpu.query import BoundFilter
+    q = TimeseriesQuery.of("test", DAY, [CountAggregator("rows")],
+                           filter=BoundFilter("__time", lower="0",
+                                              ordering="numeric"))
+    rows = ex.run(q)
+    assert rows[0]["result"]["rows"] == segment.n_rows
+
+
+def test_all_granularity_disjoint_intervals(segment):
+    """granularity=all over 2 disjoint intervals -> ONE row covering both."""
+    ex = QueryExecutor([segment])
+    ivs = [Interval.of("2026-01-01T00:00:00Z", "2026-01-01T02:00:00Z"),
+           Interval.of("2026-01-01T10:00:00Z", "2026-01-01T12:00:00Z")]
+    q = TimeseriesQuery.of("test", ivs, [CountAggregator("rows")])
+    rows = ex.run(q)
+    assert len(rows) == 1
+    frame = rows_as_frame(segment)
+    m = np.zeros(segment.n_rows, dtype=bool)
+    for iv in ivs:
+        m |= (frame["__time"] >= iv.start) & (frame["__time"] < iv.end)
+    assert rows[0]["result"]["rows"] == int(m.sum())
+    q2 = TopNQuery.of("test", ivs, "dimA", metric="rows", threshold=3,
+                      aggregations=[CountAggregator("rows")])
+    assert len(ex.run(q2)) == 1
+
+
+def test_builder_type_widening():
+    from druid_tpu.data.segment import SegmentBuilder
+    from druid_tpu.utils.intervals import Interval as Iv
+    b = SegmentBuilder("w", Iv.of("2026-01-01", "2026-01-02"))
+    b.add_row(Iv.of("2026-01-01", "2026-01-02").start, {"d": "a"}, {"m": 0})
+    b.add_row(Iv.of("2026-01-01", "2026-01-02").start + 1, {"d": "b"}, {"m": 2.5})
+    seg = b.build()
+    assert float(seg.metrics["m"].values.sum()) == 2.5
+
+
+def test_scan_filter_on_virtual_column(segment):
+    from druid_tpu.query.model import ExpressionVirtualColumn
+    from druid_tpu.query import BoundFilter
+    ex = QueryExecutor([segment])
+    vc = ExpressionVirtualColumn("doubled", "metLong * 2", "long")
+    q = ScanQuery.of("test", DAY, columns=["metLong"], limit=50,
+                     filter=BoundFilter("doubled", lower="100",
+                                        ordering="numeric"),
+                     virtual_columns=[vc])
+    out = ex.run(q)
+    events = [e for batch in out for e in batch["events"]]
+    assert events and all(e["metLong"] * 2 >= 100 for e in events)
+
+
+def test_timeseries_skip_empty_buckets_json(segment):
+    ex = QueryExecutor([segment])
+    q = {"queryType": "timeseries", "dataSource": "test",
+         "intervals": ["2026-01-01/2026-01-02"], "granularity": "minute",
+         "aggregations": [{"type": "count", "name": "n"}],
+         "context": {"skipEmptyBuckets": True}}
+    rows = ex.run_json(q)
+    assert all(r["result"]["n"] > 0 for r in rows)
