@@ -25,6 +25,7 @@ from druid_tpu.data.segment import Segment, ValueType
 from druid_tpu.engine.filters import host_mask
 from druid_tpu.engine.grouping import KeyDim, run_grouped_aggregate
 from druid_tpu.engine.merge import merge_partials
+from druid_tpu.parallel import distributed
 from druid_tpu.query.model import (DefaultLimitSpec, DimensionSpec, GroupByQuery,
                                    ListFilteredDimensionSpec, ScanQuery,
                                    SearchQuery, SegmentMetadataQuery, SelectQuery,
@@ -140,6 +141,22 @@ def _vectorized_postaggs(postaggs, value_arrays: Dict[str, np.ndarray]):
     return out
 
 
+def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg):
+    """Produce (partials, dim_values): ONE sharded device program when a mesh
+    is active and the segments agree on plan constants, else the per-segment
+    path merged host-side."""
+    merged = distributed.try_sharded(segs, intervals, query.granularity,
+                                     kds_per_seg, query.aggregations,
+                                     query.filter, query.virtual_columns)
+    if merged is not None:
+        return [merged], [vals_per_seg[0]]
+    partials = [run_grouped_aggregate(
+        s, intervals, query.granularity, kds, query.aggregations,
+        query.filter, virtual_columns=query.virtual_columns)
+        for s, kds in zip(segs, kds_per_seg)]
+    return partials, list(vals_per_seg)
+
+
 # ---------------------------------------------------------------------------
 # Timeseries
 # ---------------------------------------------------------------------------
@@ -151,10 +168,8 @@ def run_timeseries(query: TimeseriesQuery, segments: Sequence[Segment]) -> List[
     if not segs or len(starts) == 0:
         return []
 
-    partials = [run_grouped_aggregate(s, intervals, query.granularity, [],
-                                      query.aggregations, query.filter,
-                                      virtual_columns=query.virtual_columns)
-                for s in segs]
+    partials, _ = _make_partials(segs, intervals, query,
+                                 [[] for _ in segs], [[] for _ in segs])
     buckets, _, counts, states, kernels = merge_partials(
         partials, [[] for _ in partials])
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
@@ -203,14 +218,10 @@ def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
     if not segs or len(starts) == 0:
         return []
 
-    partials = []
-    dim_values = []
-    for s in segs:
-        kd, values = _keydim_for(s, query.dimension)
-        partials.append(run_grouped_aggregate(
-            s, intervals, query.granularity, [kd], query.aggregations,
-            query.filter, virtual_columns=query.virtual_columns))
-        dim_values.append([values])
+    keydims = [_keydim_for(s, query.dimension) for s in segs]
+    partials, dim_values = _make_partials(
+        segs, intervals, query, [[kd] for kd, _ in keydims],
+        [[values] for _, values in keydims])
 
     buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
@@ -266,18 +277,18 @@ def run_groupby(query: GroupByQuery, segments: Sequence[Segment]) -> List[dict]:
     if not segs or len(starts) == 0:
         return []
 
-    partials = []
-    dim_values = []
+    per_seg = []
     for s in segs:
         kds, vals = [], []
         for d in query.dimensions:
             kd, v = _keydim_for(s, d)
             kds.append(kd)
             vals.append(v)
-        partials.append(run_grouped_aggregate(
-            s, intervals, query.granularity, kds, query.aggregations,
-            query.filter, virtual_columns=query.virtual_columns))
-        dim_values.append(vals)
+        per_seg.append((kds, vals))
+
+    partials, dim_values = _make_partials(
+        segs, intervals, query, [kds for kds, _ in per_seg],
+        [vals for _, vals in per_seg])
 
     buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}

@@ -26,8 +26,13 @@ from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery, Query,
 class QueryExecutor:
     """Runs queries over an in-process set of segments, grouped by datasource."""
 
-    def __init__(self, segments: Optional[Sequence[Segment]] = None):
+    def __init__(self, segments: Optional[Sequence[Segment]] = None,
+                 mesh=None):
+        """`mesh`: optional jax.sharding.Mesh — when set, eligible grouped
+        aggregations run as one sharded device program over it (the
+        processing-pool analog, DruidProcessingModule.java:115)."""
         self._by_ds: Dict[str, List[Segment]] = {}
+        self.mesh = mesh
         for s in segments or ():
             self.add_segment(s)
 
@@ -54,6 +59,13 @@ class QueryExecutor:
     def run(self, query: Query, segments: Optional[Sequence[Segment]] = None):
         segs = list(segments) if segments is not None \
             else self._by_ds.get(query.datasource, [])
+        if self.mesh is not None:
+            from druid_tpu.parallel import use_mesh
+            with use_mesh(self.mesh):
+                return self._dispatch(query, segs)
+        return self._dispatch(query, segs)
+
+    def _dispatch(self, query: Query, segs: List[Segment]):
         if isinstance(query, TimeseriesQuery):
             return engines.run_timeseries(query, segs)
         if isinstance(query, TopNQuery):

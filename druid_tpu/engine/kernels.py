@@ -46,6 +46,11 @@ def _seg_max(values, keys, num):
 class AggKernel:
     """One aggregator's device update + host combine/finalize."""
 
+    #: how partial states merge across segments/devices inside a traced
+    #: program: "sum" (psum), "min"/"max" (pmin/pmax), or "fold" (all_gather
+    #: + pairwise device_combine). The device analog of host `combine`.
+    reduce_kind = "fold"
+
     def __init__(self, spec: A.AggregatorSpec):
         self.spec = spec
         self.name = spec.name
@@ -64,6 +69,21 @@ class AggKernel:
         """Convert device state to host combine-ready state."""
         return np.asarray(state)
 
+    def device_post(self, state, time0):
+        """Traced: make a per-segment state segment-origin independent
+        (e.g. relative→absolute time) so states combine on device across
+        segments with different time origins."""
+        return state
+
+    def host_from_device(self, state):
+        """Convert a device_post-ed, device-combined state to the host
+        combine-ready form (same shape host_post produces)."""
+        return np.asarray(state)
+
+    def device_combine(self, a, b):
+        """Traced pairwise state combine (for reduce_kind == "fold")."""
+        raise NotImplementedError
+
     def combine(self, a, b):
         raise NotImplementedError
 
@@ -80,6 +100,8 @@ class AggKernel:
 
 
 class CountKernel(AggKernel):
+    reduce_kind = "sum"
+
     def signature(self):
         return "count"
 
@@ -98,6 +120,7 @@ class CountKernel(AggKernel):
 
 
 class SumKernel(AggKernel):
+    reduce_kind = "sum"
     _DTYPES = {ValueType.LONG: "int64", ValueType.FLOAT: "float32",
                ValueType.DOUBLE: "float64"}
 
@@ -130,6 +153,7 @@ class MinMaxKernel(AggKernel):
         super().__init__(spec)
         self.vtype = vtype
         self.is_max = is_max
+        self.reduce_kind = "max" if is_max else "min"
 
     def signature(self):
         return f"{'max' if self.is_max else 'min'}({self.spec.field},{self.vtype.value})"
@@ -207,6 +231,27 @@ class FirstLastKernel(AggKernel):
         t_abs = np.where(has, t_abs, ident)
         return {"time": t_abs, "value": np.asarray(v), "has": has}
 
+    def device_post(self, state, time0):
+        import jax.numpy as jnp
+        t, v, has = state
+        ident = INT64_MIN if self.is_last else INT64_MAX
+        t_abs = jnp.where(has, t.astype(jnp.int64) + time0, jnp.int64(ident))
+        return (t_abs, v, has)
+
+    def device_combine(self, a, b):
+        import jax.numpy as jnp
+        at, av, ah = a
+        bt, bv, bh = b
+        if self.is_last:
+            take_b = (bt > at) | (~ah & bh)
+        else:
+            take_b = (bt < at) | (~ah & bh)
+        return (jnp.where(take_b, bt, at), jnp.where(take_b, bv, av), ah | bh)
+
+    def host_from_device(self, state):
+        t, v, has = (np.asarray(s) for s in state)
+        return {"time": t, "value": v, "has": has}
+
     def combine(self, a, b):
         if self.is_last:
             take_b = (b["time"] > a["time"]) | (~a["has"] & b["has"])
@@ -239,6 +284,7 @@ class FilteredKernel(AggKernel):
         super().__init__(spec)
         self.child = child
         self.filter_node = filter_node
+        self.reduce_kind = child.reduce_kind
 
     def signature(self):
         return f"filtered({self.filter_node.signature()},{self.child.signature()})"
@@ -253,6 +299,15 @@ class FilteredKernel(AggKernel):
     def host_post(self, state, segment):
         return self.child.host_post(state, segment)
 
+    def device_post(self, state, time0):
+        return self.child.device_post(state, time0)
+
+    def device_combine(self, a, b):
+        return self.child.device_combine(a, b)
+
+    def host_from_device(self, state):
+        return self.child.host_from_device(state)
+
     def combine(self, a, b):
         return self.child.combine(a, b)
 
@@ -266,6 +321,8 @@ class FilteredKernel(AggKernel):
 class HllKernel(AggKernel):
     """cardinality / hyperUnique via scatter-max register updates
     (see druid_tpu/engine/hll.py)."""
+
+    reduce_kind = "max"  # register merge = elementwise max (HLL fold)
 
     def __init__(self, spec, fields: Sequence[str], segment: Segment,
                  log2m: int, by_row: bool):

@@ -1,0 +1,14 @@
+"""Multi-chip execution: device meshes, sharded scatter-gather, collectives.
+
+Reference analog: Druid's distribution layer — the broker scatter-gather
+(client/CachingClusteredClient.java:253) + per-node parallel merge
+(ChainedExecutionQueryRunner.java) + parallel combine
+(epinephelinae/ParallelCombiner.java). TPU-first inversion: segments shard
+over a jax.sharding.Mesh axis; per-segment partial aggregation states live in
+HBM and merge with XLA collectives (psum/pmin/pmax/all_gather) over ICI
+instead of shipping intermediate bytes over HTTP.
+"""
+from druid_tpu.parallel.context import (get_mesh, make_mesh, set_mesh,
+                                        use_mesh)
+
+__all__ = ["get_mesh", "make_mesh", "set_mesh", "use_mesh"]

@@ -1,0 +1,48 @@
+"""Active device-mesh context for query execution.
+
+The executor (and tests / the driver's multi-chip dry run) install a
+`jax.sharding.Mesh` here; the engines then route eligible grouped
+aggregations through the sharded path (druid_tpu/parallel/distributed.py)
+instead of per-segment host-merged execution.
+
+Reference analog: DruidProcessingConfig.java:30-72 selecting the processing
+pool the per-segment runners execute on — here the "pool" is a device mesh.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+SEGMENT_AXIS = "seg"
+
+_state = threading.local()
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = SEGMENT_AXIS):
+    """1-D mesh over the first `n_devices` local devices (all by default)."""
+    import jax
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def set_mesh(mesh) -> None:
+    _state.mesh = mesh
+
+
+def get_mesh():
+    return getattr(_state, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh):
+    prev = get_mesh()
+    _state.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _state.mesh = prev

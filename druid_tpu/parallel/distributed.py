@@ -1,0 +1,416 @@
+"""Sharded multi-segment execution: one device program for a whole query.
+
+Reference analog, inverted for TPU:
+  * ChainedExecutionQueryRunner.java (thread-pool per-segment runners) →
+    segments stacked on a leading axis, `jax.vmap` over it;
+  * CachingClusteredClient.java:253 scatter-gather + MergeSequence →
+    `shard_map` over a mesh axis, partial states merged with
+    psum/pmin/pmax/all_gather collectives over ICI;
+  * epinephelinae/ParallelCombiner.java combining tree → the XLA collective
+    is the combining tree.
+
+Eligibility (else callers fall back to per-segment host-merged execution):
+dense key mode, "all"/"uniform" bucketing, and identical plan constants
+(filter LUTs, kernel aux, dim remaps) across segments — true whenever
+segments share dictionaries, which the ingestion path guarantees per
+datasource generation (the analog of DimensionMergerV9's unified dictionary).
+"""
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from druid_tpu.data.segment import Segment
+from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
+from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
+                                       make_group_spec, pad_pow2)
+from druid_tpu.engine.kernels import AggKernel, make_kernel
+from druid_tpu.parallel import context
+from druid_tpu.query.aggregators import AggregatorSpec
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval
+
+_FN_CACHE: Dict[Tuple, object] = {}
+
+# Stacked device blocks pin whole segment sets in HBM — bound the cache (LRU)
+# so dropped segment generations / varying column subsets free their memory.
+_STACK_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict()
+_STACK_CACHE_CAP = 4
+
+
+def _aux_equal(a: List[np.ndarray], b: List[np.ndarray]) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype != y.dtype or x.shape != y.shape or not np.array_equal(x, y):
+            return False
+    return True
+
+
+def _keydims_equal(a: Sequence[KeyDim], b: Sequence[KeyDim]) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x.column != y.column or x.cardinality != y.cardinality:
+            return False
+        if (x.remap is None) != (y.remap is None):
+            return False
+        if x.remap is not None and not np.array_equal(x.remap, y.remap):
+            return False
+    return True
+
+
+def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
+                granularity: Granularity,
+                kds_per_seg: Sequence[Sequence[KeyDim]],
+                aggs: Sequence[AggregatorSpec], flt,
+                virtual_columns: Sequence = ()) -> Optional[SegmentPartial]:
+    """Run the grouped aggregate for all segments as ONE sharded device
+    program; returns a single merged SegmentPartial, or None if ineligible
+    (caller falls back to the per-segment path)."""
+    mesh = context.get_mesh()
+    if mesh is None or not segments:
+        return None
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+
+    kds = list(kds_per_seg[0])
+    for other in kds_per_seg[1:]:
+        if not _keydims_equal(kds, other):
+            return None
+    # raw (remap-free) key dims fuse dictionary ids directly, so the
+    # dictionaries themselves must agree across segments — equal cardinality
+    # is NOT enough (ids would decode through segments[0]'s values)
+    for d in kds:
+        if d.column is None:
+            continue
+        first = segments[0].dims[d.column].dictionary
+        for s in segments[1:]:
+            other = s.dims.get(d.column)
+            if other is None:
+                return None
+            if other.dictionary is not first and \
+                    list(other.dictionary.values) != list(first.values):
+                return None
+
+    spec0 = make_group_spec(segments[0], intervals, granularity, kds)
+    if spec0.key_mode != "dense" or spec0.bucket_mode not in ("all", "uniform"):
+        return None
+
+    # plan filter + kernels per segment; constants must agree across segments
+    filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns))
+    kernels = [make_kernel(a, segments[0]) for a in aggs]
+    f_sig = filter_node.signature() if filter_node else "none"
+    f_aux = filter_node.aux_arrays() if filter_node else []
+    k_aux = [a for k in kernels for a in k.aux_arrays()]
+    for s in segments[1:]:
+        fn_s = simplify_node(plan_filter(flt, s, virtual_columns))
+        if (fn_s.signature() if fn_s else "none") != f_sig:
+            return None
+        if not _aux_equal(fn_s.aux_arrays() if fn_s else [], f_aux):
+            return None
+        ks = [make_kernel(a, s) for a in aggs]
+        if [k.signature() for k in ks] != [k.signature() for k in kernels]:
+            return None
+        if not _aux_equal([a for k in ks for a in k.aux_arrays()], k_aux):
+            return None
+    # only after every segment agreed on the plan is a const-false filter a
+    # whole-query zero (a column may exist in some segments only)
+    if isinstance(filter_node, ConstNode) and not filter_node.value:
+        return SegmentPartial(
+            segment=segments[0], spec=spec0,
+            counts=np.zeros(spec0.num_total, dtype=np.int64),
+            states={k.name: k.empty_state(spec0.num_total) for k in kernels},
+            kernels=kernels)
+
+    columns = _needed_columns(segments[0], kds, aggs, flt, virtual_columns)
+    stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
+
+    aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity)
+
+    sig = _sharded_sig(mesh, axis, spec0, kds, filter_node, kernels,
+                       len(intervals), virtual_columns, K, R)
+    fn = _FN_CACHE.get(sig)
+    if fn is None:
+        fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
+                               kernels, virtual_columns)
+        _FN_CACHE[sig] = fn
+    counts, states = fn(stacked, time0s, aux)
+
+    host_states = {k.name: k.host_from_device(st)
+                   for k, st in zip(kernels, states)}
+    return SegmentPartial(segment=segments[0], spec=spec0,
+                          counts=np.asarray(counts, dtype=np.int64),
+                          states=host_states, kernels=kernels)
+
+
+def _needed_columns(segment: Segment, kds: Sequence[KeyDim],
+                    aggs: Sequence[AggregatorSpec], flt,
+                    virtual_columns: Sequence) -> Tuple[str, ...]:
+    from druid_tpu.utils.expression import parse_expression
+    vc_names = {v.name for v in virtual_columns}
+    needed = set()
+    for d in kds:
+        if d.column is not None:
+            needed.add(d.column)
+    if flt is not None:
+        needed |= flt.required_columns()
+    for a in aggs:
+        needed |= a.required_columns()
+    for v in virtual_columns:
+        needed |= parse_expression(v.expression).required_columns()
+    needed -= vc_names
+    return tuple(sorted(c for c in needed
+                        if c in segment.dims or c in segment.metrics))
+
+
+def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
+                    columns: Tuple[str, ...]):
+    """Host-stack segments into [K, R] arrays sharded over the mesh axis.
+
+    K pads to a multiple of the axis size with empty (all-invalid) segments;
+    R pads rows to the max padded row count. Cached per (segment set,
+    columns, mesh) — repeat queries reuse HBM-resident shards, the analog of
+    the reference keeping segments mmapped across queries."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = mesh.shape[axis]
+    key = (tuple(str(s.id) for s in segments), columns, n_dev,
+           tuple(d.id for d in mesh.devices.flat))
+    cached = _STACK_CACHE.get(key)
+    if cached is not None:
+        _STACK_CACHE.move_to_end(key)
+        return cached
+
+    align = 1024
+    R = max(align, max(((s.n_rows + align - 1) // align) * align
+                       for s in segments))
+    K = ((len(segments) + n_dev - 1) // n_dev) * n_dev
+
+    def col_array(s: Segment, name: str) -> Tuple[np.ndarray, object]:
+        if name in s.dims:
+            return s.dims[name].ids, np.int32(0)
+        m = s.metrics[name]
+        return m.values, m.values.dtype.type(0)
+
+    arrays: Dict[str, np.ndarray] = {}
+    names = ("__time_offset", "__valid") + columns
+    for name in names:
+        if name == "__time_offset":
+            dt, fill = np.int32, 0
+        elif name == "__valid":
+            dt, fill = bool, False
+        else:
+            a0, fill = col_array(segments[0], name)
+            dt = a0.dtype
+        out = np.full((K, R), fill, dtype=dt)
+        for i, s in enumerate(segments):
+            if name == "__time_offset":
+                off = s.time_ms - s.interval.start
+                if off.size and (off.min() < 0 or off.max() >= 2**31):
+                    raise ValueError(f"segment {s.id} outside int32 offset range")
+                out[i, : s.n_rows] = off.astype(np.int32)
+            elif name == "__valid":
+                out[i, : s.n_rows] = True
+            else:
+                a, _ = col_array(s, name)
+                out[i, : a.shape[0]] = a
+        arrays[name] = out
+
+    time0s = np.zeros((K,), dtype=np.int64)
+    for i, s in enumerate(segments):
+        time0s[i] = s.interval.start
+
+    shard = NamedSharding(mesh, P(axis, None))
+    shard1 = NamedSharding(mesh, P(axis))
+    dev_arrays = {k: jax.device_put(v, shard) for k, v in arrays.items()}
+    dev_time0s = jax.device_put(time0s, shard1)
+    result = (dev_arrays, dev_time0s, R, K)
+    _STACK_CACHE[key] = result
+    while len(_STACK_CACHE) > _STACK_CACHE_CAP:
+        _STACK_CACHE.popitem(last=False)
+    return result
+
+
+def _assemble_aux(spec: GroupSpec, intervals: Sequence[Interval],
+                  kds: Sequence[KeyDim], f_aux: List[np.ndarray],
+                  k_aux: List[np.ndarray], granularity: Granularity) -> Tuple:
+    # absolute-time interval bounds (per-segment relative handled on device)
+    aux: List[np.ndarray] = [np.asarray(
+        [[iv.start, iv.end] for iv in intervals], dtype=np.int64)]
+    if spec.bucket_mode == "uniform":
+        aux.append(np.asarray(int(spec.bucket_starts[0]), dtype=np.int64))
+        aux.append(np.asarray(granularity.period_ms, dtype=np.int64))
+        aux.append(np.asarray(spec.num_buckets, dtype=np.int64))
+    for d in kds:
+        if d.column is None:
+            continue
+        if d.remap is not None:
+            aux.append(d.remap.astype(np.int32))
+        aux.append(np.asarray(d.cardinality, dtype=np.int32))
+    aux.extend(f_aux)
+    aux.extend(k_aux)
+    return tuple(aux)
+
+
+def _sharded_sig(mesh, axis, spec: GroupSpec, kds, filter_node, kernels,
+                 n_intervals, virtual_columns, K, R) -> Tuple:
+    dims_sig = ",".join(
+        f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in kds)
+    vc_sig = ";".join(f"{v.name}={v.expression}:{v.output_type}"
+                      for v in virtual_columns)
+    mesh_key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    return (mesh_key, axis, spec.bucket_mode, dims_sig, n_intervals, vc_sig,
+            filter_node.signature() if filter_node else "none",
+            ";".join(k.signature() for k in kernels), spec.num_total, K, R)
+
+
+def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
+                  k_local: int):
+    """Fold per-segment states over the local axis, then across the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    kind = kernel.reduce_kind
+    # On a 1-device mesh every collective is the identity; use psum for all
+    # kinds — it satisfies the replication (vma) check and is the one
+    # collective every TPU transport lowers (some support only Sum
+    # all-reduce). Bool states must go through int for psum.
+    if n_dev == 1:
+        if kind != "sum":
+            if kind == "max":
+                st = jax.tree.map(lambda x: x.max(axis=0), stacked_state)
+            elif kind == "min":
+                st = jax.tree.map(lambda x: x.min(axis=0), stacked_state)
+            else:
+                parts = [jax.tree.map(lambda x, i=i: x[i], stacked_state)
+                         for i in range(k_local)]
+                st = functools.reduce(kernel.device_combine, parts)
+        else:
+            st = jax.tree.map(
+                lambda x: (x.astype(jnp.int64)
+                           if jnp.issubdtype(x.dtype, jnp.integer)
+                           else x).sum(axis=0), stacked_state)
+
+        def ident_psum(x):
+            if x.dtype == jnp.bool_:
+                return lax.psum(x.astype(jnp.int32), axis) > 0
+            return lax.psum(x, axis)
+        return jax.tree.map(ident_psum, st)
+    if kind == "sum":
+        def local(x):
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                x = x.astype(jnp.int64)
+            return x.sum(axis=0)
+        st = jax.tree.map(local, stacked_state)
+        return jax.tree.map(lambda x: lax.psum(x, axis), st)
+    if kind == "max":
+        st = jax.tree.map(lambda x: x.max(axis=0), stacked_state)
+        return jax.tree.map(lambda x: lax.pmax(x, axis), st)
+    if kind == "min":
+        st = jax.tree.map(lambda x: x.min(axis=0), stacked_state)
+        return jax.tree.map(lambda x: lax.pmin(x, axis), st)
+    # fold: pairwise device_combine locally, all_gather + fold across devices
+    parts = [jax.tree.map(lambda x, i=i: x[i], stacked_state)
+             for i in range(k_local)]
+    st = functools.reduce(kernel.device_combine, parts)
+    gathered = jax.tree.map(
+        lambda x: lax.all_gather(x, axis, axis=0, tiled=False), st)
+    parts = [jax.tree.map(lambda x, i=i: x[i], gathered) for i in range(n_dev)]
+    return functools.reduce(kernel.device_combine, parts)
+
+
+def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
+                      kds: Sequence[KeyDim], filter_node,
+                      kernels: List[AggKernel], virtual_columns: Sequence):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    bucket_mode = spec.bucket_mode
+    num_total = spec.num_total
+    dim_cols = tuple(d.column for d in kds)
+    has_remap = tuple(d.remap is not None for d in kds)
+    vc_exprs = tuple((v.name, v.expression, v.output_type)
+                     for v in virtual_columns)
+
+    def per_segment(arrays, time0, aux):
+        it = iter(aux)
+        t = arrays["__time_offset"]
+        mask = arrays["__valid"]
+        t_abs = t.astype(jnp.int64) + time0
+
+        if vc_exprs:
+            from druid_tpu.utils.expression import parse_expression
+            bindings = dict(arrays)
+            bindings["__time"] = t_abs
+            arrays = dict(arrays)
+            for name, expr_s, out_type in vc_exprs:
+                val = parse_expression(expr_s).evaluate(bindings)
+                dt = {"long": jnp.int64, "double": jnp.float64,
+                      "float": jnp.float32}.get(out_type, jnp.float64)
+                arrays[name] = jnp.asarray(val).astype(dt)
+                bindings[name] = arrays[name]
+
+        iv = next(it)  # int64 [k, 2] absolute bounds
+        within = (t_abs[:, None] >= iv[None, :, 0]) \
+            & (t_abs[:, None] < iv[None, :, 1])
+        mask = mask & jnp.any(within, axis=1)
+
+        if bucket_mode == "all":
+            key = jnp.zeros(t.shape, dtype=jnp.int32)
+        else:
+            start0 = next(it)
+            period = next(it)
+            nb = next(it)
+            b = (t_abs - start0) // period
+            mask = mask & (b >= 0) & (b < nb)
+            key = b.astype(jnp.int32)
+        for i in range(len(dim_cols)):
+            if dim_cols[i] is None:
+                continue
+            ids = arrays[dim_cols[i]]
+            if has_remap[i]:
+                remap = next(it)
+                ids = remap[ids]
+                mask = mask & (ids >= 0)
+            card = next(it)
+            key = key * card + jnp.maximum(ids, 0)
+
+        if filter_node is not None:
+            mask = mask & filter_node.build(arrays, it)
+
+        key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
+        counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
+                                     num_segments=num_total)
+        states = tuple(k.update(arrays, mask, key, num_total, it)
+                       for k in kernels)
+        states = tuple(k.device_post(s, time0)
+                       for k, s in zip(kernels, states))
+        return counts, states
+
+    def body(stacked, time0s, aux):
+        k_local = time0s.shape[0]
+        counts, states = jax.vmap(
+            lambda a, t0: per_segment(a, t0, aux))(stacked, time0s)
+        counts = jax.lax.psum(counts.astype(jnp.int64).sum(axis=0), axis)
+        merged = tuple(
+            _merge_states(k, st, axis, n_dev, k_local)
+            for k, st in zip(kernels, states))
+        return counts, merged
+
+    # fold-merged states go through all_gather, whose output the vma system
+    # conservatively marks varying even though it is replicated by
+    # construction — turn the static replication check off for those.
+    has_fold = any(k.reduce_kind == "fold" for k in kernels) and n_dev > 1
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P(axis, None), P(axis), P()),
+                  out_specs=(P(), P()), check_vma=not has_fold)
+    return jax.jit(f)

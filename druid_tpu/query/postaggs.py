@@ -148,12 +148,16 @@ class HyperUniqueFinalizingPostAgg(PostAggregator):
 
 def postagg_from_json(j: dict) -> PostAggregator:
     t = j["type"]
+    # "name" is optional on nested fields of arithmetic/greatest/least
+    # (reference: ArithmeticPostAggregator's field list carries unnamed
+    # fieldAccess entries in wire JSON)
     if t == "fieldAccess":
-        return FieldAccessPostAgg(j["name"], j["fieldName"])
+        return FieldAccessPostAgg(j.get("name", j["fieldName"]), j["fieldName"])
     if t == "finalizingFieldAccess":
-        return FinalizingFieldAccessPostAgg(j["name"], j["fieldName"])
+        return FinalizingFieldAccessPostAgg(j.get("name", j["fieldName"]),
+                                            j["fieldName"])
     if t == "constant":
-        return ConstantPostAgg(j["name"], j["value"])
+        return ConstantPostAgg(j.get("name", "const"), j["value"])
     if t == "arithmetic":
         return ArithmeticPostAgg(j["name"], j["fn"],
                                  tuple(postagg_from_json(f) for f in j["fields"]))

@@ -3,11 +3,19 @@ mirroring the reference's single-JVM simulated-cluster testing strategy
 (SURVEY §4: CachingClusteredClientTest-style tests without sockets)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The environment's sitecustomize may have force-registered a TPU plugin and
+# overridden jax_platforms ("axon,cpu") at interpreter startup. Backends
+# initialize lazily, so flipping the config back here (before any jax op)
+# still wins — tests always run on the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

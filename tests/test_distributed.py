@@ -1,0 +1,187 @@
+"""Sharded (shard_map + collectives) execution must be result-identical to
+the per-segment host-merged path.
+
+Reference analog: CachingClusteredClientTest.java:171 — scatter-gather over
+fake servers asserted against direct execution, no sockets. Here: an 8-way
+virtual CPU mesh (conftest) stands in for the pod.
+"""
+import numpy as np
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.parallel import make_mesh, use_mesh
+from druid_tpu.query.aggregators import (CardinalityAggregator, CountAggregator,
+                                         DoubleMaxAggregator,
+                                         DoubleSumAggregator, FilteredAggregator,
+                                         FirstAggregator, LastAggregator,
+                                         LongMinAggregator, LongSumAggregator)
+from druid_tpu.query.filters import (AndFilter, BoundFilter, InFilter,
+                                     NotFilter, SelectorFilter)
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   TimeseriesQuery, TopNQuery)
+from tests.conftest import WEEK
+
+AGGS = [
+    CountAggregator("rows"),
+    LongSumAggregator("lsum", "metLong"),
+    DoubleSumAggregator("dsum", "metDouble"),
+    LongMinAggregator("lmin", "metLong"),
+    DoubleMaxAggregator("dmax", "metFloat"),
+]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _run_both(query, segments, mesh):
+    plain = QueryExecutor(segments).run(query)
+    with use_mesh(mesh):
+        sharded = QueryExecutor(segments).run(query)
+    return plain, sharded
+
+
+def _value_close(a, b):
+    if isinstance(a, float) or isinstance(b, float):
+        return abs(float(a) - float(b)) <= 1e-6 * (1 + abs(float(a)))
+    return a == b
+
+
+def _assert_rows_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra.keys() == rb.keys()
+        for k in ra:
+            va, vb = ra[k], rb[k]
+            if isinstance(va, dict):
+                assert va.keys() == vb.keys()
+                for f in va:
+                    assert _value_close(va[f], vb[f]), (k, f, va[f], vb[f])
+            elif isinstance(va, list):
+                assert len(va) == len(vb), k
+                for ea, eb in zip(va, vb):
+                    assert ea.keys() == eb.keys()
+                    for f in ea:
+                        assert _value_close(ea[f], eb[f]), (k, f, ea[f], eb[f])
+            else:
+                assert va == vb, (k, va, vb)
+
+
+def test_timeseries_sharded_matches(segments, mesh):
+    q = TimeseriesQuery.of("test", [WEEK], AGGS, granularity="day",
+                           filter=BoundFilter("metLong", lower=10, upper=80,
+                                              ordering="numeric"))
+    plain, sharded = _run_both(q, segments, mesh)
+    _assert_rows_equal(plain, sharded)
+
+
+def test_timeseries_first_last_sharded(segments, mesh):
+    q = TimeseriesQuery.of(
+        "test", [WEEK],
+        [FirstAggregator("f", "metLong", "long"),
+         LastAggregator("l", "metDouble", "double")],
+        granularity="day")
+    plain, sharded = _run_both(q, segments, mesh)
+    _assert_rows_equal(plain, sharded)
+
+
+def test_timeseries_hll_sharded(segments, mesh):
+    q = TimeseriesQuery.of(
+        "test", [WEEK],
+        [CardinalityAggregator("card", ["dimHi"]), CountAggregator("rows")],
+        granularity="all")
+    plain, sharded = _run_both(q, segments, mesh)
+    _assert_rows_equal(plain, sharded)
+
+
+def test_topn_sharded_matches(segments, mesh):
+    q = TopNQuery.of("test", [WEEK], "dimB", "lsum", 10, AGGS,
+                     granularity="all",
+                     filter=InFilter("dimA", ["v0", "v1", "v2", "v3"]))
+    plain, sharded = _run_both(q, segments, mesh)
+    _assert_rows_equal(plain, sharded)
+
+
+def test_groupby_sharded_matches(segments, mesh):
+    q = GroupByQuery.of(
+        "test", [WEEK],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        AGGS + [FilteredAggregator("fsum",
+                                   LongSumAggregator("fsum", "metLong"),
+                                   SelectorFilter("dimA", "v1"))],
+        granularity="day",
+        filter=AndFilter([NotFilter(SelectorFilter("dimA", "v9")),
+                          BoundFilter("metLong", lower=5, ordering="numeric")]))
+    plain, sharded = _run_both(q, segments, mesh)
+    # groupBy rows are sorted by the engine's limit path; compare as sets
+    key = lambda r: (r["timestamp"], r["event"]["dimA"], r["event"]["dimB"])
+    _assert_rows_equal(sorted(plain, key=key), sorted(sharded, key=key))
+
+
+def test_groupby_uneven_segments(generator, mesh):
+    """Segment count not divisible by mesh size → padded empty shards."""
+    segs = generator.segments(5, 3_000, WEEK, datasource="uneven")
+    q = GroupByQuery.of("uneven", [WEEK], [DefaultDimensionSpec("dimA")],
+                        [CountAggregator("rows"),
+                         LongSumAggregator("lsum", "metLong")],
+                        granularity="all")
+    plain, sharded = _run_both(q, segs, mesh)
+    key = lambda r: r["event"]["dimA"]
+    _assert_rows_equal(sorted(plain, key=key), sorted(sharded, key=key))
+
+
+def test_heterogeneous_column_presence(mesh):
+    """A filter column existing in only SOME segments must not shortcut to a
+    whole-query zero (const-false plan on segment 0 only)."""
+    from druid_tpu.data.segment import SegmentBuilder
+    from druid_tpu.utils.intervals import Interval
+
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    b1 = SegmentBuilder("het", iv, partition=0)
+    for i in range(100):
+        b1.add_row(iv.start + i, {"common": f"c{i % 3}"}, {"m": i})
+    b2 = SegmentBuilder("het", iv, partition=1)
+    for i in range(100):
+        b2.add_row(iv.start + i, {"common": f"c{i % 3}", "extra": f"e{i % 2}"},
+                   {"m": i})
+    segs = [b1.build(), b2.build()]
+    q = TimeseriesQuery.of("het", [iv],
+                           [CountAggregator("rows"),
+                            LongSumAggregator("ms", "m")],
+                           granularity="all",
+                           filter=SelectorFilter("extra", "e0"))
+    plain, sharded = _run_both(q, segs, mesh)
+    assert plain[0]["result"]["rows"] == 50
+    _assert_rows_equal(plain, sharded)
+
+
+def test_differing_dictionaries_fall_back(mesh):
+    """Equal-cardinality but different dictionaries must NOT fuse ids in the
+    sharded path — values would decode through the wrong dictionary."""
+    from druid_tpu.data.segment import SegmentBuilder
+    from druid_tpu.utils.intervals import Interval
+
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    b1 = SegmentBuilder("dicts", iv, partition=0)
+    for i, v in enumerate(["apple", "berry"] * 4):
+        b1.add_row(iv.start + i, {"d": v}, {"m": 1})
+    b2 = SegmentBuilder("dicts", iv, partition=1)
+    for i, v in enumerate(["cherry", "date"] * 4):
+        b2.add_row(iv.start + i, {"d": v}, {"m": 1})
+    segs = [b1.build(), b2.build()]
+    q = GroupByQuery.of("dicts", [iv], [DefaultDimensionSpec("d")],
+                        [CountAggregator("rows")], granularity="all")
+    plain, sharded = _run_both(q, segs, mesh)
+    key = lambda r: r["event"]["d"]
+    plain, sharded = sorted(plain, key=key), sorted(sharded, key=key)
+    assert [r["event"]["d"] for r in plain] == ["apple", "berry", "cherry",
+                                               "date"]
+    _assert_rows_equal(plain, sharded)
+
+
+def test_executor_mesh_arg(segments, mesh):
+    q = TimeseriesQuery.of("test", [WEEK], AGGS, granularity="hour")
+    plain = QueryExecutor(segments).run(q)
+    sharded = QueryExecutor(segments, mesh=mesh).run(q)
+    _assert_rows_equal(plain, sharded)
