@@ -191,6 +191,61 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
 _JIT_CACHE: Dict[str, object] = {}
 
 
+def eval_virtual_columns(arrays: Dict, t_abs, vc_exprs) -> Dict:
+    """Traced: evaluate expression virtual columns over staged columns
+    (reference: ExpressionVirtualColumn) into fused XLA elementwise ops.
+    Shared by the per-segment and sharded program builders."""
+    import jax.numpy as jnp
+    from druid_tpu.utils.expression import parse_expression
+
+    bindings = dict(arrays)
+    bindings["__time"] = t_abs
+    arrays = dict(arrays)
+    for name, expr_s, out_type in vc_exprs:
+        val = parse_expression(expr_s).evaluate(bindings)
+        dt = {"long": jnp.int64, "double": jnp.float64,
+              "float": jnp.float32}.get(out_type, jnp.float64)
+        arrays[name] = jnp.asarray(val).astype(dt)
+        bindings[name] = arrays[name]
+    return arrays
+
+
+def fuse_filter_update(arrays: Dict, mask, key, it,
+                       dim_cols: Tuple, has_remap: Tuple,
+                       filter_node: Optional[FilterNode],
+                       kernels: Sequence[AggKernel], num_total: int):
+    """Traced: the shared tail of the grouped-aggregate program — fuse dim
+    ids into the key (through optional remap tables), apply the filter mask,
+    and run every kernel's segmented reduction. Both the per-segment
+    (_build_device_fn) and sharded (parallel/distributed.py) builders call
+    this, so keying/update semantics cannot diverge between paths."""
+    import jax
+    import jax.numpy as jnp
+
+    for i in range(len(dim_cols)):
+        if dim_cols[i] is None:
+            continue
+        ids = arrays[dim_cols[i]]
+        if has_remap[i]:
+            remap = next(it)
+            ids = remap[ids]
+            mask = mask & (ids >= 0)
+        card = next(it)
+        key = key * card + jnp.maximum(ids, 0)
+
+    if filter_node is not None:
+        mask = mask & filter_node.build(arrays, it)
+
+    key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
+    counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
+                                 num_segments=num_total)
+    # positional states: the jit cache is shared across queries whose
+    # aggregators differ only by output name
+    states = tuple(k.update(arrays, mask, key, num_total, it)
+                   for k in kernels)
+    return counts, states
+
+
 def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
                    virtual_columns) -> str:
     dims_sig = ",".join(
@@ -232,20 +287,10 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
-        # expression virtual columns (reference: ExpressionVirtualColumn) —
-        # traced to fused XLA elementwise ops over the staged columns
         if vc_exprs:
-            from druid_tpu.utils.expression import parse_expression
             time0 = next(it)
-            bindings = dict(arrays)
-            bindings["__time"] = t.astype(jnp.int64) + time0
-            arrays = dict(arrays)
-            for name, expr_s, out_type in vc_exprs:
-                val = parse_expression(expr_s).evaluate(bindings)
-                dt = {"long": jnp.int64, "double": jnp.float64,
-                      "float": jnp.float32}.get(out_type, jnp.float64)
-                arrays[name] = jnp.asarray(val).astype(dt)
-                bindings[name] = arrays[name]
+            arrays = eval_virtual_columns(arrays, t.astype(jnp.int64) + time0,
+                                          vc_exprs)
 
         # time-in-intervals
         iv = next(it)  # int32 [k, 2]
@@ -256,6 +301,8 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
         if key_mode == "host":
             key = arrays["__key"]
             mask = mask & (key >= 0)
+            dims_for_key = ()
+            remaps_for_key = ()
         else:
             if bucket_mode == "all":
                 key = jnp.zeros(t.shape, dtype=jnp.int32)
@@ -269,28 +316,12 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
             else:
                 key = arrays["__bucket"]
                 mask = mask & (key >= 0)
-            for i in range(n_dims):
-                if dim_cols[i] is None:
-                    continue
-                ids = arrays[dim_cols[i]]
-                if has_remap[i]:
-                    remap = next(it)
-                    ids = remap[ids]
-                    mask = mask & (ids >= 0)
-                card = next(it)
-                key = key * card + jnp.maximum(ids, 0)
+            dims_for_key = dim_cols
+            remaps_for_key = has_remap
 
-        if filter_node is not None:
-            mask = mask & filter_node.build(arrays, it)
-
-        key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
-        counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
-                                     num_segments=num_total)
-        # positional states: the jit cache is shared across queries whose
-        # aggregators differ only by output name
-        states = tuple(k.update(arrays, mask, key, num_total, it)
-                       for k in kernels)
-        return counts, states
+        return fuse_filter_update(arrays, mask, key, it, dims_for_key,
+                                  remaps_for_key, filter_node, kernels,
+                                  num_total)
 
     return jax.jit(fn)
 

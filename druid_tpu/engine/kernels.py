@@ -330,7 +330,6 @@ class HllKernel(AggKernel):
         self.fields = tuple(fields)
         self.log2m = log2m
         self.by_row = by_row
-        self.segment = segment
         self._tables = []
         for f in self.fields:
             col = segment.dims.get(f)
@@ -350,7 +349,9 @@ class HllKernel(AggKernel):
                 self._tables.append(("missing", f, None))
 
     def signature(self):
-        kinds = ",".join(k for k, f, _ in self._tables)
+        # field names must be part of the signature: the jit caches are keyed
+        # by it, and the traced closure reads cols[field]
+        kinds = ",".join(f"{k}:{f}" for k, f, _ in self._tables)
         return f"hll({self.log2m},{self.by_row},{kinds})"
 
     def aux_arrays(self):

@@ -26,14 +26,19 @@ import numpy as np
 from druid_tpu.data.segment import Segment
 from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
-                                       make_group_spec, pad_pow2)
+                                       eval_virtual_columns,
+                                       fuse_filter_update, make_group_spec)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
 from druid_tpu.utils.granularity import Granularity
 from druid_tpu.utils.intervals import Interval
 
-_FN_CACHE: Dict[Tuple, object] = {}
+# Jitted sharded programs, LRU-bounded: entries capture kernel aux arrays in
+# their closures, so an unbounded cache would pin host memory across segment
+# generations.
+_FN_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict()
+_FN_CACHE_CAP = 64
 
 # Stacked device blocks pin whole segment sets in HBM — bound the cache (LRU)
 # so dropped segment generations / varying column subsets free their memory.
@@ -127,7 +132,17 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             states={k.name: k.empty_state(spec0.num_total) for k in kernels},
             kernels=kernels)
 
-    columns = _needed_columns(segments[0], kds, aggs, flt, virtual_columns)
+    # every needed column must have the same presence + kind in all segments:
+    # the plain path handles per-segment missing columns (aggregate-as-zero),
+    # but one stacked program cannot — fall back rather than KeyError/diverge
+    needed, columns = _needed_columns(segments[0], kds, aggs, flt,
+                                      virtual_columns)
+    for c in needed:
+        in_dim0 = c in segments[0].dims
+        in_met0 = c in segments[0].metrics
+        for s in segments[1:]:
+            if (c in s.dims) != in_dim0 or (c in s.metrics) != in_met0:
+                return None
     stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
 
     aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity)
@@ -139,6 +154,10 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
                                kernels, virtual_columns)
         _FN_CACHE[sig] = fn
+        while len(_FN_CACHE) > _FN_CACHE_CAP:
+            _FN_CACHE.popitem(last=False)
+    else:
+        _FN_CACHE.move_to_end(sig)
     counts, states = fn(stacked, time0s, aux)
 
     host_states = {k.name: k.host_from_device(st)
@@ -150,7 +169,9 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
 
 def _needed_columns(segment: Segment, kds: Sequence[KeyDim],
                     aggs: Sequence[AggregatorSpec], flt,
-                    virtual_columns: Sequence) -> Tuple[str, ...]:
+                    virtual_columns: Sequence):
+    """Returns (all referenced real-column names, the subset present in
+    `segment` — i.e. the columns to stage)."""
     from druid_tpu.utils.expression import parse_expression
     vc_names = {v.name for v in virtual_columns}
     needed = set()
@@ -164,8 +185,10 @@ def _needed_columns(segment: Segment, kds: Sequence[KeyDim],
     for v in virtual_columns:
         needed |= parse_expression(v.expression).required_columns()
     needed -= vc_names
-    return tuple(sorted(c for c in needed
-                        if c in segment.dims or c in segment.metrics))
+    needed -= {"__time", "__time_offset", "__valid"}
+    present = tuple(sorted(c for c in needed
+                           if c in segment.dims or c in segment.metrics))
+    return needed, present
 
 
 def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
@@ -180,12 +203,16 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_dev = mesh.shape[axis]
-    key = (tuple(str(s.id) for s in segments), columns, n_dev,
+    # keyed by object identity, not segment-id strings: rebuilt segments can
+    # legitimately reuse (datasource, interval, version, partition) and must
+    # not be served stale stacked data. The cached value pins the segment
+    # objects, so their id()s cannot be recycled while the entry lives.
+    key = (tuple(id(s) for s in segments), columns, n_dev,
            tuple(d.id for d in mesh.devices.flat))
     cached = _STACK_CACHE.get(key)
     if cached is not None:
         _STACK_CACHE.move_to_end(key)
-        return cached
+        return cached[:4]
 
     align = 1024
     R = max(align, max(((s.n_rows + align - 1) // align) * align
@@ -231,7 +258,7 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     dev_arrays = {k: jax.device_put(v, shard) for k, v in arrays.items()}
     dev_time0s = jax.device_put(time0s, shard1)
     result = (dev_arrays, dev_time0s, R, K)
-    _STACK_CACHE[key] = result
+    _STACK_CACHE[key] = result + (tuple(segments),)
     while len(_STACK_CACHE) > _STACK_CACHE_CAP:
         _STACK_CACHE.popitem(last=False)
     return result
@@ -348,16 +375,7 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
         t_abs = t.astype(jnp.int64) + time0
 
         if vc_exprs:
-            from druid_tpu.utils.expression import parse_expression
-            bindings = dict(arrays)
-            bindings["__time"] = t_abs
-            arrays = dict(arrays)
-            for name, expr_s, out_type in vc_exprs:
-                val = parse_expression(expr_s).evaluate(bindings)
-                dt = {"long": jnp.int64, "double": jnp.float64,
-                      "float": jnp.float32}.get(out_type, jnp.float64)
-                arrays[name] = jnp.asarray(val).astype(dt)
-                bindings[name] = arrays[name]
+            arrays = eval_virtual_columns(arrays, t_abs, vc_exprs)
 
         iv = next(it)  # int64 [k, 2] absolute bounds
         within = (t_abs[:, None] >= iv[None, :, 0]) \
@@ -373,25 +391,10 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
             b = (t_abs - start0) // period
             mask = mask & (b >= 0) & (b < nb)
             key = b.astype(jnp.int32)
-        for i in range(len(dim_cols)):
-            if dim_cols[i] is None:
-                continue
-            ids = arrays[dim_cols[i]]
-            if has_remap[i]:
-                remap = next(it)
-                ids = remap[ids]
-                mask = mask & (ids >= 0)
-            card = next(it)
-            key = key * card + jnp.maximum(ids, 0)
 
-        if filter_node is not None:
-            mask = mask & filter_node.build(arrays, it)
-
-        key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
-        counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
-                                     num_segments=num_total)
-        states = tuple(k.update(arrays, mask, key, num_total, it)
-                       for k in kernels)
+        counts, states = fuse_filter_update(arrays, mask, key, it, dim_cols,
+                                            has_remap, filter_node, kernels,
+                                            num_total)
         states = tuple(k.device_post(s, time0)
                        for k, s in zip(kernels, states))
         return counts, states

@@ -1,0 +1,11 @@
+from druid_tpu.storage.codec import (compress_array, decompress_array,
+                                     default_codec, LZ4, NONE, ZLIB)
+from druid_tpu.storage.format import (load_segment, persist_segment,
+                                      read_segment_meta)
+from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
+
+__all__ = [
+    "compress_array", "decompress_array", "default_codec", "LZ4", "NONE",
+    "ZLIB", "load_segment", "persist_segment", "read_segment_meta",
+    "FileSmoosher", "SmooshedFileMapper",
+]

@@ -1,0 +1,198 @@
+"""On-disk segment format (V1): columnar parts in a smoosh container.
+
+Capability parity with the reference's V9 segment format
+(processing/.../segment/IndexIO.java:86-116 — version.bin, meta.smoosh,
+index.drd, per-column ColumnDescriptor parts;
+segment/serde/DictionaryEncodedColumnPartSerde.java:57). TPU-first layout
+decisions:
+  * every physical column part is a dense block-compressed array (native LZ4)
+    that decodes straight into the numpy array device staging expects —
+    no per-row varint decoding on the critical path;
+  * string dims store (sorted dictionary blob, int32 id column, per-value
+    bitmap index), exactly the planning structures the host filter planner
+    uses; the device never sees strings;
+  * bitmaps load lazily (the reference mmaps them on demand too).
+
+Layout: <dir>/version.bin (u32=1), meta.smoosh + chunk files. Parts:
+  index.json                segment identity + schema + row count
+  __time                    int64 millis, block-compressed
+  dim.<name>.dict           utf8 dictionary (n, offsets[n+1], bytes)
+  dim.<name>.ids            int32 ids, block-compressed
+  dim.<name>.bitmaps        per-value packed-word bitmaps, LZ4 per value
+  met.<name>                numeric column, block-compressed
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from druid_tpu.data.bitmap import Bitmap, BitmapIndex
+from druid_tpu.data.dictionary import Dictionary
+from druid_tpu.data.segment import (NumericColumn, Segment, SegmentId,
+                                    StringDimColumn, ValueType)
+from druid_tpu.storage import codec as codecs
+from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
+from druid_tpu.utils.intervals import Interval
+
+FORMAT_VERSION = 1
+
+
+def _encode_dictionary(d: Dictionary) -> bytes:
+    blobs = [v.encode("utf-8") for v in d.values]
+    offsets = np.zeros(len(blobs) + 1, dtype=np.int32)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    return (struct.pack("<i", len(blobs)) + offsets.tobytes()
+            + b"".join(blobs))
+
+
+def _decode_dictionary(buf) -> Dictionary:
+    buf = memoryview(buf)
+    (n,) = struct.unpack_from("<i", buf, 0)
+    offsets = np.frombuffer(buf, dtype=np.int32, count=n + 1, offset=4)
+    base = 4 + (n + 1) * 4
+    blob = bytes(buf[base:base + int(offsets[-1])])
+    values = [blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+              for i in range(n)]
+    return Dictionary(values)
+
+
+def _encode_bitmap_index(index: BitmapIndex, codec: int) -> bytes:
+    parts = []
+    for vid in range(index.cardinality):
+        words = index.bitmap(vid).words
+        parts.append(codecs.compress_block(codec, words.tobytes()))
+    offsets = np.zeros(index.cardinality + 1, dtype=np.int64)
+    np.cumsum([len(p) for p in parts], out=offsets[1:])
+    return (struct.pack("<qiB", index.n_rows, index.cardinality, codec)
+            + offsets.tobytes() + b"".join(parts))
+
+
+class LazyBitmapIndex(BitmapIndex):
+    """BitmapIndex that decompresses per-value bitmaps on first access —
+    the analog of the reference mmapping bitmap parts on demand."""
+
+    def __init__(self, buf):
+        buf = memoryview(buf)
+        n_rows, cardinality, codec = struct.unpack_from("<qiB", buf, 0)
+        off = 13
+        self._offsets = np.frombuffer(buf, dtype=np.int64,
+                                      count=cardinality + 1, offset=off)
+        self._blob = buf[off + (cardinality + 1) * 8:]
+        self._codec = codec
+        self._word_bytes = (n_rows + 7) // 8
+        super().__init__(n_rows, cardinality,
+                         [None] * cardinality)  # type: ignore[list-item]
+
+    def bitmap(self, value_id: int) -> Bitmap:
+        if value_id < 0 or value_id >= self.cardinality:
+            return Bitmap.empty(self.n_rows)
+        b = self._bitmaps[value_id]
+        if b is None:
+            lo, hi = int(self._offsets[value_id]), int(self._offsets[value_id + 1])
+            words = np.frombuffer(
+                codecs.decompress_block(self._codec, self._blob[lo:hi],
+                                        self._word_bytes), dtype=np.uint8)
+            b = Bitmap(words.copy(), self.n_rows)
+            self._bitmaps[value_id] = b
+        return b
+
+    def union_of(self, value_ids: np.ndarray) -> Bitmap:
+        return Bitmap.union([self.bitmap(int(v)) for v in value_ids
+                             if 0 <= v < self.cardinality], self.n_rows)
+
+    def size_bytes(self) -> int:
+        return int(self._offsets[-1])
+
+
+def persist_segment(segment: Segment, directory: str,
+                    codec: Optional[int] = None,
+                    build_bitmaps: bool = True,
+                    chunk_size: int = 1 << 31) -> int:
+    """Write a segment to `directory`; returns total bytes written.
+
+    Reference analog: IndexMergerV9.persist
+    (processing/.../segment/IndexMergerV9.java:729)."""
+    if codec is None:
+        codec = codecs.default_codec()
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "version.bin"), "wb") as f:
+        f.write(struct.pack("<I", FORMAT_VERSION))
+
+    meta = {
+        "datasource": segment.id.datasource,
+        "interval": [segment.id.interval.start, segment.id.interval.end],
+        "version": segment.id.version,
+        "partition": segment.id.partition,
+        "n_rows": segment.n_rows,
+        "dimensions": list(segment.dims.keys()),
+        "metrics": {k: v.type.value for k, v in segment.metrics.items()},
+        "min_time": segment.min_time,
+        "max_time": segment.max_time,
+        "codec": codec,
+    }
+    with FileSmoosher(directory, chunk_size) as sm:
+        sm.add("index.json", json.dumps(meta).encode())
+        sm.add("__time", codecs.compress_array(segment.time_ms, codec))
+        for name, col in segment.dims.items():
+            sm.add(f"dim.{name}.dict", _encode_dictionary(col.dictionary))
+            sm.add(f"dim.{name}.ids", codecs.compress_array(col.ids, codec))
+            if build_bitmaps:
+                sm.add(f"dim.{name}.bitmaps",
+                       _encode_bitmap_index(col.bitmap_index(), codec))
+        for name, m in segment.metrics.items():
+            sm.add(f"met.{name}", codecs.compress_array(m.values, codec))
+    total = 0
+    for fn in os.listdir(directory):
+        total += os.path.getsize(os.path.join(directory, fn))
+    return total
+
+
+def load_segment(directory: str,
+                 columns: Optional[Sequence[str]] = None) -> Segment:
+    """mmap + decode a persisted segment. Column values decode eagerly via
+    native batch LZ4 (multi-threaded); bitmap indexes attach lazily.
+
+    Reference analog: IndexIO.loadIndex (segment/IndexIO.java:116)."""
+    with open(os.path.join(directory, "version.bin"), "rb") as f:
+        (version,) = struct.unpack("<I", f.read(4))
+    if version != FORMAT_VERSION:
+        raise ValueError(f"unknown segment format version {version}")
+    mapper = SmooshedFileMapper(directory)
+    meta = json.loads(bytes(mapper.part("index.json")))
+    seg_id = SegmentId(meta["datasource"],
+                       Interval(meta["interval"][0], meta["interval"][1]),
+                       meta["version"], meta["partition"])
+    time_ms = decompress_part(mapper, "__time")
+    dims: Dict[str, StringDimColumn] = {}
+    for name in meta["dimensions"]:
+        if columns is not None and name not in columns:
+            continue
+        d = _decode_dictionary(mapper.part(f"dim.{name}.dict"))
+        ids = decompress_part(mapper, f"dim.{name}.ids").copy()
+        col = StringDimColumn(ids, d)
+        bm_part = f"dim.{name}.bitmaps"
+        if mapper.has(bm_part):
+            col.set_bitmap_index(LazyBitmapIndex(mapper.part(bm_part)))
+        dims[name] = col
+    metrics: Dict[str, NumericColumn] = {}
+    for name, tname in meta["metrics"].items():
+        if columns is not None and name not in columns:
+            continue
+        vals = decompress_part(mapper, f"met.{name}").copy()
+        metrics[name] = NumericColumn(vals, ValueType(tname))
+    seg = Segment(seg_id, time_ms.copy(), dims, metrics, sorted_by_time=True)
+    seg._mapper = mapper  # keep mmaps alive for lazy bitmap loads
+    return seg
+
+
+def decompress_part(mapper: SmooshedFileMapper, name: str) -> np.ndarray:
+    return codecs.decompress_array(mapper.part(name))
+
+
+def read_segment_meta(directory: str) -> dict:
+    with SmooshedFileMapper(directory) as mapper:
+        return json.loads(bytes(mapper.part("index.json")))

@@ -1,0 +1,122 @@
+"""Smoosh container: pack many named parts into few mmap-able chunk files.
+
+Capability parity with the reference's smoosh format
+(java-util/.../io/smoosh/FileSmoosher.java, SmooshedFileMapper.java): all
+columns of a segment live in ≤chunk_size files `chunk_NNNNN.bin` plus a
+`meta.smoosh` index of (name, chunk, start, end). Reading maps chunks with
+mmap and hands out zero-copy memoryviews, so decompression (native LZ4)
+reads straight from the page cache.
+"""
+from __future__ import annotations
+
+import mmap
+import os
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_CHUNK_SIZE = 1 << 31  # 2GB, like the reference's mmap limit
+META_FILE = "meta.smoosh"
+
+
+def _chunk_name(i: int) -> str:
+    return f"chunk_{i:05d}.bin"
+
+
+class FileSmoosher:
+    """Writer: add named byte parts; parts never span chunks."""
+
+    def __init__(self, directory: str, chunk_size: int = DEFAULT_CHUNK_SIZE):
+        self.directory = directory
+        self.chunk_size = chunk_size
+        os.makedirs(directory, exist_ok=True)
+        self._entries: List[Tuple[str, int, int, int]] = []
+        self._chunk_idx = 0
+        self._chunk_pos = 0
+        self._fh = None
+
+    def _ensure_chunk(self, size: int):
+        if self._fh is None or (self._chunk_pos + size > self.chunk_size
+                                and self._chunk_pos > 0):
+            if self._fh is not None:
+                self._fh.close()
+                self._chunk_idx += 1
+            self._fh = open(os.path.join(
+                self.directory, _chunk_name(self._chunk_idx)), "wb")
+            self._chunk_pos = 0
+
+    def add(self, name: str, data: bytes):
+        if any(e[0] == name for e in self._entries):
+            raise ValueError(f"duplicate smoosh part {name!r}")
+        self._ensure_chunk(len(data))
+        start = self._chunk_pos
+        self._fh.write(data)
+        self._chunk_pos += len(data)
+        self._entries.append((name, self._chunk_idx, start, self._chunk_pos))
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        with open(os.path.join(self.directory, META_FILE), "w") as f:
+            f.write(f"v1,{self.chunk_size},{self._chunk_idx + 1}\n")
+            for name, chunk, start, end in self._entries:
+                f.write(f"{name},{chunk},{start},{end}\n")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SmooshedFileMapper:
+    """Reader: mmap chunk files, hand out zero-copy memoryviews per part."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._parts: Dict[str, Tuple[int, int, int]] = {}
+        with open(os.path.join(directory, META_FILE)) as f:
+            header = f.readline().strip().split(",")
+            if header[0] != "v1":
+                raise ValueError(f"unknown smoosh version {header[0]!r}")
+            n_chunks = int(header[2])
+            for line in f:
+                if not line.strip():
+                    continue
+                name, chunk, start, end = line.rsplit(",", 3)
+                self._parts[name] = (int(chunk), int(start), int(end))
+        self._maps: List[Optional[mmap.mmap]] = [None] * n_chunks
+        self._files: List[Optional[object]] = [None] * n_chunks
+
+    def names(self) -> List[str]:
+        return list(self._parts.keys())
+
+    def has(self, name: str) -> bool:
+        return name in self._parts
+
+    def part(self, name: str) -> memoryview:
+        chunk, start, end = self._parts[name]
+        if self._maps[chunk] is None:
+            fh = open(os.path.join(self.directory, _chunk_name(chunk)), "rb")
+            self._files[chunk] = fh
+            self._maps[chunk] = mmap.mmap(fh.fileno(), 0,
+                                          access=mmap.ACCESS_READ)
+        return memoryview(self._maps[chunk])[start:end]
+
+    def part_size(self, name: str) -> int:
+        chunk, start, end = self._parts[name]
+        return end - start
+
+    def close(self):
+        for i, m in enumerate(self._maps):
+            if m is not None:
+                m.close()
+                self._maps[i] = None
+            if self._files[i] is not None:
+                self._files[i].close()
+                self._files[i] = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
