@@ -128,6 +128,25 @@ class NumericColumn:
         return ColumnCapabilities(self.type)
 
 
+class ComplexColumn:
+    """Fixed-width complex metric column: one row = one state vector
+    (e.g. HLL registers int8[2^log2m]). Reference analog: ComplexColumn +
+    ComplexColumnPartSerde (segment/serde/ComplexColumnPartSerde.java) —
+    here states are dense 2-D arrays so device kernels reduce them directly
+    (HLL merge = segment_max over rows)."""
+
+    __slots__ = ("values", "type_name")
+    type = ValueType.COMPLEX
+
+    def __init__(self, values: np.ndarray, type_name: str):
+        assert values.ndim == 2
+        self.values = values
+        self.type_name = type_name
+
+    def capabilities(self) -> ColumnCapabilities:
+        return ColumnCapabilities(ValueType.COMPLEX)
+
+
 @dataclass
 class DeviceBlock:
     """A segment staged on device as padded dense arrays (all length `padded_rows`).
@@ -230,7 +249,7 @@ class Segment:
         arrays: Dict[str, object] = {}
 
         def _pad(a: np.ndarray, fill=0):
-            out = np.full((pad_n,), fill, dtype=a.dtype)
+            out = np.full((pad_n,) + a.shape[1:], fill, dtype=a.dtype)
             out[: a.shape[0]] = a
             return out
 

@@ -190,17 +190,24 @@ class FirstLastKernel(AggKernel):
     (absolute time, value) so cross-segment combine is order-correct.
     """
 
-    def __init__(self, spec, vtype: ValueType, is_last: bool):
+    def __init__(self, spec, vtype: ValueType, is_last: bool,
+                 time_field: Optional[str] = None):
         super().__init__(spec)
         self.vtype = vtype
         self.is_last = is_last
+        # rolled-up segments carry true event times in a hidden pair column
+        # (__ft_<field>, absolute int64); without it, row __time orders
+        self.time_field = time_field
 
     def signature(self):
-        return f"{'last' if self.is_last else 'first'}({self.spec.field},{self.vtype.value})"
+        return (f"{'last' if self.is_last else 'first'}"
+                f"({self.spec.field},{self.vtype.value},"
+                f"pt={self.time_field or ''})")
 
     def update(self, cols, mask, keys, num, aux):
         import jax.numpy as jnp
-        t = cols["__time_offset"]
+        pair = self.time_field is not None and self.time_field in cols
+        t = cols[self.time_field] if pair else cols["__time_offset"]
         if self.spec.field not in cols:
             e = self.empty_state(1)
             return (jnp.asarray(np.broadcast_to(
@@ -211,10 +218,11 @@ class FirstLastKernel(AggKernel):
         v = cols[self.spec.field]
         n = t.shape[0]
         if self.is_last:
-            ident_t = jnp.int32(-(2**31))
+            ident_t = (jnp.int64(INT64_MIN) if pair
+                       else jnp.int32(-(2**31)))
             tbest = _seg_max(jnp.where(mask, t, ident_t), keys, num)
         else:
-            ident_t = INT32_MAX
+            ident_t = jnp.int64(INT64_MAX) if pair else INT32_MAX
             tbest = _seg_min(jnp.where(mask, t, ident_t), keys, num)
         cand = mask & (t == tbest[keys])
         idx = jnp.where(cand, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
@@ -226,7 +234,9 @@ class FirstLastKernel(AggKernel):
 
     def host_post(self, state, segment):
         t, v, has = (np.asarray(s) for s in state)
-        t_abs = t.astype(np.int64) + segment.interval.start
+        t_abs = t.astype(np.int64)
+        if self.time_field is None:
+            t_abs = t_abs + segment.interval.start
         ident = INT64_MIN if self.is_last else INT64_MAX
         t_abs = np.where(has, t_abs, ident)
         return {"time": t_abs, "value": np.asarray(v), "has": has}
@@ -235,7 +245,10 @@ class FirstLastKernel(AggKernel):
         import jax.numpy as jnp
         t, v, has = state
         ident = INT64_MIN if self.is_last else INT64_MAX
-        t_abs = jnp.where(has, t.astype(jnp.int64) + time0, jnp.int64(ident))
+        t64 = t.astype(jnp.int64)
+        if self.time_field is None:
+            t64 = t64 + time0
+        t_abs = jnp.where(has, t64, jnp.int64(ident))
         return (t_abs, v, has)
 
     def device_combine(self, a, b):
@@ -333,6 +346,7 @@ class HllKernel(AggKernel):
         self._tables = []
         for f in self.fields:
             col = segment.dims.get(f)
+            met = segment.metrics.get(f)
             if col is not None:
                 if by_row:
                     tbl = segment.aux_cached(
@@ -343,7 +357,18 @@ class HllKernel(AggKernel):
                         ("hll_regrho", f, log2m),
                         lambda c=col: hll_mod.dim_register_tables(c.dictionary, log2m))
                     self._tables.append(("dim_regrho", f, (reg, rho)))
-            elif f in segment.metrics or f == "__time":
+            elif met is not None and met.type is ValueType.COMPLEX:
+                # pre-aggregated HLL register column (ingest-time hyperUnique)
+                if by_row:
+                    raise ValueError(
+                        f"byRow cardinality cannot consume pre-aggregated "
+                        f"hyperUnique column {f!r}; use hyperUnique instead")
+                if met.values.shape[1] != (1 << log2m):
+                    raise ValueError(
+                        f"hyperUnique column {f!r} has {met.values.shape[1]} "
+                        f"registers, query expects {1 << log2m}")
+                self._tables.append(("complex", f, None))
+            elif met is not None or f == "__time":
                 self._tables.append(("numeric", f, None))
             else:
                 self._tables.append(("missing", f, None))
@@ -389,6 +414,12 @@ class HllKernel(AggKernel):
                                             self.log2m)
             return regs
         for kind, f, _ in self._tables:
+            if kind == "complex":
+                rows = cols[f].astype(jnp.int32)  # [n, m] registers
+                part = _seg_max(
+                    jnp.where(mask[:, None], rows, 0), keys, num)
+                regs = part if regs is None else jnp.maximum(regs, part)
+                continue
             if kind == "dim_regrho":
                 reg_t = next(aux)
                 rho_t = next(aux)
@@ -452,10 +483,11 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
         return MinMaxKernel(spec, ValueType.FLOAT, False)
     if isinstance(spec, A.FloatMaxAggregator):
         return MinMaxKernel(spec, ValueType.FLOAT, True)
-    if isinstance(spec, A.FirstAggregator):
-        return FirstLastKernel(spec, ValueType(spec.kind), False)
-    if isinstance(spec, A.LastAggregator):
-        return FirstLastKernel(spec, ValueType(spec.kind), True)
+    if isinstance(spec, (A.FirstAggregator, A.LastAggregator)):
+        tf = f"__ft_{spec.field}"
+        return FirstLastKernel(spec, ValueType(spec.kind),
+                               isinstance(spec, A.LastAggregator),
+                               tf if tf in segment.metrics else None)
     if isinstance(spec, A.FilteredAggregator):
         child = make_kernel(spec.delegate, segment)
         node = plan_filter(spec.filter, segment)

@@ -1,0 +1,13 @@
+from druid_tpu.ingest.incremental import IncrementalIndex
+from druid_tpu.ingest.input import (CombiningFirehose, DimensionsSpec,
+                                    Firehose, InlineFirehose, InputRowParser,
+                                    LocalFirehose, RowBatch, TimestampSpec,
+                                    TransformSpec, firehose_from_json)
+from druid_tpu.ingest.merger import merge_segments
+
+__all__ = [
+    "IncrementalIndex", "merge_segments", "InputRowParser", "TimestampSpec",
+    "DimensionsSpec", "TransformSpec", "RowBatch", "Firehose",
+    "InlineFirehose", "LocalFirehose", "CombiningFirehose",
+    "firehose_from_json",
+]

@@ -132,16 +132,26 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             states={k.name: k.empty_state(spec0.num_total) for k in kernels},
             kernels=kernels)
 
-    # every needed column must have the same presence + kind in all segments:
-    # the plain path handles per-segment missing columns (aggregate-as-zero),
-    # but one stacked program cannot — fall back rather than KeyError/diverge
+    # every needed column must have the same presence, kind AND dtype in all
+    # segments: the plain path handles per-segment differences (missing
+    # aggregates as zero), but one stacked program cannot — fall back rather
+    # than KeyError, silently cast, or crash. Complex (2-D) metric columns
+    # also fall back: the stacker allocates [K, R] only.
     needed, columns = _needed_columns(segments[0], kds, aggs, flt,
                                       virtual_columns)
     for c in needed:
         in_dim0 = c in segments[0].dims
-        in_met0 = c in segments[0].metrics
+        met0 = segments[0].metrics.get(c)
+        if met0 is not None and np.asarray(met0.values).ndim != 1:
+            return None
         for s in segments[1:]:
-            if (c in s.dims) != in_dim0 or (c in s.metrics) != in_met0:
+            if (c in s.dims) != in_dim0:
+                return None
+            met = s.metrics.get(c)
+            if (met is None) != (met0 is None):
+                return None
+            if met is not None and (met.type is not met0.type
+                                    or met.values.dtype != met0.values.dtype):
                 return None
     stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
 

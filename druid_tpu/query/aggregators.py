@@ -146,6 +146,15 @@ class FirstAggregator(AggregatorSpec):
     field: str
     kind: str = "double"  # long|double|float
 
+    def combining(self):
+        return FirstAggregator(self.name, self.name, self.kind)
+
+    def required_columns(self):
+        # the rollup pair-time column, when present, restores true event-time
+        # ordering over rolled-up segments (reference stores
+        # SerializablePair(long time, value) for exactly this)
+        return {self.field, f"__ft_{self.field}"}
+
     def to_json(self):
         return {"type": f"{self.kind}First", "name": self.name, "fieldName": self.field}
 
@@ -156,6 +165,12 @@ class LastAggregator(AggregatorSpec):
     name: str
     field: str
     kind: str = "double"
+
+    def combining(self):
+        return LastAggregator(self.name, self.name, self.kind)
+
+    def required_columns(self):
+        return {self.field, f"__ft_{self.field}"}
 
     def to_json(self):
         return {"type": f"{self.kind}Last", "name": self.name, "fieldName": self.field}

@@ -50,15 +50,16 @@ def decompress_block(codec: int, data, out_size: int) -> bytes:
 
 
 def compress_array(arr: np.ndarray, codec: int | None = None) -> bytes:
-    """Serialize a 1-D numpy array as a block-compressed column part.
+    """Serialize a numpy array (any rank) as a block-compressed column part.
 
-    Layout: [codec u8][dtype_len u8][dtype str][n_elems i64][block_size i32]
-            [n_blocks i32][comp_sizes i32 * n_blocks][blocks...]
+    Layout: [codec u8][dtype_len u8][dtype str][ndim u8][shape i64 * ndim]
+            [block_size i32][n_blocks i32][(size i32, codec u8) * n_blocks]
+            [blocks...]
     """
     if codec is None:
         codec = default_codec()
     arr = np.ascontiguousarray(arr)
-    raw = arr.view(np.uint8).ravel()
+    raw = arr.reshape(-1).view(np.uint8)
     dtype_s = arr.dtype.str.encode()
     n_bytes = raw.shape[0]
     n_blocks = (n_bytes + BLOCK_SIZE - 1) // BLOCK_SIZE if n_bytes else 0
@@ -72,7 +73,9 @@ def compress_array(arr: np.ndarray, codec: int | None = None) -> bytes:
         else:
             blocks.append((codec, comp))
     header = struct.pack("<BB", codec, len(dtype_s)) + dtype_s
-    header += struct.pack("<qii", arr.shape[0], BLOCK_SIZE, n_blocks)
+    header += struct.pack("<B", arr.ndim)
+    header += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    header += struct.pack("<ii", BLOCK_SIZE, n_blocks)
     header += b"".join(struct.pack("<iB", len(c), bc) for bc, c in blocks)
     return header + b"".join(c for _, c in blocks)
 
@@ -84,8 +87,13 @@ def decompress_array(buf) -> np.ndarray:
     codec, dlen = struct.unpack_from("<BB", buf, 0)
     dtype = np.dtype(bytes(buf[2:2 + dlen]).decode())
     off = 2 + dlen
-    n_elems, block_size, n_blocks = struct.unpack_from("<qii", buf, off)
-    off += 16
+    (ndim,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    shape = struct.unpack_from(f"<{ndim}q", buf, off)
+    off += 8 * ndim
+    n_elems = int(np.prod(shape)) if ndim else 1
+    block_size, n_blocks = struct.unpack_from("<ii", buf, off)
+    off += 8
     sizes = np.zeros(n_blocks, dtype=np.int64)
     codecs = np.zeros(n_blocks, dtype=np.uint8)
     for i in range(n_blocks):
@@ -101,7 +109,7 @@ def decompress_array(buf) -> np.ndarray:
     if n_blocks and (codecs == LZ4).all() and native.available():
         out = native.lz4_decompress_batch(blob, src_offsets, sizes,
                                           dst_offsets, dst_sizes, total)
-        return out.view(dtype)[:n_elems]
+        return out.view(dtype)[:n_elems].reshape(shape)
     out = np.empty(total, dtype=np.uint8)
     for i in range(n_blocks):
         chunk = decompress_block(
@@ -109,4 +117,4 @@ def decompress_array(buf) -> np.ndarray:
             int(dst_sizes[i]))
         out[int(dst_offsets[i]):int(dst_offsets[i] + dst_sizes[i])] = \
             np.frombuffer(chunk, dtype=np.uint8)
-    return out.view(dtype)[:n_elems]
+    return out.view(dtype)[:n_elems].reshape(shape)

@@ -32,8 +32,8 @@ import numpy as np
 
 from druid_tpu.data.bitmap import Bitmap, BitmapIndex
 from druid_tpu.data.dictionary import Dictionary
-from druid_tpu.data.segment import (NumericColumn, Segment, SegmentId,
-                                    StringDimColumn, ValueType)
+from druid_tpu.data.segment import (ComplexColumn, NumericColumn, Segment,
+                                    SegmentId, StringDimColumn, ValueType)
 from druid_tpu.storage import codec as codecs
 from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
 from druid_tpu.utils.intervals import Interval
@@ -129,7 +129,9 @@ def persist_segment(segment: Segment, directory: str,
         "partition": segment.id.partition,
         "n_rows": segment.n_rows,
         "dimensions": list(segment.dims.keys()),
-        "metrics": {k: v.type.value for k, v in segment.metrics.items()},
+        "metrics": {k: (f"complex:{v.type_name}"
+                        if v.type is ValueType.COMPLEX else v.type.value)
+                    for k, v in segment.metrics.items()},
         "min_time": segment.min_time,
         "max_time": segment.max_time,
         "codec": codec,
@@ -178,12 +180,15 @@ def load_segment(directory: str,
         if mapper.has(bm_part):
             col.set_bitmap_index(LazyBitmapIndex(mapper.part(bm_part)))
         dims[name] = col
-    metrics: Dict[str, NumericColumn] = {}
+    metrics: Dict[str, object] = {}
     for name, tname in meta["metrics"].items():
         if columns is not None and name not in columns:
             continue
         vals = decompress_part(mapper, f"met.{name}").copy()
-        metrics[name] = NumericColumn(vals, ValueType(tname))
+        if tname.startswith("complex:"):
+            metrics[name] = ComplexColumn(vals, tname.split(":", 1)[1])
+        else:
+            metrics[name] = NumericColumn(vals, ValueType(tname))
     seg = Segment(seg_id, time_ms.copy(), dims, metrics, sorted_by_time=True)
     seg._mapper = mapper  # keep mmaps alive for lazy bitmap loads
     return seg

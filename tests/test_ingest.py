@@ -1,0 +1,369 @@
+"""Ingestion tests: parsers, IncrementalIndex rollup, merger — the analog of
+the reference's IncrementalIndexTest / IndexMergerTestBase / parser tests."""
+import json
+
+import numpy as np
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.ingest import (IncrementalIndex, InlineFirehose,
+                              InputRowParser, LocalFirehose, TimestampSpec,
+                              TransformSpec, merge_segments)
+from druid_tpu.ingest.input import DimensionsSpec, ExpressionTransform
+from druid_tpu.query.aggregators import (CountAggregator, DoubleSumAggregator,
+                                         FirstAggregator,
+                                         HyperUniqueAggregator,
+                                         LastAggregator, LongMaxAggregator,
+                                         LongSumAggregator)
+from druid_tpu.query.filters import BoundFilter, SelectorFilter
+from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery, \
+    TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-03-01", "2026-03-02")
+T0 = IV.start
+
+
+def _mk_index(**kw):
+    defaults = dict(
+        datasource="ing",
+        interval=IV,
+        metric_specs=[CountAggregator("count"),
+                      LongSumAggregator("val_sum", "val")],
+        dimensions=["d1", "d2"],
+        query_granularity="hour",
+    )
+    defaults.update(kw)
+    return IncrementalIndex(**defaults)
+
+
+def test_rollup_basic():
+    idx = _mk_index(flush_rows=4)  # force multiple compactions
+    for i in range(100):
+        idx.add({"timestamp": T0 + (i % 3) * 3_600_000,
+                 "d1": f"a{i % 2}", "d2": "z", "val": 1})
+    # 3 hours x 2 d1 values = 6 groups
+    assert idx.n_rows == 6
+    seg = idx.to_segment()
+    assert seg.n_rows == 6
+    assert int(seg.metrics["count"].values.sum()) == 100
+    assert int(seg.metrics["val_sum"].values.sum()) == 100
+    # rolled-up count queries back as longSum of the count column
+    q = TimeseriesQuery.of(
+        "ing", [IV], [LongSumAggregator("rows", "count"),
+                      LongSumAggregator("v", "val_sum")],
+        granularity="all")
+    res = QueryExecutor([seg]).run(q)
+    assert res[0]["result"] == {"rows": 100, "v": 100}
+
+
+def test_no_rollup_keeps_rows():
+    idx = _mk_index(rollup=False, flush_rows=7)
+    for i in range(50):
+        idx.add({"timestamp": T0 + i, "d1": "a", "d2": "b", "val": 2})
+    assert idx.to_segment().n_rows == 50
+
+
+def test_rollup_matches_recomputed_golden():
+    rng = np.random.default_rng(0)
+    idx = _mk_index(
+        metric_specs=[CountAggregator("count"),
+                      LongSumAggregator("s", "val"),
+                      LongMaxAggregator("mx", "val"),
+                      FirstAggregator("first_v", "val", "long"),
+                      LastAggregator("last_v", "val", "long")],
+        flush_rows=13)
+    rows = []
+    for i in range(500):
+        r = {"timestamp": T0 + int(rng.integers(0, 4)) * 3_600_000 + i,
+             "d1": f"k{int(rng.integers(0, 3))}", "d2": "c",
+             "val": int(rng.integers(0, 100))}
+        rows.append(r)
+        idx.add(r)
+    seg = idx.to_segment()
+    # golden: group rows by (hour, d1, d2)
+    golden = {}
+    for r in rows:
+        hour = (r["timestamp"] // 3_600_000) * 3_600_000
+        k = (hour, r["d1"], r["d2"])
+        g = golden.setdefault(k, {"count": 0, "s": 0, "mx": -1,
+                                  "ft": None, "fv": None, "lt": None,
+                                  "lv": None})
+        g["count"] += 1
+        g["s"] += r["val"]
+        g["mx"] = max(g["mx"], r["val"])
+        if g["ft"] is None or r["timestamp"] < g["ft"]:
+            g["ft"], g["fv"] = r["timestamp"], r["val"]
+        if g["lt"] is None or r["timestamp"] > g["lt"]:
+            g["lt"], g["lv"] = r["timestamp"], r["val"]
+    assert seg.n_rows == len(golden)
+    d1 = seg.dims["d1"]
+    for i in range(seg.n_rows):
+        k = (int(seg.time_ms[i]), d1.dictionary.value_of(int(d1.ids[i])), "c")
+        g = golden[k]
+        assert int(seg.metrics["count"].values[i]) == g["count"]
+        assert int(seg.metrics["s"].values[i]) == g["s"]
+        assert int(seg.metrics["mx"].values[i]) == g["mx"]
+        assert int(seg.metrics["first_v"].values[i]) == g["fv"]
+        assert int(seg.metrics["last_v"].values[i]) == g["lv"]
+
+
+def test_schemaless_dimension_discovery():
+    idx = _mk_index(dimensions=None, flush_rows=3)
+    idx.add({"timestamp": T0, "d1": "x", "val": 1})
+    idx.add({"timestamp": T0 + 1, "newdim": "y", "val": 2})
+    idx.add({"timestamp": T0 + 2, "d1": "x", "newdim": "y", "val": 3})
+    idx.add({"timestamp": T0 + 3, "d1": "x", "newdim": "y", "val": 4})
+    seg = idx.to_segment()
+    assert set(seg.dims) == {"d1", "newdim"}
+    # missing values encode as null ("")
+    assert "" in seg.dims["newdim"].dictionary.values
+
+
+def test_out_of_interval_rows_dropped():
+    idx = _mk_index()
+    idx.add({"timestamp": T0 - 1, "d1": "x", "val": 1})
+    idx.add({"timestamp": T0, "d1": "x", "val": 1})
+    idx.add({"timestamp": IV.end, "d1": "x", "val": 1})
+    assert idx.n_rows == 1
+    assert idx.rows_out_of_interval == 2
+
+
+def test_hyperunique_ingest_metric_roundtrip(tmp_path):
+    from druid_tpu.storage import load_segment, persist_segment
+    idx = _mk_index(
+        metric_specs=[CountAggregator("count"),
+                      HyperUniqueAggregator("uniq", "user")],
+        dimensions=["d1"], flush_rows=11)
+    for i in range(300):
+        idx.add({"timestamp": T0 + i % 2, "d1": f"g{i % 2}",
+                 "user": f"user_{i % 57}"})
+    seg = idx.to_segment()
+    assert seg.metrics["uniq"].values.ndim == 2
+    q = TimeseriesQuery.of(
+        "ing", [IV], [HyperUniqueAggregator("u", "uniq")], granularity="all")
+    est = QueryExecutor([seg]).run(q)[0]["result"]["u"]
+    assert 50 <= est <= 64  # HLL estimate of 57 uniques
+    # survives persist/load
+    d = str(tmp_path / "hll_seg")
+    persist_segment(seg, d)
+    est2 = QueryExecutor([load_segment(d)]).run(q)[0]["result"]["u"]
+    assert est2 == est
+    # groupBy over the complex metric
+    gq = GroupByQuery.of("ing", [IV], [DefaultDimensionSpec("d1")],
+                         [HyperUniqueAggregator("u", "uniq")],
+                         granularity="all")
+    rows = QueryExecutor([seg]).run(gq)
+    assert len(rows) == 2
+    for r in rows:
+        # gcd(2,57)=1 so each d1 group still sees all 57 users
+        assert 50 <= r["event"]["u"] <= 64
+
+
+def test_merge_segments_equals_single_index():
+    specs = [CountAggregator("count"), LongSumAggregator("s", "val"),
+             DoubleSumAggregator("d", "dval")]
+    idx_all = _mk_index(metric_specs=specs, flush_rows=17)
+    idx_a = _mk_index(metric_specs=specs, flush_rows=17)
+    idx_b = _mk_index(metric_specs=specs, flush_rows=17)
+    rng = np.random.default_rng(7)
+    for i in range(400):
+        row = {"timestamp": T0 + int(rng.integers(0, 5)) * 3_600_000,
+               "d1": f"v{int(rng.integers(0, 4))}",
+               "d2": f"w{int(rng.integers(0, 3))}",
+               "val": int(rng.integers(0, 10)),
+               "dval": float(rng.normal())}
+        idx_all.add(row)
+        (idx_a if i % 2 else idx_b).add(row)
+    merged = merge_segments([idx_a.to_segment(), idx_b.to_segment()],
+                            specs, query_granularity="hour")
+    single = idx_all.to_segment()
+    assert merged.n_rows == single.n_rows
+    # compare via a query (canonical ordering)
+    q = GroupByQuery.of(
+        "ing", [IV],
+        [DefaultDimensionSpec("d1"), DefaultDimensionSpec("d2")],
+        [LongSumAggregator("c", "count"), LongSumAggregator("s", "s")],
+        granularity="hour")
+    ra = QueryExecutor([merged]).run(q)
+    rb = QueryExecutor([single]).run(q)
+    assert ra == rb
+
+
+def test_merge_heterogeneous_dims():
+    specs = [CountAggregator("count")]
+    a = IncrementalIndex("m", IV, specs, dimensions=["x"])
+    a.add({"timestamp": T0, "x": "1"})
+    b = IncrementalIndex("m", IV, specs, dimensions=["y"])
+    b.add({"timestamp": T0, "y": "2"})
+    merged = merge_segments([a.to_segment(), b.to_segment()], specs,
+                            rollup=False)
+    assert set(merged.dims) == {"x", "y"}
+    assert merged.n_rows == 2
+    vals = {(merged.dims["x"].dictionary.value_of(int(merged.dims["x"].ids[i])),
+             merged.dims["y"].dictionary.value_of(int(merged.dims["y"].ids[i])))
+            for i in range(2)}
+    assert vals == {("1", ""), ("", "2")}
+
+
+# ---------------------------------------------------------------------------
+# Parsers / firehoses / transforms
+# ---------------------------------------------------------------------------
+
+def test_json_parser():
+    p = InputRowParser(TimestampSpec("ts", "iso"), DimensionsSpec(("a",)),
+                       fmt="json")
+    batch = p.parse_batch([json.dumps({"ts": "2026-03-01T00:00:00Z",
+                                       "a": "x", "m": 5})])
+    assert batch.timestamps == [T0]
+    assert batch.columns["a"] == ["x"]
+
+
+def test_csv_tsv_regex_parsers():
+    csv_p = InputRowParser(TimestampSpec("t", "millis"), DimensionsSpec(),
+                           fmt="csv", columns=["t", "a", "b"])
+    b = csv_p.parse_batch([f"{T0},x,3", f"{T0 + 1},y,4"])
+    assert b.columns["a"] == ["x", "y"]
+    tsv_p = InputRowParser(TimestampSpec("t", "millis"), DimensionsSpec(),
+                           fmt="tsv", columns=["t", "a"])
+    b = tsv_p.parse_batch([f"{T0}\tz"])
+    assert b.columns["a"] == ["z"]
+    rx_p = InputRowParser(TimestampSpec("t", "millis"), DimensionsSpec(),
+                          fmt="regex", columns=["t", "w"],
+                          pattern=r"(\d+) (\w+)")
+    b = rx_p.parse_batch([f"{T0} hello"])
+    assert b.columns["w"] == ["hello"]
+
+
+def test_timestamp_formats():
+    assert TimestampSpec(format="millis").parse(T0) == T0
+    assert TimestampSpec(format="posix").parse(T0 // 1000) == T0
+    assert TimestampSpec(format="auto").parse(str(T0)) == T0
+    assert TimestampSpec(format="auto").parse("2026-03-01") == T0
+    assert TimestampSpec(format="%d/%m/%Y %H:%M").parse("01/03/2026 00:00") == T0
+    with pytest.raises(ValueError):
+        TimestampSpec().parse(None)
+    assert TimestampSpec(missing_value=123).parse(None) == 123
+
+
+def test_transform_spec():
+    from druid_tpu.ingest.input import RowBatch
+    ts = TransformSpec(
+        transforms=(ExpressionTransform("doubled", "v * 2"),),
+        filter=BoundFilter("v", lower="3", ordering="numeric"))
+    batch = RowBatch([T0, T0 + 1, T0 + 2],
+                     {"v": [2, 3, 10], "d": ["a", "b", "c"]})
+    out = ts.apply(batch)
+    assert len(out) == 2  # v>=3 kept
+    assert out.columns["d"] == ["b", "c"]
+    assert [float(x) for x in out.columns["doubled"]] == [6.0, 20.0]
+
+
+def test_local_firehose(tmp_path):
+    import gzip
+    (tmp_path / "a.json").write_text('{"t": 1, "d": "x"}\n{"t": 2, "d": "y"}\n')
+    with gzip.open(tmp_path / "b.json.gz", "wt") as f:
+        f.write('{"t": 3, "d": "z"}\n')
+    fh = LocalFirehose(str(tmp_path), "*.json*")
+    lines = [l for batch in fh.batches() for l in batch]
+    assert len(lines) == 3
+
+
+def test_firehose_to_index_end_to_end():
+    records = [json.dumps({"ts": T0 + i, "d1": f"p{i % 3}", "val": i})
+               for i in range(100)]
+    parser = InputRowParser(TimestampSpec("ts", "millis"),
+                            DimensionsSpec(("d1",)))
+    idx = _mk_index(dimensions=["d1"], query_granularity="all")
+    for raw in InlineFirehose(records).batches(batch_size=16):
+        idx.add_batch(parser.parse_batch(raw))
+    seg = idx.to_segment()
+    assert seg.n_rows == 3  # 3 d1 values, granularity all
+    assert int(seg.metrics["val_sum"].values.sum()) == sum(range(100))
+
+
+def test_schemaless_backfill_is_null():
+    """Rows ingested before a dim is discovered must read as null, not as
+    the first-seen value of the new dimension."""
+    idx = _mk_index(dimensions=None, flush_rows=2)
+    idx.add({"timestamp": T0, "d1": "a", "val": 1})
+    idx.add({"timestamp": T0 + 1, "d1": "b", "val": 1})      # compaction 1
+    idx.add({"timestamp": T0 + 2, "newdim": "y", "val": 1})
+    idx.add({"timestamp": T0 + 3, "newdim": "y", "val": 1})  # compaction 2
+    seg = idx.to_segment()
+    # rows 3+4 roll up (same hour, same dims) → 3 rows; the two pre-discovery
+    # rows read newdim as null, NOT as "y"
+    nd = seg.dims["newdim"]
+    vals = sorted(nd.dictionary.value_of(int(i)) for i in nd.ids)
+    assert vals == ["", "", "y"]
+
+
+def test_first_last_merge_uses_event_time():
+    """Cross-segment first/last must pick by true event time, not
+    concatenation order (pair-time column semantics)."""
+    specs = [FirstAggregator("fv", "val", "long"),
+             LastAggregator("lv", "val", "long")]
+    H = T0  # one hour bucket
+    a = IncrementalIndex("fl", IV, specs, dimensions=["d"],
+                         query_granularity="hour")
+    a.add({"timestamp": H + 10, "d": "g", "val": 1})
+    b = IncrementalIndex("fl", IV, specs, dimensions=["d"],
+                         query_granularity="hour")
+    b.add({"timestamp": H + 5, "d": "g", "val": 2})
+    b.add({"timestamp": H + 20, "d": "g", "val": 3})
+    merged = merge_segments([a.to_segment(), b.to_segment()], specs,
+                            query_granularity="hour")
+    assert merged.n_rows == 1
+    assert int(merged.metrics["fv"].values[0]) == 2   # t=H+5 wins first
+    assert int(merged.metrics["lv"].values[0]) == 3   # t=H+20 wins last
+    # combining keeps the long kind
+    assert merged.metrics["fv"].values.dtype == np.int64
+    # query over rolled-up segments also orders by pair time
+    q = TimeseriesQuery.of("fl", [IV],
+                           [FirstAggregator("f", "fv", "long"),
+                            LastAggregator("l", "lv", "long")],
+                           granularity="all")
+    res = QueryExecutor([a.to_segment(), b.to_segment()]).run(q)
+    assert res[0]["result"] == {"f": 2, "l": 3}
+
+
+def test_sharded_complex_column_falls_back():
+    """hyperUnique complex columns can't stack [K,R]; the mesh path must
+    fall back to per-segment execution, matching plain results."""
+    from druid_tpu.parallel import make_mesh, use_mesh
+    specs = [CountAggregator("count"), HyperUniqueAggregator("uu", "user")]
+    segs = []
+    for p in range(2):
+        idx = IncrementalIndex("hc", IV, specs, dimensions=["d"],
+                               query_granularity="hour")
+        for i in range(100):
+            idx.add({"timestamp": T0 + i, "d": f"x{i % 3}",
+                     "user": f"u{p}_{i % 20}"})
+        segs.append(idx.to_segment(partition=p))
+    q = TimeseriesQuery.of("hc", [IV], [HyperUniqueAggregator("u", "uu")],
+                           granularity="all")
+    plain = QueryExecutor(segs).run(q)
+    with use_mesh(make_mesh()):
+        sharded = QueryExecutor(segs).run(q)
+    assert plain == sharded
+    assert 36 <= plain[0]["result"]["u"] <= 44  # 40 uniques
+
+
+def test_sharded_dtype_mismatch_falls_back():
+    from druid_tpu.data.segment import SegmentBuilder
+    from druid_tpu.parallel import make_mesh, use_mesh
+    from druid_tpu.query.aggregators import DoubleSumAggregator
+    b1 = SegmentBuilder("dm", IV, partition=0)
+    for i in range(10):
+        b1.add_row(T0 + i, {"d": "x"}, {"m": i})        # long metric
+    b2 = SegmentBuilder("dm", IV, partition=1)
+    for i in range(10):
+        b2.add_row(T0 + i, {"d": "x"}, {"m": i + 0.5})  # double metric
+    segs = [b1.build(), b2.build()]
+    q = TimeseriesQuery.of("dm", [IV], [DoubleSumAggregator("s", "m")],
+                           granularity="all")
+    plain = QueryExecutor(segs).run(q)
+    with use_mesh(make_mesh()):
+        sharded = QueryExecutor(segs).run(q)
+    assert abs(plain[0]["result"]["s"] - (45 + 50)) < 1e-9
+    assert plain == sharded
