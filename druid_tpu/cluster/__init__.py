@@ -1,0 +1,15 @@
+from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
+                                         LinearShardSpec, NoneShardSpec,
+                                         NumberedShardSpec, ShardSpec,
+                                         SingleDimensionShardSpec,
+                                         shardspec_from_json)
+from druid_tpu.cluster.timeline import (PartitionChunk, PartitionHolder,
+                                        TimelineObjectHolder,
+                                        VersionedIntervalTimeline)
+
+__all__ = [
+    "ShardSpec", "NoneShardSpec", "LinearShardSpec", "NumberedShardSpec",
+    "HashBasedNumberedShardSpec", "SingleDimensionShardSpec",
+    "shardspec_from_json", "PartitionChunk", "PartitionHolder",
+    "TimelineObjectHolder", "VersionedIntervalTimeline",
+]

@@ -704,7 +704,8 @@ def make_row_matcher(flt: F.DimFilter):
         expr = parse_expression(flt.expression)
 
         def ex_match(row):
-            out = expr.evaluate({k: (0 if v is None else v)
+            # None ≡ "" — the same null contract as every other row matcher
+            out = expr.evaluate({k: ("" if v is None else v)
                                  for k, v in row.items()})
             try:
                 return bool(float(out))

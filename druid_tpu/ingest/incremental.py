@@ -376,8 +376,11 @@ class IncrementalIndex:
     # -- ingestion ------------------------------------------------------
     def add(self, row: dict, timestamp: Optional[int] = None):
         """Add one row: {'timestamp': ms | via arg, dims..., metrics...}."""
-        ts = int(row.get("timestamp", timestamp)
-                 if timestamp is None else timestamp)
+        raw_ts = row.get("timestamp") if timestamp is None else timestamp
+        if raw_ts is None:
+            raise ValueError(
+                "row has no 'timestamp' key and no timestamp argument")
+        ts = int(raw_ts)
         cols = {k: v for k, v in row.items() if k != "timestamp"}
         self.add_batch(RowBatch([ts], {k: [v] for k, v in cols.items()}))
 

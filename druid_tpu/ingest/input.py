@@ -222,24 +222,32 @@ class TransformSpec:
             return batch
         cols = dict(batch.columns)
         n = len(batch)
-        bindings: Dict[str, object] = {"__time": np.asarray(
-            batch.timestamps, dtype=np.int64)}
-        for k, v in cols.items():
-            arr = np.asarray(v, dtype=object)
-            num = np.asarray(
-                [x if isinstance(x, (int, float)) and not isinstance(x, bool)
-                 else _maybe_num(x) for x in v], dtype=object)
-            if all(isinstance(x, (int, float)) for x in num):
-                bindings[k] = np.asarray([float(x) for x in num])
-            else:
-                bindings[k] = arr
-        for t in self.transforms:
-            val = parse_expression(t.expression).evaluate(bindings)
-            val = np.asarray(val)
-            if val.ndim == 0:
-                val = np.full(n, val[()])
-            cols[t.name] = list(val)
-            bindings[t.name] = val
+        if self.transforms:
+            # bind only the columns the transform expressions reference
+            exprs = [(t, parse_expression(t.expression))
+                     for t in self.transforms]
+            referenced = set()
+            for _, e in exprs:
+                referenced |= e.required_columns()
+            bindings: Dict[str, object] = {"__time": np.asarray(
+                batch.timestamps, dtype=np.int64)}
+            for k in referenced:
+                if k == "__time" or k not in cols:
+                    continue
+                v = cols[k]
+                num = [x if isinstance(x, (int, float))
+                       and not isinstance(x, bool) else _maybe_num(x)
+                       for x in v]
+                if all(isinstance(x, (int, float)) for x in num):
+                    bindings[k] = np.asarray([float(x) for x in num])
+                else:
+                    bindings[k] = np.asarray(v, dtype=object)
+            for t, e in exprs:
+                val = np.asarray(e.evaluate(bindings))
+                if val.ndim == 0:
+                    val = np.full(n, val[()])
+                cols[t.name] = list(val)
+                bindings[t.name] = val
         if self.filter is not None:
             keep = _filter_rows(self.filter, batch.timestamps, cols, n)
             ts = [t for t, k in zip(batch.timestamps, keep) if k]

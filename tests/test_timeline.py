@@ -1,0 +1,153 @@
+"""Timeline MVCC + shard spec tests — the analog of the reference's
+VersionedIntervalTimelineTest scenarios."""
+import pytest
+
+from druid_tpu.cluster import (HashBasedNumberedShardSpec, NoneShardSpec,
+                               NumberedShardSpec, PartitionChunk,
+                               SingleDimensionShardSpec,
+                               VersionedIntervalTimeline, shardspec_from_json)
+from druid_tpu.utils.intervals import Interval
+
+
+def IV(a, b):
+    return Interval.of(f"2026-01-{a:02d}", f"2026-01-{b:02d}")
+
+
+def chunk(obj, spec=None):
+    return PartitionChunk(spec or NoneShardSpec(), obj)
+
+
+def lookup_objs(tl, iv):
+    return [(str(h.interval), h.version, sorted(h.payloads()))
+            for h in tl.lookup(iv)]
+
+
+def test_basic_add_lookup():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 2), "v1", chunk("a"))
+    tl.add(IV(2, 3), "v1", chunk("b"))
+    out = tl.lookup(IV(1, 3))
+    assert [h.payloads() for h in out] == [["a"], ["b"]]
+    # clipping to query interval
+    out = tl.lookup(Interval.of("2026-01-01T06:00:00Z", "2026-01-02"))
+    assert len(out) == 1 and out[0].payloads() == ["a"]
+    assert out[0].interval == Interval.of("2026-01-01T06:00:00Z", "2026-01-02")
+
+
+def test_higher_version_overshadows():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 3), "v1", chunk("old"))
+    tl.add(IV(1, 3), "v2", chunk("new"))
+    assert lookup_objs(tl, IV(1, 3)) == [
+        ("2026-01-01T00:00:00.000Z/2026-01-03T00:00:00.000Z", "v2", ["new"])]
+    # removing v2 resurrects v1
+    tl.remove(IV(1, 3), "v2", 0)
+    assert lookup_objs(tl, IV(1, 3)) == [
+        ("2026-01-01T00:00:00.000Z/2026-01-03T00:00:00.000Z", "v1", ["old"])]
+
+
+def test_partial_overshadow_splits():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 5), "v1", chunk("wide"))
+    tl.add(IV(2, 3), "v2", chunk("narrow"))
+    out = lookup_objs(tl, IV(1, 5))
+    assert out == [
+        ("2026-01-01T00:00:00.000Z/2026-01-02T00:00:00.000Z", "v1", ["wide"]),
+        ("2026-01-02T00:00:00.000Z/2026-01-03T00:00:00.000Z", "v2", ["narrow"]),
+        ("2026-01-03T00:00:00.000Z/2026-01-05T00:00:00.000Z", "v1", ["wide"]),
+    ]
+
+
+def test_incomplete_partition_set_invisible():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 2), "v2", chunk("p0", NumberedShardSpec(0, 2)))
+    tl.add(IV(1, 2), "v1", chunk("whole"))
+    # v2 has 1 of 2 partitions: invisible, v1 shows
+    assert lookup_objs(tl, IV(1, 2))[0][1] == "v1"
+    tl.add(IV(1, 2), "v2", chunk("p1", NumberedShardSpec(1, 2)))
+    out = tl.lookup(IV(1, 2))
+    assert out[0].version == "v2"
+    assert sorted(out[0].payloads()) == ["p0", "p1"]
+    # incomplete entries visible through lookup_with_incomplete
+    tl2 = VersionedIntervalTimeline()
+    tl2.add(IV(1, 2), "v1", chunk("x", NumberedShardSpec(0, 3)))
+    assert tl2.lookup(IV(1, 2)) == []
+    assert len(tl2.lookup_with_incomplete(IV(1, 2))) == 1
+
+
+def test_is_overshadowed_and_find_fully():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 3), "v1", chunk("old"))
+    tl.add(IV(1, 2), "v2", chunk("n1"))
+    assert not tl.is_overshadowed(IV(1, 3), "v1")  # only half covered
+    tl.add(IV(2, 3), "v3", chunk("n2"))
+    assert tl.is_overshadowed(IV(1, 3), "v1")      # covered by v2+v3 union
+    shadowed = tl.find_fully_overshadowed()
+    assert [h.version for h in shadowed] == ["v1"]
+    # newer versions are not overshadowed
+    assert not tl.is_overshadowed(IV(1, 2), "v2")
+
+
+def test_version_comparison_is_lexicographic():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 2), "2026-01-01T00:00:00Z", chunk("older"))
+    tl.add(IV(1, 2), "2026-01-02T00:00:00Z", chunk("newer"))
+    assert tl.lookup(IV(1, 2))[0].payloads() == ["newer"]
+
+
+def test_adjacent_same_entry_merges():
+    tl = VersionedIntervalTimeline()
+    tl.add(IV(1, 5), "v1", chunk("w"))
+    # lookup over a range with an internal boundary from another datasource's
+    # perspective must not split the holder
+    out = tl.lookup(IV(1, 5))
+    assert len(out) == 1
+
+
+# -- shard specs --------------------------------------------------------
+
+def test_numbered_shardspec_completeness():
+    s0, s1 = NumberedShardSpec(0, 2), NumberedShardSpec(1, 2)
+    assert not s0.complete_set([s0])
+    assert s0.complete_set([s0, s1])
+    # open-ended (streaming) sets are always complete
+    assert NumberedShardSpec(3, 0).complete_set([NumberedShardSpec(3, 0)])
+
+
+def test_hashed_shardspec_routing_and_pruning():
+    specs = [HashBasedNumberedShardSpec(i, 4, ("user",)) for i in range(4)]
+    rows = [{"user": f"u{i}"} for i in range(100)]
+    counts = [0] * 4
+    for r in rows:
+        owners = [s for s in specs if s.is_in_chunk(r)]
+        assert len(owners) == 1  # exactly one shard owns each row
+        counts[owners[0].partition_num] += 1
+    assert all(c > 10 for c in counts)  # roughly balanced
+    # pruning: a pinned value hits exactly one shard
+    domain = {"user": ["u7"]}
+    possible = [s for s in specs if s.possible_in_domain(domain)]
+    assert len(possible) == 1
+    assert possible[0].is_in_chunk({"user": "u7"})
+    # unconstrained dim: no pruning
+    assert all(s.possible_in_domain({}) for s in specs)
+
+
+def test_single_dimension_shardspec():
+    a = SingleDimensionShardSpec("d", None, "m", 0)
+    b = SingleDimensionShardSpec("d", "m", None, 1)
+    assert a.is_in_chunk({"d": "apple"})
+    assert not a.is_in_chunk({"d": "zebra"})
+    assert b.is_in_chunk({"d": "zebra"})
+    assert a.complete_set([a, b])
+    assert not a.complete_set([a])
+    gap = SingleDimensionShardSpec("d", "x", None, 1)
+    assert not a.complete_set([a, gap])
+    assert a.possible_in_domain({"d": ["apple"]})
+    assert not a.possible_in_domain({"d": ["zebra"]})
+
+
+def test_shardspec_json_roundtrip():
+    for s in [NoneShardSpec(), NumberedShardSpec(1, 3),
+              HashBasedNumberedShardSpec(2, 4, ("a", "b")),
+              SingleDimensionShardSpec("d", "a", "b", 1)]:
+        assert shardspec_from_json(s.to_json()) == s
