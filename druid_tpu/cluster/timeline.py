@@ -22,7 +22,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Generic, List, Optional, Tuple, TypeVar
 
 from druid_tpu.cluster.shardspec import NoneShardSpec, ShardSpec
-from druid_tpu.utils.intervals import Interval
+from druid_tpu.utils.intervals import Interval, condense
 
 T = TypeVar("T")
 
@@ -203,11 +203,4 @@ class VersionedIntervalTimeline(Generic[T]):
 
 def _covered(interval: Interval, covers: List[Interval]) -> bool:
     """Is `interval` fully covered by the union of `covers`?"""
-    pos = interval.start
-    for iv in sorted(covers, key=lambda i: (i.start, -i.end)):
-        if iv.start > pos:
-            return False
-        pos = max(pos, iv.end)
-        if pos >= interval.end:
-            return True
-    return pos >= interval.end
+    return any(iv.contains_interval(interval) for iv in condense(covers))

@@ -68,16 +68,14 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
         return KeyDim(spec.dimension, col.cardinality, None), col.dictionary.values
 
     cache_key = ("keydim", spec.dimension,
-                 json.dumps(fn.to_json(), sort_keys=True) if fn else None,
+                 json.dumps(fn.cache_key(), sort_keys=True) if fn else None,
                  tuple(sorted(whitelist)) if whitelist is not None else None,
                  is_white)
 
     def _compute():
-        outs = []
-        for v in col.dictionary.values:
-            o = fn.apply(v) if fn else v
-            o = "" if o is None else str(o)
-            outs.append(o)
+        vals = col.dictionary.values
+        raw = fn.apply_all(vals) if fn else vals
+        outs = ["" if o is None else str(o) for o in raw]
         keep = [True] * len(outs)
         if whitelist is not None:
             for i, o in enumerate(outs):

@@ -704,9 +704,14 @@ def make_row_matcher(flt: F.DimFilter):
         expr = parse_expression(flt.expression)
 
         def ex_match(row):
-            # None ≡ "" — the same null contract as every other row matcher
-            out = expr.evaluate({k: ("" if v is None else v)
-                                 for k, v in row.items()})
+            # None ≡ "" — the same null contract as every other row matcher.
+            # A numeric expr over a null-bound column raises (e.g. "" > 2);
+            # such rows simply don't match, as in the reference.
+            try:
+                out = expr.evaluate({k: ("" if v is None else v)
+                                     for k, v in row.items()})
+            except (TypeError, ValueError):
+                return False
             try:
                 return bool(float(out))
             except (TypeError, ValueError):

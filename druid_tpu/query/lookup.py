@@ -1,0 +1,81 @@
+"""Process-wide named lookup registry.
+
+Reference analog: query/lookup/LookupExtractorFactoryContainerProvider +
+LookupReferencesManager (server-side registry of named key→value maps,
+versioned, distributed by the coordinator — server/lookup/cache/
+LookupCoordinatorManager.java). Here: an in-process versioned registry; the
+cluster layer distributes lookup definitions to nodes the same way the
+coordinator pushes them over HTTP.
+
+Lookups are applied host-side over dictionaries (O(cardinality)), never on
+device — see ExtractionFn in druid_tpu/query/model.py.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class LookupContainer:
+    """A named lookup version (reference: LookupExtractorFactoryContainer)."""
+    name: str
+    mapping: Dict[str, str]
+    version: str = "v0"
+
+
+class LookupReferencesManager:
+    """Thread-safe registry of named lookups with versioned replace."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lookups: Dict[str, LookupContainer] = {}
+
+    def add(self, name: str, mapping: Dict[str, str],
+            version: str = "v0") -> bool:
+        """Register/replace; a replace with a version <= current is a no-op
+        (mirrors LookupReferencesManager version-gated updates)."""
+        with self._lock:
+            cur = self._lookups.get(name)
+            if cur is not None and version <= cur.version:
+                return False
+            self._lookups[name] = LookupContainer(name, dict(mapping), version)
+            return True
+
+    def remove(self, name: str) -> bool:
+        with self._lock:
+            return self._lookups.pop(name, None) is not None
+
+    def get(self, name: str) -> Optional[LookupContainer]:
+        with self._lock:
+            return self._lookups.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._lookups)
+
+    def snapshot(self) -> List[dict]:
+        """Introspection/persistence snapshot (LookupSnapshotTaker analog)."""
+        with self._lock:
+            return [{"name": c.name, "version": c.version, "map": dict(c.mapping)}
+                    for c in self._lookups.values()]
+
+
+_MANAGER = LookupReferencesManager()
+
+
+def lookup_manager() -> LookupReferencesManager:
+    return _MANAGER
+
+
+def register_lookup(name: str, mapping: Dict[str, str],
+                    version: str = "v0") -> bool:
+    return _MANAGER.add(name, mapping, version)
+
+
+def get_lookup(name: str) -> Dict[str, str]:
+    c = _MANAGER.get(name)
+    if c is None:
+        raise KeyError(f"lookup [{name}] not registered")
+    return c.mapping

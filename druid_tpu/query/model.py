@@ -12,6 +12,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from druid_tpu.query import lookup as _lookup_mod
 from druid_tpu.query.aggregators import AggregatorSpec, agg_from_json
 from druid_tpu.query.filters import DimFilter, filter_from_json
 from druid_tpu.query.postaggs import PostAggregator, postagg_from_json
@@ -34,6 +35,18 @@ class ExtractionFn:
 
     def to_json(self) -> dict:
         raise NotImplementedError
+
+    def cache_key(self) -> dict:
+        """Key for per-segment id-remap caches. Defaults to the wire form;
+        fns whose output depends on external state (registered lookups) must
+        mix that state's version in so stale remaps are not served."""
+        return self.to_json()
+
+    def apply_all(self, values):
+        """Batch apply over a dictionary's values (the engine's remap loop).
+        Override where per-call setup (registry resolution) would otherwise
+        repeat O(cardinality) times."""
+        return [self.apply(v) for v in values]
 
 
 @dataclass(frozen=True)
@@ -107,7 +120,138 @@ class LookupExtractionFn(ExtractionFn):
 
     def to_json(self):
         return {"type": "lookup", "lookup": {"type": "map", "map": dict(self.lookup)},
-                "retainMissingValue": self.retain_missing}
+                "retainMissingValue": self.retain_missing,
+                "replaceMissingValueWith": self.replace_missing}
+
+
+@dataclass(frozen=True)
+class StrlenExtractionFn(ExtractionFn):
+    """reference: query/extraction/StrlenExtractionFn.java"""
+    def apply(self, value):
+        return str(len(value)) if value is not None else "0"
+
+    def to_json(self):
+        return {"type": "strlen"}
+
+
+@dataclass(frozen=True)
+class StringFormatExtractionFn(ExtractionFn):
+    """reference: query/extraction/StringFormatExtractionFn.java — %-style
+    format applied to the dim value; nullHandling returnNull|emptyString."""
+    format: str
+    null_handling: str = "nullString"
+
+    def apply(self, value):
+        if value is None:
+            if self.null_handling == "returnNull":
+                return None
+            # nullString renders as Java's "null", emptyString as ""
+            value = "" if self.null_handling == "emptyString" else "null"
+        return self.format % (value,)
+
+    def to_json(self):
+        return {"type": "stringFormat", "format": self.format,
+                "nullHandling": self.null_handling}
+
+
+@dataclass(frozen=True)
+class TimeFormatExtractionFn(ExtractionFn):
+    """reference: query/extraction/TimeFormatExtractionFn.java. Parses the
+    value as an ISO timestamp (or epoch millis) and reformats via strftime;
+    optional granularity truncation first. Joda patterns are mapped to the
+    common strftime subset (yyyy, MM, dd, HH, mm, ss, EEEE, MMMM)."""
+    format: Optional[str] = None
+    granularity: Optional[str] = None
+
+    # longest-pattern-first so e.g. MMMM is not consumed by MM
+    _JODA = (("yyyy", "%Y"), ("MMMM", "%B"), ("MMM", "%b"), ("MM", "%m"),
+             ("dd", "%d"), ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+             ("EEEE", "%A"), ("EEE", "%a"))
+
+    def apply(self, value):
+        import datetime as _dt
+
+        from druid_tpu.utils.intervals import parse_ts, ts_to_iso
+        if value is None:
+            return None
+        try:
+            ms = parse_ts(value)
+        except (ValueError, TypeError):
+            # epoch-millis strings (dictionary values are always str)
+            try:
+                ms = int(value)
+            except (ValueError, TypeError):
+                return None
+        if self.granularity:
+            ms = Granularity.of(self.granularity).bucket_start(ms)
+        if self.format is None:
+            return ts_to_iso(ms)
+        dt = _dt.datetime.fromtimestamp(ms / 1000.0, _dt.timezone.utc)
+        fmt = self.format
+        for joda, std in self._JODA:
+            fmt = fmt.replace(joda, std)
+        return dt.strftime(fmt)
+
+    def to_json(self):
+        return {"type": "timeFormat", "format": self.format,
+                "granularity": self.granularity}
+
+
+@dataclass(frozen=True)
+class CascadeExtractionFn(ExtractionFn):
+    """reference: query/extraction/CascadeExtractionFn.java — chain."""
+    fns: Tuple[ExtractionFn, ...] = ()
+
+    def apply(self, value):
+        for fn in self.fns:
+            value = fn.apply(value)
+        return value
+
+    def apply_all(self, values):
+        for fn in self.fns:
+            values = fn.apply_all(values)
+        return list(values)
+
+    def to_json(self):
+        return {"type": "cascade",
+                "extractionFns": [f.to_json() for f in self.fns]}
+
+    def cache_key(self):
+        return {"type": "cascade",
+                "extractionFns": [f.cache_key() for f in self.fns]}
+
+
+@dataclass(frozen=True)
+class RegisteredLookupExtractionFn(ExtractionFn):
+    """Named lookup resolved against the process-wide lookup registry
+    (reference: query/lookup/RegisteredLookupExtractionFn.java +
+    LookupReferencesManager)."""
+    lookup: str
+    retain_missing: bool = True
+    replace_missing: Optional[str] = None
+
+    def apply(self, value):
+        return self._apply_with(_lookup_mod.get_lookup(self.lookup), value)
+
+    def _apply_with(self, m, value):
+        if value in m:
+            return m[value]
+        return value if self.retain_missing else self.replace_missing
+
+    def apply_all(self, values):
+        m = _lookup_mod.get_lookup(self.lookup)  # resolve registry once
+        return [self._apply_with(m, v) for v in values]
+
+    def to_json(self):
+        return {"type": "registeredLookup", "lookup": self.lookup,
+                "retainMissingValue": self.retain_missing,
+                "replaceMissingValueWith": self.replace_missing}
+
+    def cache_key(self):
+        c = _lookup_mod.lookup_manager().get(self.lookup)
+        j = self.to_json()
+        j["_lookupVersion"] = c.version if c is not None else None
+        return j
 
 
 class DimensionSpec:
@@ -205,6 +349,20 @@ def extractionfn_from_json(j) -> ExtractionFn:
         return LookupExtractionFn(tuple(j["lookup"]["map"].items()),
                                   j.get("retainMissingValue", True),
                                   j.get("replaceMissingValueWith"))
+    if t == "strlen":
+        return StrlenExtractionFn()
+    if t == "stringFormat":
+        return StringFormatExtractionFn(j["format"],
+                                        j.get("nullHandling", "nullString"))
+    if t == "timeFormat":
+        return TimeFormatExtractionFn(j.get("format"), j.get("granularity"))
+    if t == "cascade":
+        return CascadeExtractionFn(
+            tuple(extractionfn_from_json(f) for f in j["extractionFns"]))
+    if t == "registeredLookup":
+        return RegisteredLookupExtractionFn(j["lookup"],
+                                            j.get("retainMissingValue", True),
+                                            j.get("replaceMissingValueWith"))
     raise ValueError(f"unknown extraction fn {t!r}")
 
 

@@ -1,0 +1,63 @@
+"""Extraction fns + registered lookups (reference: query/extraction/*,
+query/lookup/LookupReferencesManager)."""
+import numpy as np
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.lookup import lookup_manager, register_lookup
+from druid_tpu.query.model import (CascadeExtractionFn, ExtractionDimensionSpec,
+                                   GroupByQuery, RegisteredLookupExtractionFn,
+                                   StringFormatExtractionFn, StrlenExtractionFn,
+                                   SubstringExtractionFn, TimeFormatExtractionFn,
+                                   extractionfn_from_json)
+from druid_tpu.query.aggregators import CountAggregator
+from tests.conftest import DAY, rows_as_frame
+
+
+def test_serde_round_trip():
+    fns = [
+        StrlenExtractionFn(),
+        StringFormatExtractionFn("[%s]"),
+        TimeFormatExtractionFn("yyyy-MM-dd", "day"),
+        CascadeExtractionFn((SubstringExtractionFn(0, 2),)),
+        RegisteredLookupExtractionFn("x", False, "?"),
+    ]
+    for fn in fns:
+        j = fn.to_json()
+        assert extractionfn_from_json(j).to_json() == j
+
+
+def test_time_format():
+    fn = TimeFormatExtractionFn("EEEE")
+    assert fn.apply("2026-01-02") == "Friday"
+    fn = TimeFormatExtractionFn(None, "month")
+    assert fn.apply("2026-01-15T10:00:00Z") == "2026-01-01T00:00:00.000Z"
+
+
+def test_registered_lookup_versioning():
+    m = lookup_manager()
+    assert register_lookup("tl", {"a": "1"}, "v1")
+    assert not m.add("tl", {"a": "2"}, "v0")  # stale version rejected
+    assert m.add("tl", {"a": "2"}, "v2")
+    assert m.get("tl").mapping == {"a": "2"}
+    snap = m.snapshot()
+    assert any(s["name"] == "tl" and s["version"] == "v2" for s in snap)
+
+
+def test_groupby_with_registered_lookup(segment):
+    dict_vals = list(segment.dims["dimA"].dictionary.values)
+    m = {dict_vals[0]: "ZERO", dict_vals[1]: "ONE"}
+    register_lookup("dimA-names", m, "v9")
+    q = GroupByQuery.of(
+        "test", [DAY],
+        [ExtractionDimensionSpec("dimA", "named",
+                                 RegisteredLookupExtractionFn("dimA-names"))],
+        [CountAggregator("rows")], granularity="all")
+    rows = QueryExecutor([segment]).run(q)
+    frame = rows_as_frame(segment)
+    got = {r["event"]["named"]: r["event"]["rows"] for r in rows}
+    assert "ZERO" in got and "ONE" in got
+    vals, counts = np.unique(frame["dimA"], return_counts=True)
+    want = {}
+    for v, c in zip(vals, counts):
+        want[m.get(v, v)] = want.get(m.get(v, v), 0) + int(c)
+    assert got == want
