@@ -46,6 +46,26 @@ def _segments_for(segments: Sequence[Segment],
             if any(s.interval.overlaps(iv) for iv in intervals)]
 
 
+def _clamp_to_data(intervals: Sequence[Interval],
+                   segs: Sequence[Segment]) -> List[Interval]:
+    """Intersect query intervals with the extent of the matched segments.
+    The reference never materializes buckets outside segment data (cursors
+    exist per granularity bucket *within* segments —
+    QueryableIndexStorageAdapter.makeCursors); clamping keeps eternity-
+    interval queries from enumerating unbounded bucket ranges."""
+    if not segs:
+        return list(intervals)
+    lo = min(s.min_time for s in segs)
+    hi = max(s.max_time for s in segs) + 1
+    data = Interval(lo, hi)
+    out = []
+    for iv in intervals:
+        x = iv.intersect(data)
+        if x is not None and x.width > 0:
+            out.append(x)
+    return out
+
+
 def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str]]:
     """Build a KeyDim (+ local id -> output value list) for one dimension spec.
 
@@ -162,6 +182,8 @@ def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg):
 def run_timeseries(query: TimeseriesQuery, segments: Sequence[Segment]) -> List[dict]:
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
+    if not query.granularity.is_all:
+        intervals = _clamp_to_data(intervals, segs)
     starts = _bucket_starts(query.granularity, intervals)
     if not segs or len(starts) == 0:
         return []
@@ -212,6 +234,8 @@ def _scalar(v):
 def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
+    if not query.granularity.is_all:
+        intervals = _clamp_to_data(intervals, segs)
     starts = _bucket_starts(query.granularity, intervals)
     if not segs or len(starts) == 0:
         return []
@@ -271,6 +295,8 @@ def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
 def run_groupby(query: GroupByQuery, segments: Sequence[Segment]) -> List[dict]:
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
+    if not query.granularity.is_all:
+        intervals = _clamp_to_data(intervals, segs)
     starts = _bucket_starts(query.granularity, intervals)
     if not segs or len(starts) == 0:
         return []
@@ -374,7 +400,10 @@ def _apply_limit_spec(rows: List[dict], limit_spec: Optional[DefaultLimitSpec],
             descending = c.direction == "descending"
 
             def one_key(row, col=c):
-                v = row["event"].get(col.dimension)
+                # "__timestamp" orders by the granularity bucket (used by
+                # SQL ORDER BY on a FLOOR(__time TO ...) projection)
+                v = row["timestamp"] if col.dimension == "__timestamp" \
+                    else row["event"].get(col.dimension)
                 if col.dimension_order == "numeric" or not isinstance(v, str):
                     try:
                         v = float(v)

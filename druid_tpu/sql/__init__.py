@@ -1,0 +1,14 @@
+"""SQL layer: parser → planner → native queries (reference: sql/ module,
+Calcite-based DruidPlanner → DruidQuery → native query types).
+
+The TPU build replaces Calcite with a self-contained recursive-descent SQL
+parser and a direct planner that picks the native query type exactly like
+DruidQuery.toDruidQuery (sql/.../calcite/rel/DruidQuery.java): scan for
+non-aggregate selects, timeseries for time-bucketed aggregates, topN for
+single-dimension ordered-limited aggregates, groupBy otherwise.
+"""
+from druid_tpu.sql.executor import SqlExecutor
+from druid_tpu.sql.parser import parse_sql
+from druid_tpu.sql.planner import PlannerError, plan_sql
+
+__all__ = ["SqlExecutor", "parse_sql", "plan_sql", "PlannerError"]

@@ -1,0 +1,218 @@
+"""SQL execution entry point.
+
+Reference analog: sql/src/main/java/org/apache/druid/sql/http/SqlResource.java
+(POST /druid/v2/sql) + QueryMaker (runs the planned native query through
+QueryLifecycle and shapes native result sequences back into SQL rows), and
+calcite/schema/DruidSchema.java (table discovery from live segments) +
+the INFORMATION_SCHEMA tables.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from druid_tpu.query.model import (GroupByQuery, ScanQuery, TimeBoundaryQuery,
+                                   TimeseriesQuery, TopNQuery)
+from druid_tpu.sql.parser import Select, parse_sql
+from druid_tpu.sql.planner import (OutputColumn, PlannedQuery, PlannerError,
+                                   SqlSchema, plan_sql)
+from druid_tpu.utils.intervals import ts_to_iso
+
+
+class SqlExecutor:
+    """Plans SQL against the live segment schema and runs it on a
+    QueryExecutor (or any object with .run(query) and .datasources /
+    .segments_of)."""
+
+    def __init__(self, query_executor):
+        self.qe = query_executor
+
+    # ---- schema discovery (DruidSchema analog) ------------------------
+    def schema(self) -> SqlSchema:
+        tables: Dict[str, Dict[str, str]] = {}
+        for ds in self.qe.datasources:
+            cols: Dict[str, str] = {}
+            for seg in self.qe.segments_of(ds):
+                for d in seg.dims:
+                    cols.setdefault(d, "string")
+                for m, col in seg.metrics.items():
+                    t = col.type.value if hasattr(col.type, "value") else str(col.type)
+                    cols.setdefault(m, t)
+            tables[ds] = cols
+        return SqlSchema(tables)
+
+    # ---- entry points --------------------------------------------------
+    def explain(self, sql: str, parameters: Sequence[object] = ()) -> dict:
+        sel = parse_sql(sql, parameters)
+        planned = plan_sql(sel, self.schema())
+        if planned.native is None:
+            return {"queryType": "metadata", "table": planned.meta_table}
+        return planned.native.to_json()
+
+    def execute(self, sql: str, parameters: Sequence[object] = ()
+                ) -> Tuple[List[str], List[list]]:
+        """Returns (column names, rows as lists) — the SQL resource's
+        array-result format."""
+        sel = parse_sql(sql, parameters)
+        if sel.explain:
+            import json as _json
+            planned_json = self.explain(_strip_explain(sql), parameters)
+            return (["PLAN"], [[_json.dumps(planned_json, sort_keys=True)]])
+        planned = plan_sql(sel, self.schema())
+        if planned.meta_table is not None:
+            return self._run_meta(planned)
+        rows = self.qe.run(planned.native)
+        return self._shape(planned, rows)
+
+    def execute_dicts(self, sql: str, parameters: Sequence[object] = ()
+                      ) -> List[dict]:
+        cols, rows = self.execute(sql, parameters)
+        return [dict(zip(cols, r)) for r in rows]
+
+    # ---- result shaping (QueryMaker analog) ---------------------------
+    def _shape(self, planned: PlannedQuery, rows) -> Tuple[List[str], List[list]]:
+        q = planned.native
+        outs = planned.outputs
+        names = [o.alias for o in outs]
+        table: List[list] = []
+        if isinstance(q, TimeseriesQuery):
+            # executor-side ORDER BY (non-time orderings of bucket rows);
+            # sorts the native rows so non-projected order fields work too
+            for fname, desc in reversed(planned.sort_in_executor):
+                rows = sorted(rows, key=lambda r, f=fname:
+                              (r["result"].get(f) is None,
+                               r["result"].get(f) or 0), reverse=desc)
+            for r in rows:
+                table.append(_emit(outs, r["result"], r["timestamp"]))
+        elif isinstance(q, TopNQuery):
+            for r in rows:
+                for entry in r["result"]:
+                    table.append(_emit(outs, entry, r["timestamp"]))
+        elif isinstance(q, GroupByQuery):
+            for r in rows:
+                table.append(_emit(outs, r["event"], r["timestamp"]))
+        elif isinstance(q, TimeBoundaryQuery):
+            for r in rows:
+                table.append([_iso(r["result"].get(o.key)) for o in outs])
+        elif isinstance(q, ScanQuery):
+            for batch in rows:
+                for ev in batch["events"]:
+                    table.append(_emit(outs, ev, ev.get("__time")))
+        else:
+            raise PlannerError(f"cannot shape {type(q).__name__} results")
+        if planned.limit_in_executor is not None or planned.offset_in_executor:
+            off = planned.offset_in_executor
+            lim = planned.limit_in_executor
+            table = table[off:off + lim if lim is not None else None]
+        return names, table
+
+    # ---- INFORMATION_SCHEMA -------------------------------------------
+    def _run_meta(self, planned: PlannedQuery) -> Tuple[List[str], List[list]]:
+        sel = planned.meta_select
+        schema = self.schema()
+        if planned.meta_table == "SCHEMATA":
+            data = [{"CATALOG_NAME": "druid", "SCHEMA_NAME": s}
+                    for s in ("druid", "INFORMATION_SCHEMA")]
+        elif planned.meta_table == "TABLES":
+            data = [{"TABLE_CATALOG": "druid", "TABLE_SCHEMA": "druid",
+                     "TABLE_NAME": t, "TABLE_TYPE": "TABLE"}
+                    for t in sorted(schema.tables)]
+        elif planned.meta_table == "COLUMNS":
+            data = []
+            for t in sorted(schema.tables):
+                cols = [("__time", "TIMESTAMP")] + sorted(
+                    (c, _sql_type(ty)) for c, ty in schema.tables[t].items())
+                for i, (c, ty) in enumerate(cols):
+                    data.append({"TABLE_CATALOG": "druid",
+                                 "TABLE_SCHEMA": "druid", "TABLE_NAME": t,
+                                 "COLUMN_NAME": c, "ORDINAL_POSITION": i + 1,
+                                 "DATA_TYPE": ty,
+                                 "IS_NULLABLE": "YES" if ty == "VARCHAR" else "NO"})
+        else:
+            raise PlannerError(
+                f"unknown INFORMATION_SCHEMA table [{planned.meta_table}]")
+        return _meta_select(sel, data)
+
+
+def _strip_explain(sql: str) -> str:
+    import re
+    return re.sub(r"(?is)^\s*EXPLAIN\s+PLAN\s+FOR\s+", "", sql)
+
+
+def _sql_type(t: str) -> str:
+    return {"string": "VARCHAR", "long": "BIGINT", "float": "FLOAT",
+            "double": "DOUBLE"}.get(t, t.upper())
+
+
+def _iso(v):
+    return ts_to_iso(v) if v is not None else None
+
+
+def _emit(outs: List[OutputColumn], fields: dict, ts) -> list:
+    row = []
+    for o in outs:
+        if o.kind == "time":
+            row.append(_iso(ts))
+        elif o.kind == "constant":
+            row.append(o.constant)
+        elif o.kind == "column" and o.key == "__time":
+            row.append(_iso(fields.get("__time", ts)))
+        else:
+            row.append(fields.get(o.key))
+    return row
+
+
+def _meta_select(sel: Select, data: List[dict]) -> Tuple[List[str], List[list]]:
+    """Evaluate a (restricted) select over an in-memory metadata table:
+    column projections, simple equality/IN where, ORDER BY columns, LIMIT."""
+    from druid_tpu.sql import parser as P
+
+    def match(row, e) -> bool:
+        if e is None:
+            return True
+        if isinstance(e, P.Bin) and e.op == "AND":
+            return match(row, e.left) and match(row, e.right)
+        if isinstance(e, P.Bin) and e.op == "OR":
+            return match(row, e.left) or match(row, e.right)
+        if isinstance(e, P.Un) and e.op == "NOT":
+            return not match(row, e.operand)
+        if isinstance(e, P.Bin) and e.op in ("=", "<>"):
+            l, r = e.left, e.right
+            if isinstance(r, P.Col):
+                l, r = r, l
+            if isinstance(l, P.Col) and isinstance(r, P.Lit):
+                eq = str(row.get(l.name)) == str(r.value)
+                return eq if e.op == "=" else not eq
+        if isinstance(e, P.InExpr) and isinstance(e.operand, P.Col):
+            hit = str(row.get(e.operand.name)) in {str(v.value) for v in e.values}
+            return hit != e.negated
+        if isinstance(e, P.LikeExpr) and isinstance(e.operand, P.Col):
+            import re as _re
+            pat = "^" + "".join(
+                ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+                for ch in str(e.pattern.value)) + "$"
+            hit = bool(_re.match(pat, str(row.get(e.operand.name, ""))))
+            return hit != e.negated
+        raise PlannerError("unsupported WHERE on INFORMATION_SCHEMA")
+
+    rows = [r for r in data if match(r, sel.where)]
+    if sel.order_by:
+        for ob in reversed(sel.order_by):
+            if not isinstance(ob.expr, P.Col):
+                raise PlannerError("ORDER BY columns only on INFORMATION_SCHEMA")
+            rows.sort(key=lambda r: str(r.get(ob.expr.name)),
+                      reverse=ob.descending)
+    if sel.limit is not None:
+        rows = rows[sel.offset:sel.offset + sel.limit]
+    elif sel.offset:
+        rows = rows[sel.offset:]
+
+    if len(sel.items) == 1 and isinstance(sel.items[0].expr, P.Star):
+        names = keys = list(data[0].keys()) if data else []
+    else:
+        names, keys = [], []
+        for it in sel.items:
+            if not isinstance(it.expr, P.Col):
+                raise PlannerError("INFORMATION_SCHEMA projections are columns")
+            names.append(it.alias or it.expr.name)
+            keys.append(it.expr.name)
+    return names, [[r.get(k) for k in keys] for r in rows]

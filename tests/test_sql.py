@@ -1,0 +1,283 @@
+"""SQL layer golden tests, modeled on the reference's CalciteQueryTest
+(sql/src/test/.../calcite/CalciteQueryTest.java:139 — every feature asserted
+as (expected native plan, expected results) against in-process segments)."""
+import json
+
+import numpy as np
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.sql import PlannerError, SqlExecutor, parse_sql, plan_sql
+from tests.conftest import rows_as_frame
+
+
+@pytest.fixture(scope="module")
+def sql(segments):
+    return SqlExecutor(QueryExecutor(segments))
+
+
+@pytest.fixture(scope="module")
+def frames(segments):
+    return [rows_as_frame(s) for s in segments]
+
+
+def _concat(frames, col):
+    return np.concatenate([f[col] for f in frames])
+
+
+# ---------------------------------------------------------------------------
+# plan goldens (query-type selection mirrors DruidQuery.toDruidQuery)
+# ---------------------------------------------------------------------------
+
+PLAN_GOLDENS = [
+    ("SELECT COUNT(*) FROM test", "timeseries"),
+    ("SELECT dimA, COUNT(*) FROM test GROUP BY dimA", "groupBy"),
+    ("SELECT dimA, COUNT(*) c FROM test GROUP BY dimA ORDER BY c DESC LIMIT 5",
+     "topN"),
+    ("SELECT __time, dimA FROM test LIMIT 3", "scan"),
+    ("SELECT MAX(__time) FROM test", "timeBoundary"),
+    ("SELECT FLOOR(__time TO DAY), COUNT(*) FROM test GROUP BY 1",
+     "timeseries"),
+    ("SELECT DISTINCT dimA FROM test", "groupBy"),
+    # ORDER BY dim (not metric) must NOT become topN
+    ("SELECT dimA, COUNT(*) c FROM test GROUP BY dimA ORDER BY dimA LIMIT 5",
+     "groupBy"),
+    # HAVING forces groupBy
+    ("SELECT dimA, COUNT(*) c FROM test GROUP BY dimA HAVING COUNT(*) > 1 "
+     "ORDER BY c DESC LIMIT 5", "groupBy"),
+]
+
+
+@pytest.mark.parametrize("stmt,qtype", PLAN_GOLDENS)
+def test_plan_golden(sql, stmt, qtype):
+    plan = sql.explain(stmt)
+    assert plan["queryType"] == qtype, json.dumps(plan, indent=1)
+
+
+def test_plan_filter_shape(sql):
+    plan = sql.explain("SELECT COUNT(*) FROM test WHERE dimA = 'x' "
+                       "AND metLong >= 5 AND dimB IN ('a','b')")
+    f = plan["filter"]
+    assert f["type"] == "and"
+    types = sorted(x["type"] for x in f["fields"])
+    assert types == ["bound", "in", "selector"]
+
+
+def test_plan_time_interval(sql):
+    plan = sql.explain(
+        "SELECT COUNT(*) FROM test WHERE __time >= TIMESTAMP '2026-01-01' "
+        "AND __time < TIMESTAMP '2026-01-02'")
+    assert plan["intervals"] == ["2026-01-01T00:00:00.000Z/2026-01-02T00:00:00.000Z"]
+    assert plan["filter"] is None
+
+
+# ---------------------------------------------------------------------------
+# result goldens
+# ---------------------------------------------------------------------------
+
+def test_count_star(sql, frames):
+    cols, rows = sql.execute("SELECT COUNT(*) n FROM test")
+    assert cols == ["n"]
+    assert rows == [[sum(len(f["__time"]) for f in frames)]]
+
+
+def test_filtered_sum(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT SUM(metLong) s FROM test WHERE dimA = ?",
+        parameters=[frames[0]["dimA"][0]])
+    v = frames[0]["dimA"][0]
+    want = sum(int(f["metLong"][f["dimA"] == v].sum()) for f in frames)
+    assert rows == [[want]]
+
+
+def test_groupby_results(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT dimA, COUNT(*) n, SUM(metLong) s FROM test "
+        "GROUP BY dimA ORDER BY dimA")
+    a = _concat(frames, "dimA")
+    m = _concat(frames, "metLong")
+    want = []
+    for v in sorted(set(a)):
+        sel = a == v
+        want.append([v, int(sel.sum()), int(m[sel].sum())])
+    assert rows == want
+
+
+def test_topn_matches_groupby(sql):
+    _, t = sql.execute("SELECT dimB, SUM(metLong) s FROM test "
+                       "GROUP BY dimB ORDER BY s DESC LIMIT 7")
+    plan = sql.explain("SELECT dimB, SUM(metLong) s FROM test "
+                       "GROUP BY dimB ORDER BY s DESC LIMIT 7")
+    assert plan["queryType"] == "topN"
+    # same statement forced down the groupBy path via HAVING no-op
+    _, g = sql.execute("SELECT dimB, SUM(metLong) s FROM test GROUP BY dimB "
+                       "HAVING SUM(metLong) > -1 ORDER BY s DESC LIMIT 7")
+    assert [r[0] for r in t] == [r[0] for r in g]
+    assert [pytest.approx(r[1]) for r in t] == [r[1] for r in g]
+
+
+def test_avg_postagg(sql, frames):
+    _, rows = sql.execute("SELECT AVG(metFloat) a FROM test")
+    m = _concat(frames, "metFloat")
+    assert rows[0][0] == pytest.approx(float(m.sum()) / len(m), rel=1e-5)
+
+
+def test_time_floor_day(sql, frames):
+    _, rows = sql.execute("SELECT FLOOR(__time TO DAY) d, COUNT(*) n "
+                          "FROM test GROUP BY 1 ORDER BY d")
+    t = _concat(frames, "__time")
+    days = (t // 86400000) * 86400000
+    want_counts = [int((days == d).sum()) for d in sorted(set(days))]
+    assert [r[1] for r in rows] == want_counts
+    assert rows[0][0].endswith("T00:00:00.000Z")
+
+
+def test_having(sql, frames):
+    _, rows = sql.execute("SELECT dimB, COUNT(*) n FROM test GROUP BY dimB "
+                          "HAVING COUNT(*) > 500 ORDER BY n DESC")
+    b = _concat(frames, "dimB")
+    vals, counts = np.unique(b, return_counts=True)
+    want = sorted([int(c) for c in counts if c > 500], reverse=True)
+    assert [r[1] for r in rows] == want
+
+
+def test_scan_with_filter_and_limit(sql, frames):
+    _, rows = sql.execute(
+        "SELECT __time, dimA, metLong FROM test WHERE metLong > 90 "
+        "ORDER BY __time LIMIT 10")
+    assert len(rows) == 10
+    assert all(r[2] > 90 for r in rows)
+    times = [r[0] for r in rows]
+    assert times == sorted(times)
+
+
+def test_count_distinct_approx(sql, frames):
+    _, rows = sql.execute("SELECT COUNT(DISTINCT dimHi) u FROM test")
+    exact = len(set(_concat(frames, "dimHi")))
+    assert rows[0][0] == pytest.approx(exact, rel=0.05)
+
+
+def test_case_expression_aggregate(sql, frames):
+    _, rows = sql.execute(
+        "SELECT SUM(CASE WHEN metLong > 50 THEN 1 ELSE 0 END) hi FROM test")
+    m = _concat(frames, "metLong")
+    assert rows[0][0] == pytest.approx(int((m > 50).sum()))
+
+
+def test_filter_clause_aggregate(sql, frames):
+    _, rows = sql.execute(
+        "SELECT COUNT(*) FILTER (WHERE metLong > 50) hi, COUNT(*) n FROM test")
+    m = _concat(frames, "metLong")
+    assert rows[0] == [int((m > 50).sum()), len(m)]
+
+
+def test_between_and_bounds(sql, frames):
+    _, rows = sql.execute(
+        "SELECT COUNT(*) n FROM test WHERE metLong BETWEEN 10 AND 20")
+    m = _concat(frames, "metLong")
+    assert rows[0][0] == int(((m >= 10) & (m <= 20)).sum())
+
+
+def test_arithmetic_over_aggs(sql, frames):
+    _, rows = sql.execute("SELECT SUM(metLong) / COUNT(*) r FROM test")
+    m = _concat(frames, "metLong")
+    assert rows[0][0] == pytest.approx(float(m.sum()) / len(m))
+
+
+def test_substring_group(sql, frames):
+    _, rows = sql.execute("SELECT SUBSTRING(dimA, 1, 6) p, COUNT(*) n "
+                          "FROM test GROUP BY 1 ORDER BY p")
+    a = _concat(frames, "dimA")
+    pre = np.asarray([v[:6] for v in a])
+    want = [[v, int((pre == v).sum())] for v in sorted(set(pre))]
+    assert rows == want
+
+
+def test_min_max_time_boundary(sql, frames):
+    _, rows = sql.execute("SELECT MIN(__time) mn, MAX(__time) mx FROM test")
+    t = _concat(frames, "__time")
+    from druid_tpu.utils.intervals import ts_to_iso
+    assert rows == [[ts_to_iso(int(t.min())), ts_to_iso(int(t.max()))]]
+
+
+def test_information_schema(sql):
+    _, rows = sql.execute("SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
+    assert rows == [["test"]]
+    _, rows = sql.execute(
+        "SELECT COLUMN_NAME, DATA_TYPE FROM INFORMATION_SCHEMA.COLUMNS "
+        "WHERE TABLE_NAME = 'test' AND DATA_TYPE = 'VARCHAR'")
+    names = [r[0] for r in rows]
+    assert "dimA" in names and "dimB" in names and "metLong" not in names
+
+
+def test_planner_errors(sql):
+    with pytest.raises(PlannerError):
+        sql.execute("SELECT nosuchcol FROM test")
+    with pytest.raises(PlannerError):
+        sql.execute("SELECT * FROM nosuchtable")
+    with pytest.raises(PlannerError):
+        sql.execute("SELECT dimA FROM test ORDER BY dimA")  # scan orders by time only
+
+
+def test_count_col_with_filter_clause(sql, frames):
+    # COUNT(col) FILTER (WHERE ...) must AND both predicates
+    _, rows = sql.execute(
+        "SELECT COUNT(dimA) FILTER (WHERE metLong > 50) c FROM test")
+    m = _concat(frames, "metLong")
+    a = _concat(frames, "dimA")
+    want = int(((m > 50) & (a != "")).sum())
+    assert rows[0][0] == want
+
+
+def test_timeseries_order_by_agg(sql, frames):
+    _, rows = sql.execute(
+        "SELECT FLOOR(__time TO DAY) d, SUM(metLong) s FROM test "
+        "GROUP BY 1 ORDER BY s DESC LIMIT 1")
+    t = _concat(frames, "__time")
+    m = _concat(frames, "metLong")
+    days = (t // 86400000) * 86400000
+    best = max(sorted(set(days)), key=lambda d: m[days == d].sum())
+    from druid_tpu.utils.intervals import ts_to_iso
+    assert rows == [[ts_to_iso(int(best)),
+                     pytest.approx(int(m[days == best].sum()))]]
+
+
+def test_time_between(sql, frames):
+    _, rows = sql.execute(
+        "SELECT COUNT(*) n FROM test WHERE __time BETWEEN "
+        "TIMESTAMP '2026-01-01' AND TIMESTAMP '2026-01-02'")
+    t = _concat(frames, "__time")
+    lo, hi = 1767225600000, 1767312000000
+    assert rows[0][0] == int(((t >= lo) & (t <= hi)).sum())
+
+
+def test_time_bound_under_or(sql, frames):
+    # __time comparison that can't become an interval → numeric bound filter
+    _, rows = sql.execute(
+        "SELECT COUNT(*) n FROM test WHERE "
+        "__time >= TIMESTAMP '2026-01-03' OR dimA = 'nope'")
+    t = _concat(frames, "__time")
+    assert rows[0][0] == int((t >= 1767398400000).sum())
+
+
+def test_contradictory_time_range_empty(sql):
+    _, rows = sql.execute(
+        "SELECT COUNT(*) n FROM test WHERE __time >= TIMESTAMP '2026-02-01' "
+        "AND __time < TIMESTAMP '2026-01-01'")
+    assert rows == []
+
+
+def test_floor_to_unit_outside_groupby_rejected(sql):
+    with pytest.raises(PlannerError):
+        sql.execute("SELECT COUNT(*) FROM test "
+                    "WHERE FLOOR(__time TO DAY) = TIMESTAMP '2026-01-01'")
+
+
+def test_parse_errors():
+    from druid_tpu.sql.parser import SqlParseError
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT FROM x")
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a FROM t WHERE")
+    with pytest.raises(SqlParseError):
+        parse_sql("SELECT a FROM t extra garbage ,")
