@@ -1,3 +1,11 @@
+from druid_tpu.cluster.broker import Broker, MissingSegmentsError
+from druid_tpu.cluster.cache import CacheConfig, LruCache
+from druid_tpu.cluster.coordinator import (Coordinator, DynamicConfig,
+                                           ForeverDropRule, ForeverLoadRule,
+                                           IntervalDropRule, IntervalLoadRule,
+                                           PeriodDropRule, PeriodLoadRule,
+                                           rule_from_json)
+from druid_tpu.cluster.metadata import MetadataStore, SegmentDescriptor
 from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
                                          LinearShardSpec, NoneShardSpec,
                                          NumberedShardSpec, ShardSpec,
@@ -6,10 +14,16 @@ from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
 from druid_tpu.cluster.timeline import (PartitionChunk, PartitionHolder,
                                         TimelineObjectHolder,
                                         VersionedIntervalTimeline)
+from druid_tpu.cluster.view import DataNode, InventoryView, descriptor_for
 
 __all__ = [
     "ShardSpec", "NoneShardSpec", "LinearShardSpec", "NumberedShardSpec",
     "HashBasedNumberedShardSpec", "SingleDimensionShardSpec",
     "shardspec_from_json", "PartitionChunk", "PartitionHolder",
     "TimelineObjectHolder", "VersionedIntervalTimeline",
+    "MetadataStore", "SegmentDescriptor", "DataNode", "InventoryView",
+    "descriptor_for", "Broker", "MissingSegmentsError", "LruCache",
+    "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",
+    "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
+    "IntervalDropRule", "rule_from_json",
 ]

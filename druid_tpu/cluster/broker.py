@@ -1,0 +1,326 @@
+"""Broker: cluster-wide scatter-gather query execution.
+
+Reference analog: CachingClusteredClient (client/CachingClusteredClient.java:93
+— the broker's QuerySegmentWalker): timeline lookup (computeSegmentsToQuery
+:290) → shard pruning → cache probe (pruneSegmentsWithCachedResults :397) →
+group by server → per-server fan-out (addSequencesFromServer :536) → merge;
+plus RetryQueryRunner (query/RetryQueryRunner.java:71 — re-fans-out segments
+reported missing) and ResultLevelCachingQueryRunner.
+
+TPU-first difference from the reference: data nodes return *partial
+aggregation states* (AggregatePartials — dense per-key arrays), and the
+broker merge is the same vectorized sparse-merge used across segments
+(druid_tpu/engine/merge.py) — HLL and sketch merges stay exact because
+states, not finalized estimates, cross the node boundary. Within one host
+the same states would merge on-device via collectives (druid_tpu/parallel/).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from druid_tpu.cluster.cache import (CacheConfig, LruCache, query_cache_key,
+                                     result_level_key)
+from druid_tpu.cluster.metadata import SegmentDescriptor
+from druid_tpu.cluster.view import InventoryView, _is_aggregate
+from druid_tpu.engine import engines
+from druid_tpu.engine.engines import AggregatePartials
+from druid_tpu.query import filters as F
+from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery,
+                                   Query, ScanQuery, SearchQuery,
+                                   SegmentMetadataQuery, SelectQuery,
+                                   TimeBoundaryQuery, TimeseriesQuery,
+                                   TopNQuery, query_from_json)
+from druid_tpu.utils.intervals import Interval, condense
+
+
+class MissingSegmentsError(RuntimeError):
+    def __init__(self, segment_ids: Sequence[str]):
+        super().__init__(f"segments not served after retries: "
+                         f"{sorted(segment_ids)}")
+        self.segment_ids = sorted(segment_ids)
+
+
+def _filter_domain(flt) -> Dict[str, List[Optional[str]]]:
+    """Extract dim → candidate-values constraints for shard pruning
+    (the broker's hash-pruning of secondary partitions)."""
+    if isinstance(flt, F.SelectorFilter):
+        return {flt.dimension: [flt.value]}
+    if isinstance(flt, F.InFilter):
+        return {flt.dimension: list(flt.values)}
+    if isinstance(flt, F.AndFilter):
+        out: Dict[str, List[Optional[str]]] = {}
+        for f in flt.fields:
+            for d, vals in _filter_domain(f).items():
+                if d in out:
+                    out[d] = [v for v in out[d] if v in set(vals)]
+                else:
+                    out[d] = vals
+        return out
+    return {}
+
+
+class Broker:
+    """QuerySegmentWalker over the cluster. Also provides the QueryExecutor
+    surface (run / run_json / datasources / segments_of) so SqlExecutor can
+    plan and execute cluster-wide."""
+
+    def __init__(self, view: InventoryView,
+                 cache: Optional[LruCache] = None,
+                 cache_config: Optional[CacheConfig] = None,
+                 max_retries: int = 2, seed: int = 0,
+                 max_threads: int = 8):
+        self.view = view
+        self.cache = cache
+        self.cache_config = cache_config or CacheConfig()
+        self.max_retries = max_retries
+        self.rng = random.Random(seed)
+        self.max_threads = max_threads
+        self._lock = threading.Lock()
+
+    # ---- QueryExecutor-compatible surface ------------------------------
+    @property
+    def datasources(self) -> List[str]:
+        return self.view.datasources()
+
+    def segments_of(self, datasource: str):
+        """Segment objects for schema discovery. In-process convenience —
+        a multi-host deployment answers this with segmentMetadata queries
+        (DruidSchema does exactly that)."""
+        out, seen = [], set()
+        for node in self.view.nodes():
+            for s in node.segments():
+                if s.id.datasource == datasource and str(s.id) not in seen:
+                    seen.add(str(s.id))
+                    out.append(s)
+        return out
+
+    def run_json(self, j: dict):
+        return self.run(query_from_json(j))
+
+    # ---- the signature path (§3.1) -------------------------------------
+    def run(self, query: Query):
+        segments = self._segments_to_query(query)
+        if not segments:
+            return []
+        if _is_aggregate(query):
+            return self._run_aggregate(query, segments)
+        return self._run_rows(query, segments)
+
+    def _segments_to_query(self, query: Query) -> List[SegmentDescriptor]:
+        """Timeline lookup + shard pruning (computeSegmentsToQuery)."""
+        tl = self.view.timeline(query.datasource)
+        if tl is None:
+            return []
+        domain = _filter_domain(query.filter) if query.filter is not None else {}
+        out, seen = [], set()
+        for iv in condense(query.intervals):
+            for holder in tl.lookup(iv):
+                for chunk in holder.partitions:
+                    rs = chunk.obj
+                    d = rs.descriptor
+                    if d.id in seen:
+                        continue
+                    seen.add(d.id)
+                    if domain and d.shard_spec is not None \
+                            and not d.shard_spec.possible_in_domain(domain):
+                        continue
+                    out.append(d)
+        return out
+
+    # ---- aggregate path: partials + broker-side finish -----------------
+    def _run_aggregate(self, query: Query,
+                       segments: List[SegmentDescriptor]):
+        use_rcache = (self.cache is not None
+                      and self.cache_config.cacheable(query)
+                      and self.cache_config.use_result_cache)
+        rkey = None
+        if use_rcache:
+            rkey = result_level_key(
+                query, [f"{d.id}" for d in segments])
+            hit = self.cache.get("result", rkey)
+            if hit is not None:
+                return hit
+
+        # bound intervals by the queried segments' extents so every node
+        # (and the broker finish) shares one finite bucket index space;
+        # granularity "all" has a single bucket stamped with the query
+        # interval start — leave it unbounded so the timestamp matches
+        # single-process execution
+        q2 = query
+        if not query.granularity.is_all:
+            lo = min(d.interval.start for d in segments)
+            hi = max(d.interval.end for d in segments)
+            bounded = []
+            for iv in condense(query.intervals):
+                x = iv.intersect(Interval(lo, hi))
+                if x is not None and x.width > 0:
+                    bounded.append(x)
+            if not bounded:
+                return []
+            q2 = replace(query, intervals=tuple(bounded))
+
+        parts = self._scatter(q2, segments, rows_mode=False)
+        ap = AggregatePartials.concat(parts)
+        if isinstance(query, TimeseriesQuery):
+            rows = engines.finish_timeseries(q2, ap)
+        elif isinstance(query, TopNQuery):
+            rows = engines.finish_topn(q2, ap)
+        elif isinstance(query, GroupByQuery):
+            rows = engines.finish_groupby(q2, ap)
+        else:  # pragma: no cover
+            raise TypeError(type(query).__name__)
+        if use_rcache and self.cache_config.populate_result_cache:
+            self.cache.put("result", rkey, rows)
+        return rows
+
+    # ---- row path -------------------------------------------------------
+    def _run_rows(self, query: Query, segments: List[SegmentDescriptor]):
+        q2 = query
+        if isinstance(query, ScanQuery) and (query.limit is not None
+                                             or query.offset):
+            # nodes can't apply the global offset; ask for offset+limit rows
+            # (unlimited when limit is None) and apply offset at the broker
+            lim = None if query.limit is None else query.limit + query.offset
+            q2 = replace(query, limit=lim, offset=0)
+        results = self._scatter(q2, segments, rows_mode=True)
+        return self._merge_rows(query, results, segments)
+
+    # ---- scatter + retry (RetryQueryRunner) ----------------------------
+    def _scatter(self, query: Query, segments: List[SegmentDescriptor],
+                 rows_mode: bool):
+        pending: Dict[str, SegmentDescriptor] = {d.id: d for d in segments}
+        tried: Dict[str, Set[str]] = {d.id: set() for d in segments}
+        gathered = []
+        for _ in range(self.max_retries + 1):
+            if not pending:
+                break
+            # group by chosen server
+            by_server: Dict[str, List[str]] = {}
+            unassigned = []
+            for sid, d in pending.items():
+                rs = self.view.replica_set(sid)
+                server = rs.pick(self.rng, exclude=tried[sid]) if rs else None
+                if server is None:
+                    unassigned.append(sid)
+                else:
+                    by_server.setdefault(server, []).append(sid)
+            if not by_server:
+                break
+
+            def run_one(item):
+                server, sids = item
+                node = self.view.node(server)
+                if node is None:
+                    return server, sids, None, set()
+                try:
+                    if rows_mode:
+                        rows, served = node.run_rows(query, sids)
+                        return server, sids, rows, served
+                    ap, served = node.run_partials(query, sids)
+                    return server, sids, ap, served
+                except ConnectionError:
+                    return server, sids, None, set()
+
+            with ThreadPoolExecutor(max_workers=self.max_threads) as pool:
+                outcomes = list(pool.map(run_one, by_server.items()))
+
+            for server, sids, result, served in outcomes:
+                for sid in sids:
+                    tried[sid].add(server)
+                if result is not None:
+                    gathered.append(result)
+                for sid in served:
+                    pending.pop(sid, None)
+        if pending:
+            raise MissingSegmentsError(list(pending))
+        return gathered
+
+    # ---- row merges (QueryToolChest.mergeResults analogs) --------------
+    def _merge_rows(self, query: Query, results: List[List[dict]],
+                    segments: List[SegmentDescriptor]):
+        if isinstance(query, ScanQuery):
+            batches = [b for rows in results for b in rows]
+            if query.order != "none":
+                iv_of = {d.id: d.interval.start for d in segments}
+                batches.sort(key=lambda b: iv_of.get(b["segmentId"], 0),
+                             reverse=(query.order == "descending"))
+            if query.limit is not None or query.offset:
+                out, skip = [], query.offset
+                remaining = query.limit
+                for b in batches:
+                    ev = b["events"]
+                    if skip:
+                        if skip >= len(ev):
+                            skip -= len(ev)
+                            continue
+                        ev = ev[skip:]
+                        skip = 0
+                    if remaining is not None:
+                        ev = ev[:remaining]
+                        remaining -= len(ev)
+                    if ev:
+                        out.append({**b, "events": ev})
+                    if remaining is not None and remaining <= 0:
+                        break
+                batches = out
+            return batches
+        if isinstance(query, TimeBoundaryQuery):
+            mn, mx = None, None
+            for rows in results:
+                for r in rows:
+                    res = r["result"]
+                    if "minTime" in res:
+                        mn = res["minTime"] if mn is None \
+                            else min(mn, res["minTime"])
+                    if "maxTime" in res:
+                        mx = res["maxTime"] if mx is None \
+                            else max(mx, res["maxTime"])
+            if mn is None and mx is None:
+                return []
+            result = {}
+            if query.bound in (None, "minTime"):
+                result["minTime"] = mn
+            if query.bound in (None, "maxTime"):
+                result["maxTime"] = mx
+            ts = mn if query.bound != "maxTime" else mx
+            return [{"timestamp": ts, "result": result}]
+        if isinstance(query, SearchQuery):
+            hits: Dict[Tuple[str, str], int] = {}
+            ts = None
+            for rows in results:
+                for r in rows:
+                    ts = r["timestamp"] if ts is None \
+                        else min(ts, r["timestamp"])
+                    for e in r["result"]:
+                        key = (e["dimension"], e["value"])
+                        hits[key] = hits.get(key, 0) + e["count"]
+            if not hits:
+                return []
+            entries = [{"dimension": d, "value": v, "count": c}
+                       for (d, v), c in hits.items()]
+            if query.sort == "strlen":
+                entries.sort(key=lambda e: (len(e["value"]), e["value"],
+                                            e["dimension"]))
+            else:
+                entries.sort(key=lambda e: (e["value"], e["dimension"]))
+            return [{"timestamp": ts, "result": entries[: query.limit]}]
+        if isinstance(query, (SegmentMetadataQuery, SelectQuery)):
+            merged: List[dict] = []
+            for rows in results:
+                merged += rows
+            return merged
+        if isinstance(query, DataSourceMetadataQuery):
+            best = None
+            for rows in results:
+                for r in rows:
+                    t = r["result"].get("maxIngestedEventTime")
+                    if best is None or (t is not None and t > best):
+                        best = t
+            return [] if best is None else \
+                [{"timestamp": best,
+                  "result": {"maxIngestedEventTime": best}}]
+        raise TypeError(f"cannot merge {type(query).__name__}")

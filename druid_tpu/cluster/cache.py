@@ -1,0 +1,102 @@
+"""Query caches.
+
+Reference analogs: client/cache/Cache.java SPI with Caffeine local cache
+(client/cache/CaffeineCache.java) + CacheConfig; used at the segment level
+by the historical's CachingQueryRunner and at the result level by the
+broker's ResultLevelCachingQueryRunner. Cache keys come from per-query-type
+CacheStrategy (query/CacheStrategy.java).
+
+Here: an LRU local cache keyed by (namespace, key). Segment-level entries
+hold per-segment partial states (exact merges — the analog of caching
+non-finalized per-segment results); result-level entries hold final rows,
+keyed by the query plus the exact segment-version set so any timeline
+change (new version, compaction) invalidates naturally (the reference's
+etag mechanism).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Sequence, Tuple
+
+
+class CacheStats:
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.puts = 0
+
+
+class LruCache:
+    """Thread-safe LRU with entry-count bound (Cache SPI analog)."""
+
+    def __init__(self, max_entries: int = 10_000):
+        self.max_entries = max_entries
+        self._data: "OrderedDict[Tuple[str, str], object]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.stats = CacheStats()
+
+    def get(self, namespace: str, key: str):
+        with self._lock:
+            k = (namespace, key)
+            if k in self._data:
+                self._data.move_to_end(k)
+                self.stats.hits += 1
+                return self._data[k]
+            self.stats.misses += 1
+            return None
+
+    def put(self, namespace: str, key: str, value) -> None:
+        with self._lock:
+            k = (namespace, key)
+            self._data[k] = value
+            self._data.move_to_end(k)
+            self.stats.puts += 1
+            while len(self._data) > self.max_entries:
+                self._data.popitem(last=False)
+                self.stats.evictions += 1
+
+    def invalidate_namespace(self, namespace: str) -> int:
+        with self._lock:
+            doomed = [k for k in self._data if k[0] == namespace]
+            for k in doomed:
+                del self._data[k]
+            return len(doomed)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._data)
+
+
+class CacheConfig:
+    """Which levels populate/use cache (reference: CacheConfig +
+    CacheStrategy.isCacheable per query type)."""
+
+    UNCACHEABLE = {"scan", "select", "dataSourceMetadata"}
+
+    def __init__(self, use_segment_cache: bool = True,
+                 populate_segment_cache: bool = True,
+                 use_result_cache: bool = True,
+                 populate_result_cache: bool = True):
+        self.use_segment_cache = use_segment_cache
+        self.populate_segment_cache = populate_segment_cache
+        self.use_result_cache = use_result_cache
+        self.populate_result_cache = populate_result_cache
+
+    def cacheable(self, query) -> bool:
+        return query.query_type not in self.UNCACHEABLE
+
+
+def query_cache_key(query) -> str:
+    """Canonical per-query cache key from the wire format, excluding
+    context (reference: per-toolchest computeCacheKey)."""
+    j = query.to_json()
+    j.pop("context", None)
+    return json.dumps(j, sort_keys=True)
+
+
+def result_level_key(query, segment_versions: Sequence[str]) -> str:
+    """Result-level key: query + exact segment-id/version set (etag)."""
+    return query_cache_key(query) + "|" + ",".join(sorted(segment_versions))

@@ -1,0 +1,351 @@
+"""Coordinator: the cluster control loop.
+
+Reference analogs (server/src/main/java/org/apache/druid/server/coordinator/):
+  DruidCoordinator.java:95            — leader control loop
+  helper/DruidCoordinatorRuleRunner   — apply load/drop rules
+  rules/LoadRule.java, PeriodLoadRule, IntervalLoadRule, ForeverLoadRule,
+  *DropRule                           — retention rules
+  CostBalancerStrategy.java           — segment placement cost
+  helper/DruidCoordinatorBalancer     — move segments between nodes
+  ReplicationThrottler.java           — bound replica creation per run
+  "markAsUnusedOvershadowedSegments"  — MVCC cleanup of overshadowed versions
+  CoordinatorDynamicConfig.java       — runtime knobs
+
+One `run_once()` = one coordinator period. Segments are pulled from a
+`segment_source` (the deep-storage puller analog — see
+druid_tpu/storage/format.py for the on-disk source) and announced into the
+InventoryView, which is what the broker routes by.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from druid_tpu.cluster.metadata import MetadataStore, SegmentDescriptor
+from druid_tpu.cluster.timeline import PartitionChunk, VersionedIntervalTimeline
+from druid_tpu.cluster.view import DataNode, InventoryView
+from druid_tpu.cluster.shardspec import NoneShardSpec
+from druid_tpu.data.segment import Segment
+from druid_tpu.utils.intervals import Interval
+
+MS_PER_DAY = 86_400_000
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+class Rule:
+    def applies(self, d: SegmentDescriptor, now_ms: int) -> bool:
+        raise NotImplementedError
+
+    def is_load(self) -> bool:
+        raise NotImplementedError
+
+    tiered_replicants: Dict[str, int] = {}
+
+
+@dataclass
+class ForeverLoadRule(Rule):
+    tiered_replicants: Dict[str, int] = field(
+        default_factory=lambda: {"_default_tier": 2})
+
+    def applies(self, d, now_ms):
+        return True
+
+    def is_load(self):
+        return True
+
+
+@dataclass
+class PeriodLoadRule(Rule):
+    """Load segments younger than `period_ms` (measured from segment
+    interval end to now — reference PeriodLoadRule.appliesTo)."""
+    period_ms: int = 30 * MS_PER_DAY
+    include_future: bool = True
+    tiered_replicants: Dict[str, int] = field(
+        default_factory=lambda: {"_default_tier": 2})
+
+    def applies(self, d, now_ms):
+        if d.interval.end >= now_ms - self.period_ms:
+            return self.include_future or d.interval.start <= now_ms
+        return False
+
+    def is_load(self):
+        return True
+
+
+@dataclass
+class IntervalLoadRule(Rule):
+    interval: Interval = None
+    tiered_replicants: Dict[str, int] = field(
+        default_factory=lambda: {"_default_tier": 2})
+
+    def applies(self, d, now_ms):
+        return self.interval.contains_interval(d.interval)
+
+    def is_load(self):
+        return True
+
+
+@dataclass
+class ForeverDropRule(Rule):
+    def applies(self, d, now_ms):
+        return True
+
+    def is_load(self):
+        return False
+
+
+@dataclass
+class PeriodDropRule(Rule):
+    """Drop segments entirely older than `period_ms`."""
+    period_ms: int = 365 * MS_PER_DAY
+
+    def applies(self, d, now_ms):
+        return d.interval.end < now_ms - self.period_ms
+
+    def is_load(self):
+        return False
+
+
+@dataclass
+class IntervalDropRule(Rule):
+    interval: Interval = None
+
+    def applies(self, d, now_ms):
+        return self.interval.contains_interval(d.interval)
+
+    def is_load(self):
+        return False
+
+
+def rule_from_json(j: dict) -> Rule:
+    t = j["type"]
+    reps = j.get("tieredReplicants", {"_default_tier": 2})
+    if t == "loadForever":
+        return ForeverLoadRule(dict(reps))
+    if t == "loadByPeriod":
+        return PeriodLoadRule(int(j.get("periodMs", 30 * MS_PER_DAY)),
+                              j.get("includeFuture", True), dict(reps))
+    if t == "loadByInterval":
+        return IntervalLoadRule(Interval.parse(j["interval"]), dict(reps))
+    if t == "dropForever":
+        return ForeverDropRule()
+    if t == "dropByPeriod":
+        return PeriodDropRule(int(j.get("periodMs", 365 * MS_PER_DAY)))
+    if t == "dropByInterval":
+        return IntervalDropRule(Interval.parse(j["interval"]))
+    raise ValueError(f"unknown rule type {t!r}")
+
+
+DEFAULT_RULES = [ForeverLoadRule()]
+
+
+# ---------------------------------------------------------------------------
+# Placement cost (CostBalancerStrategy)
+# ---------------------------------------------------------------------------
+
+_HALF_LIFE_MS = 7 * MS_PER_DAY
+
+
+def _interval_cost(a: Interval, b: Interval) -> float:
+    """Exponential-decay proximity cost between two segment intervals —
+    co-locating temporally-close segments is expensive because queries hit
+    them together (the insight of CostBalancerStrategy.computeJointSegmentsCost)."""
+    gap = max(b.start - a.end, a.start - b.end, 0)
+    return math.exp(-gap / _HALF_LIFE_MS)
+
+
+def placement_cost(d: SegmentDescriptor, server_segments:
+                   Sequence[SegmentDescriptor]) -> float:
+    cost = 0.0
+    for s in server_segments:
+        c = _interval_cost(d.interval, s.interval)
+        if s.datasource == d.datasource:
+            c *= 2.0
+        cost += c
+    return cost
+
+
+@dataclass
+class DynamicConfig:
+    """CoordinatorDynamicConfig analog."""
+    max_segments_to_move: int = 5
+    replication_throttle_limit: int = 10
+    max_non_primary_replicants: int = 10_000
+
+
+@dataclass
+class CoordinatorStats:
+    assigned: int = 0
+    dropped: int = 0
+    moved: int = 0
+    overshadowed_marked: int = 0
+    deleted: int = 0
+    unassigned: int = 0
+
+
+class Coordinator:
+    """Single-leader control loop (leadership election is trivial in-process;
+    multi-coordinator HA would take the same leader-latch approach as the
+    reference's CuratorDruidLeaderSelector)."""
+
+    def __init__(self, metadata: MetadataStore, view: InventoryView,
+                 segment_source: Callable[[SegmentDescriptor], Segment],
+                 config: Optional[DynamicConfig] = None):
+        self.metadata = metadata
+        self.view = view
+        self.segment_source = segment_source
+        self.config = config or DynamicConfig()
+
+    # ---- one coordinator period ---------------------------------------
+    def run_once(self, now_ms: Optional[int] = None) -> CoordinatorStats:
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        stats = CoordinatorStats()
+        self._mark_overshadowed(stats)
+        used = self.metadata.used_segments()
+        self._run_rules(used, now_ms, stats)
+        self._balance(stats)
+        return stats
+
+    # ---- MVCC cleanup ---------------------------------------------------
+    def _mark_overshadowed(self, stats: CoordinatorStats) -> None:
+        """Build a metadata timeline per datasource and mark fully
+        overshadowed segments unused (atomic replacement completion)."""
+        by_ds: Dict[str, List[SegmentDescriptor]] = {}
+        for d in self.metadata.used_segments():
+            by_ds.setdefault(d.datasource, []).append(d)
+        for ds, descs in by_ds.items():
+            tl: VersionedIntervalTimeline = VersionedIntervalTimeline()
+            for d in descs:
+                spec = d.shard_spec or NoneShardSpec(d.partition)
+                tl.add(d.interval, d.version, PartitionChunk(spec, d))
+            doomed = []
+            for holder in tl.find_fully_overshadowed():
+                doomed += [c.obj.id for c in holder.partitions]
+            if doomed:
+                stats.overshadowed_marked += self.metadata.mark_unused(doomed)
+
+    # ---- rules ----------------------------------------------------------
+    def _rules_for(self, datasource: str) -> List[Rule]:
+        payload = self.metadata.rules_for(datasource)
+        if not payload:
+            return list(DEFAULT_RULES)
+        return [rule_from_json(j) for j in payload]
+
+    def _nodes_by_tier(self) -> Dict[str, List[DataNode]]:
+        tiers: Dict[str, List[DataNode]] = {}
+        for n in self.view.nodes():
+            tiers.setdefault(n.tier, []).append(n)
+        return tiers
+
+    def _run_rules(self, used: List[SegmentDescriptor], now_ms: int,
+                   stats: CoordinatorStats) -> None:
+        tiers = self._nodes_by_tier()
+        served_by: Dict[str, List[SegmentDescriptor]] = {
+            n.name: self.view.served_segments(n.name)
+            for ns in tiers.values() for n in ns}
+        replicas_created = 0
+        rules_cache: Dict[str, List[Rule]] = {}
+        for d in used:
+            rules = rules_cache.get(d.datasource)
+            if rules is None:
+                rules = rules_cache[d.datasource] = \
+                    self._rules_for(d.datasource)
+            rule = next((r for r in rules if r.applies(d, now_ms)), None)
+            if rule is None or not rule.is_load():
+                # drop from every server holding it
+                rs = self.view.replica_set(d.id)
+                if rs is not None:
+                    for server in sorted(rs.servers):
+                        node = self.view.node(server)
+                        if node is not None:
+                            node.drop_segment(d.id)
+                        self.view.unannounce(server, d.id)
+                        stats.dropped += 1
+                continue
+            rs = self.view.replica_set(d.id)
+            holders = set(rs.servers) if rs is not None else set()
+            for tier, wanted in rule.tiered_replicants.items():
+                nodes = tiers.get(tier, [])
+                tier_holders = [n for n in nodes if n.name in holders]
+                deficit = wanted - len(tier_holders)
+                # drop excess replicas (from the costliest server)
+                while deficit < 0 and tier_holders:
+                    victim = tier_holders.pop()
+                    victim.drop_segment(d.id)
+                    self.view.unannounce(victim.name, d.id)
+                    served_by[victim.name] = [
+                        s for s in served_by[victim.name] if s.id != d.id]
+                    stats.dropped += 1
+                    deficit += 1
+                # assign missing replicas, throttled
+                candidates = [n for n in nodes if n.name not in holders]
+                while deficit > 0 and candidates:
+                    is_primary = not holders
+                    if not is_primary and \
+                            replicas_created >= self.config.replication_throttle_limit:
+                        break
+                    best = min(candidates, key=lambda n: placement_cost(
+                        d, served_by[n.name]))
+                    if not self._load_on(best, d):
+                        candidates.remove(best)
+                        continue
+                    served_by[best.name].append(d)
+                    holders.add(best.name)
+                    candidates.remove(best)
+                    stats.assigned += 1
+                    if not is_primary:
+                        replicas_created += 1
+                    deficit -= 1
+                if deficit > 0:
+                    stats.unassigned += deficit
+
+    def _load_on(self, node: DataNode, d: SegmentDescriptor) -> bool:
+        segment = self.segment_source(d)
+        if segment is None or not node.load_segment(segment):
+            return False
+        self.view.announce(node.name, d)
+        return True
+
+    # ---- balancing ------------------------------------------------------
+    def _balance(self, stats: CoordinatorStats) -> None:
+        """Move segments from loaded → underloaded nodes within a tier,
+        min-cost placement (DruidCoordinatorBalancer + CostBalancerStrategy)."""
+        for tier, nodes in self._nodes_by_tier().items():
+            if len(nodes) < 2:
+                continue
+            moves_left = self.config.max_segments_to_move
+            while moves_left > 0:
+                counts = {n.name: n.segment_count() for n in nodes}
+                src = max(nodes, key=lambda n: counts[n.name])
+                dst = min(nodes, key=lambda n: counts[n.name])
+                if counts[src.name] - counts[dst.name] < 2:
+                    break
+                dst_served = self.view.served_segments(dst.name)
+                dst_ids = {d.id for d in dst_served}
+                movable = [d for d in self.view.served_segments(src.name)
+                           if d.id not in dst_ids]
+                if not movable:
+                    break
+                d = min(movable,
+                        key=lambda m: placement_cost(m, dst_served))
+                if not self._load_on(dst, d):
+                    break
+                src.drop_segment(d.id)
+                self.view.unannounce(src.name, d.id)
+                stats.moved += 1
+                moves_left -= 1
+
+    # ---- kill (permanent deletion of unused segments) -------------------
+    def kill_unused(self, datasource: str) -> int:
+        """KillTask analog: permanently delete unused segments' metadata."""
+        with self.metadata._lock:
+            cur = self.metadata._conn.execute(
+                "SELECT id FROM segments WHERE used = 0 AND datasource = ?",
+                (datasource,))
+            ids = [r[0] for r in cur.fetchall()]
+        return self.metadata.delete_segments(ids)

@@ -1,0 +1,330 @@
+"""SQL metadata store (sqlite) — the cluster's source of truth.
+
+Reference analogs:
+  segments table + transactional publish — server/src/main/java/org/apache/
+    druid/metadata/IndexerSQLMetadataStorageCoordinator.java (announceHistorical
+    Segments with dataSource-metadata compare-and-swap = exactly-once streaming
+    publish), MetadataSegmentManager.java (used-segment polling)
+  rules table — metadata/MetadataRuleManager.java
+  audit — server/audit/SQLAuditManager.java
+
+Segments are stored as JSON descriptors (DataSegment analog); payload columns
+keep (datasource, start, end, version, partition, used) queryable. The
+datasource metadata CAS is the exactly-once hook used by streaming ingestion
+(§3.4: offsets and segments commit in one transaction).
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from druid_tpu.cluster.shardspec import NoneShardSpec, ShardSpec, shardspec_from_json
+from druid_tpu.utils.intervals import Interval
+
+
+@dataclass(frozen=True)
+class SegmentDescriptor:
+    """DataSegment analog (api/.../timeline/DataSegment.java): identity +
+    shard spec + size/location metadata, without the column data."""
+    datasource: str
+    interval: Interval
+    version: str
+    partition: int = 0
+    shard_spec: Optional[ShardSpec] = None
+    size_bytes: int = 0
+    num_rows: int = 0
+    load_spec: Optional[dict] = None   # where the segment file lives
+
+    @property
+    def id(self) -> str:
+        return (f"{self.datasource}_{self.interval}_{self.version}"
+                f"_{self.partition}")
+
+    def to_json(self) -> dict:
+        return {"dataSource": self.datasource, "interval": str(self.interval),
+                "version": self.version,
+                "shardSpec": (self.shard_spec.to_json() if self.shard_spec
+                              else {"type": "numbered",
+                                    "partitionNum": self.partition,
+                                    "partitions": 0}),
+                "size": self.size_bytes, "numRows": self.num_rows,
+                "loadSpec": self.load_spec}
+
+    @staticmethod
+    def from_json(j: dict) -> "SegmentDescriptor":
+        spec = shardspec_from_json(j.get("shardSpec"))
+        return SegmentDescriptor(
+            j["dataSource"], Interval.parse(j["interval"]), j["version"],
+            getattr(spec, "partition_num", 0), spec,
+            j.get("size", 0), j.get("numRows", 0), j.get("loadSpec"))
+
+
+class MetadataStore:
+    """sqlite-backed metadata store; ':memory:' for tests, a file path for
+    durability. Thread-safe via one connection + lock (sqlite serializes
+    writers anyway; the reference uses JDBI connection pools)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._create_tables()
+
+    def _create_tables(self):
+        with self._lock, self._conn as c:
+            c.executescript("""
+            CREATE TABLE IF NOT EXISTS segments (
+              id TEXT PRIMARY KEY, datasource TEXT NOT NULL,
+              start INTEGER NOT NULL, end INTEGER NOT NULL,
+              version TEXT NOT NULL, partition_num INTEGER NOT NULL,
+              used INTEGER NOT NULL DEFAULT 1,
+              created_ms INTEGER NOT NULL, payload TEXT NOT NULL);
+            CREATE INDEX IF NOT EXISTS idx_segments_ds
+              ON segments(datasource, used);
+            CREATE TABLE IF NOT EXISTS datasource_metadata (
+              datasource TEXT PRIMARY KEY, commit_metadata TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS rules (
+              datasource TEXT PRIMARY KEY, payload TEXT NOT NULL,
+              updated_ms INTEGER NOT NULL);
+            CREATE TABLE IF NOT EXISTS config (
+              name TEXT PRIMARY KEY, payload TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS audit (
+              id INTEGER PRIMARY KEY AUTOINCREMENT, audit_key TEXT,
+              type TEXT, author TEXT, comment_txt TEXT, created_ms INTEGER,
+              payload TEXT);
+            CREATE TABLE IF NOT EXISTS tasks (
+              id TEXT PRIMARY KEY, datasource TEXT, status TEXT,
+              created_ms INTEGER, payload TEXT);
+            CREATE TABLE IF NOT EXISTS supervisors (
+              id TEXT PRIMARY KEY, payload TEXT NOT NULL);
+            """)
+
+    # ---- segments ------------------------------------------------------
+    def publish_segments(self, descriptors: Sequence[SegmentDescriptor],
+                         datasource_meta_update: Optional[Tuple[str, Optional[dict], dict]] = None
+                         ) -> bool:
+        """Transactionally insert segments; optionally CAS the datasource
+        commit metadata (start_metadata → end_metadata) in the SAME
+        transaction — the exactly-once publish of
+        IndexerSQLMetadataStorageCoordinator.announceHistoricalSegments.
+        Returns False (and commits nothing) if the CAS comparison fails."""
+        now = int(time.time() * 1000)
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                if datasource_meta_update is not None:
+                    ds, expected, new = datasource_meta_update
+                    cur = self._conn.execute(
+                        "SELECT commit_metadata FROM datasource_metadata "
+                        "WHERE datasource = ?", (ds,))
+                    row = cur.fetchone()
+                    current = json.loads(row[0]) if row else None
+                    if current != expected:
+                        self._conn.execute("ROLLBACK")
+                        return False
+                    self._conn.execute(
+                        "INSERT INTO datasource_metadata(datasource, commit_metadata) "
+                        "VALUES(?, ?) ON CONFLICT(datasource) DO UPDATE SET "
+                        "commit_metadata = excluded.commit_metadata",
+                        (ds, json.dumps(new, sort_keys=True)))
+                for d in descriptors:
+                    self._conn.execute(
+                        "INSERT OR IGNORE INTO segments(id, datasource, start, "
+                        "end, version, partition_num, used, created_ms, payload) "
+                        "VALUES(?,?,?,?,?,?,1,?,?)",
+                        (d.id, d.datasource, d.interval.start, d.interval.end,
+                         d.version, d.partition, now,
+                         json.dumps(d.to_json(), sort_keys=True)))
+                self._conn.execute("COMMIT")
+                return True
+            except BaseException:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                raise
+
+    def used_segments(self, datasource: Optional[str] = None
+                      ) -> List[SegmentDescriptor]:
+        with self._lock:
+            if datasource is None:
+                cur = self._conn.execute(
+                    "SELECT payload FROM segments WHERE used = 1")
+            else:
+                cur = self._conn.execute(
+                    "SELECT payload FROM segments WHERE used = 1 AND "
+                    "datasource = ?", (datasource,))
+            return [SegmentDescriptor.from_json(json.loads(r[0]))
+                    for r in cur.fetchall()]
+
+    def mark_unused(self, segment_ids: Sequence[str]) -> int:
+        with self._lock, self._conn as c:
+            n = 0
+            for sid in segment_ids:
+                n += c.execute("UPDATE segments SET used = 0 WHERE id = ?",
+                               (sid,)).rowcount
+            return n
+
+    def mark_used(self, segment_ids: Sequence[str]) -> int:
+        with self._lock, self._conn as c:
+            n = 0
+            for sid in segment_ids:
+                n += c.execute("UPDATE segments SET used = 1 WHERE id = ?",
+                               (sid,)).rowcount
+            return n
+
+    def delete_segments(self, segment_ids: Sequence[str]) -> int:
+        """Permanent removal (the kill-task step after mark_unused)."""
+        with self._lock, self._conn as c:
+            n = 0
+            for sid in segment_ids:
+                n += c.execute("DELETE FROM segments WHERE id = ?",
+                               (sid,)).rowcount
+            return n
+
+    def datasources(self) -> List[str]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT DISTINCT datasource FROM segments WHERE used = 1")
+            return sorted(r[0] for r in cur.fetchall())
+
+    def max_version(self, datasource: str, interval: Interval) -> Optional[str]:
+        """Highest version overlapping the interval (segment allocation)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT MAX(version) FROM segments WHERE datasource = ? AND "
+                "used = 1 AND start < ? AND end > ?",
+                (datasource, interval.end, interval.start))
+            row = cur.fetchone()
+            return row[0] if row else None
+
+    def max_partition(self, datasource: str, interval: Interval,
+                      version: str) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT MAX(partition_num) FROM segments WHERE datasource = ? "
+                "AND version = ? AND start = ? AND end = ?",
+                (datasource, version, interval.start, interval.end))
+            row = cur.fetchone()
+            return -1 if row is None or row[0] is None else int(row[0])
+
+    # ---- datasource commit metadata (streaming offsets) ----------------
+    def datasource_metadata(self, datasource: str) -> Optional[dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT commit_metadata FROM datasource_metadata WHERE "
+                "datasource = ?", (datasource,))
+            row = cur.fetchone()
+            return json.loads(row[0]) if row else None
+
+    def reset_datasource_metadata(self, datasource: str) -> None:
+        with self._lock, self._conn as c:
+            c.execute("DELETE FROM datasource_metadata WHERE datasource = ?",
+                      (datasource,))
+
+    # ---- rules ---------------------------------------------------------
+    def set_rules(self, datasource: str, rules: List[dict]) -> None:
+        with self._lock, self._conn as c:
+            c.execute(
+                "INSERT INTO rules(datasource, payload, updated_ms) "
+                "VALUES(?,?,?) ON CONFLICT(datasource) DO UPDATE SET "
+                "payload = excluded.payload, updated_ms = excluded.updated_ms",
+                (datasource, json.dumps(rules), int(time.time() * 1000)))
+
+    def rules_for(self, datasource: str) -> List[dict]:
+        """Datasource rules + default-datasource (_default) rules appended —
+        the reference's rule resolution order."""
+        with self._lock:
+            out = []
+            for ds in (datasource, "_default"):
+                cur = self._conn.execute(
+                    "SELECT payload FROM rules WHERE datasource = ?", (ds,))
+                row = cur.fetchone()
+                if row:
+                    out += json.loads(row[0])
+            return out
+
+    # ---- config / audit ------------------------------------------------
+    def set_config(self, name: str, payload: dict) -> None:
+        with self._lock, self._conn as c:
+            c.execute("INSERT INTO config(name, payload) VALUES(?,?) "
+                      "ON CONFLICT(name) DO UPDATE SET payload = excluded.payload",
+                      (name, json.dumps(payload)))
+
+    def get_config(self, name: str, default: Optional[dict] = None
+                   ) -> Optional[dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT payload FROM config WHERE name = ?", (name,))
+            row = cur.fetchone()
+            return json.loads(row[0]) if row else default
+
+    def audit(self, key: str, type_: str, author: str, comment: str,
+              payload: dict) -> None:
+        with self._lock, self._conn as c:
+            c.execute("INSERT INTO audit(audit_key, type, author, comment_txt, "
+                      "created_ms, payload) VALUES(?,?,?,?,?,?)",
+                      (key, type_, author, comment, int(time.time() * 1000),
+                       json.dumps(payload)))
+
+    def audit_log(self, key: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            q = "SELECT audit_key, type, author, comment_txt, created_ms, payload FROM audit"
+            args: tuple = ()
+            if key is not None:
+                q += " WHERE audit_key = ?"
+                args = (key,)
+            return [{"key": r[0], "type": r[1], "author": r[2],
+                     "comment": r[3], "created": r[4],
+                     "payload": json.loads(r[5])}
+                    for r in self._conn.execute(q + " ORDER BY id", args)]
+
+    # ---- tasks / supervisors (used by the indexing service) ------------
+    def insert_task(self, task_id: str, datasource: str, status: str,
+                    payload: dict) -> None:
+        with self._lock, self._conn as c:
+            c.execute("INSERT OR REPLACE INTO tasks(id, datasource, status, "
+                      "created_ms, payload) VALUES(?,?,?,?,?)",
+                      (task_id, datasource, status, int(time.time() * 1000),
+                       json.dumps(payload)))
+
+    def update_task_status(self, task_id: str, status: str) -> None:
+        with self._lock, self._conn as c:
+            c.execute("UPDATE tasks SET status = ? WHERE id = ?",
+                      (status, task_id))
+
+    def task(self, task_id: str) -> Optional[dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT id, datasource, status, payload FROM tasks WHERE id = ?",
+                (task_id,))
+            r = cur.fetchone()
+            if r is None:
+                return None
+            return {"id": r[0], "datasource": r[1], "status": r[2],
+                    "payload": json.loads(r[3])}
+
+    def tasks(self, status: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            if status is None:
+                cur = self._conn.execute(
+                    "SELECT id, datasource, status, payload FROM tasks")
+            else:
+                cur = self._conn.execute(
+                    "SELECT id, datasource, status, payload FROM tasks "
+                    "WHERE status = ?", (status,))
+            return [{"id": r[0], "datasource": r[1], "status": r[2],
+                     "payload": json.loads(r[3])} for r in cur.fetchall()]
+
+    def set_supervisor(self, supervisor_id: str, payload: dict) -> None:
+        with self._lock, self._conn as c:
+            c.execute("INSERT OR REPLACE INTO supervisors(id, payload) "
+                      "VALUES(?,?)", (supervisor_id, json.dumps(payload)))
+
+    def supervisors(self) -> Dict[str, dict]:
+        with self._lock:
+            return {r[0]: json.loads(r[1]) for r in self._conn.execute(
+                "SELECT id, payload FROM supervisors")}

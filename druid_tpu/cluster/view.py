@@ -1,0 +1,271 @@
+"""Data nodes and the broker's cluster view.
+
+Reference analogs:
+  DataNode       — historical process: ServerManager (server/coordination/
+                   ServerManager.java:74 — per-segment query serving) +
+                   SegmentLoadDropHandler (load/drop lifecycle) +
+                   SegmentManager (local timeline of loaded segments).
+  InventoryView  — BrokerServerView (client/BrokerServerView.java:57) +
+                   HttpServerInventoryView: the broker's live map of which
+                   server holds which segment, maintained via announcements
+                   (here: direct callbacks standing in for ZK/HTTP sync),
+                   building per-datasource VersionedIntervalTimeline whose
+                   payloads are replica sets (ServerSelector analog).
+
+The node boundary (run_partials / run_rows) is in-process here; a real
+multi-host deployment serializes AggregatePartials' numpy states over the
+wire — shapes and dtypes are all plain host arrays by construction.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from druid_tpu.cluster.cache import CacheConfig, LruCache, query_cache_key
+from druid_tpu.cluster.metadata import SegmentDescriptor
+from druid_tpu.cluster.shardspec import NoneShardSpec
+from druid_tpu.cluster.timeline import (PartitionChunk,
+                                        VersionedIntervalTimeline)
+from druid_tpu.data.segment import Segment
+from druid_tpu.engine import engines
+from druid_tpu.engine.engines import AggregatePartials, make_aggregate_partials
+from druid_tpu.query.model import (GroupByQuery, Query, TimeseriesQuery,
+                                   TopNQuery)
+from druid_tpu.utils.intervals import Interval
+
+
+def descriptor_for(segment: Segment,
+                   shard_spec=None) -> SegmentDescriptor:
+    """Pass the real shard spec for multi-partition sets (numbered/hashed) —
+    the timeline's completeness check depends on it. The defaults (none for
+    partition 0, linear otherwise) are always-complete append semantics."""
+    from druid_tpu.cluster.shardspec import LinearShardSpec
+    if shard_spec is None:
+        shard_spec = NoneShardSpec(0) if segment.id.partition == 0 \
+            else LinearShardSpec(segment.id.partition)
+    return SegmentDescriptor(
+        segment.id.datasource, segment.id.interval, segment.id.version,
+        segment.id.partition, shard_spec, num_rows=segment.n_rows)
+
+
+def _is_aggregate(query: Query) -> bool:
+    return isinstance(query, (TimeseriesQuery, TopNQuery, GroupByQuery))
+
+
+class DataNode:
+    """One data server: loaded segments + the per-node query engine."""
+
+    def __init__(self, name: str, tier: str = "_default_tier",
+                 max_segments: Optional[int] = None,
+                 cache: Optional[LruCache] = None,
+                 cache_config: Optional[CacheConfig] = None,
+                 mesh=None):
+        self.name = name
+        self.tier = tier
+        self.max_segments = max_segments
+        self.cache = cache
+        self.cache_config = cache_config or CacheConfig()
+        self.mesh = mesh
+        self._segments: Dict[str, Segment] = {}
+        self._lock = threading.RLock()
+        self.alive = True
+
+    # ---- load/drop (SegmentLoadDropHandler analog) ---------------------
+    def load_segment(self, segment: Segment) -> bool:
+        with self._lock:
+            if self.max_segments is not None \
+                    and len(self._segments) >= self.max_segments \
+                    and str(segment.id) not in self._segments:
+                return False
+            self._segments[str(segment.id)] = segment
+            return True
+
+    def drop_segment(self, segment_id: str) -> bool:
+        with self._lock:
+            return self._segments.pop(str(segment_id), None) is not None
+
+    def served_segment_ids(self) -> Set[str]:
+        with self._lock:
+            return set(self._segments)
+
+    def segment_count(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+    def segments(self) -> List[Segment]:
+        with self._lock:
+            return list(self._segments.values())
+
+    # ---- query serving (ServerManager analog) --------------------------
+    def _select(self, segment_ids: Sequence[str]) -> Tuple[List[Segment], Set[str]]:
+        with self._lock:
+            found, served = [], set()
+            for sid in segment_ids:
+                s = self._segments.get(str(sid))
+                if s is not None:
+                    found.append(s)
+                    served.add(str(sid))
+            return found, served
+
+    def run_partials(self, query: Query, segment_ids: Sequence[str]
+                     ) -> Tuple[AggregatePartials, Set[str]]:
+        """Aggregate path: produce partial states for the requested segments
+        (clamp=False — the broker pre-bounds intervals so bucket index
+        spaces align across nodes). Per-segment partials are cached when the
+        segment cache is enabled (CachingQueryRunner analog)."""
+        if not self.alive:
+            raise ConnectionError(f"server [{self.name}] is down")
+        segs, served = self._select(segment_ids)
+        use_cache = (self.cache is not None
+                     and self.cache_config.cacheable(query)
+                     and self.cache_config.use_segment_cache)
+        if not use_cache:
+            ap = make_aggregate_partials(query, segs, clamp=False)
+            return ap, served
+        qkey = query_cache_key(query)
+        parts: List[AggregatePartials] = []
+        to_compute: List[Segment] = []
+        for s in segs:
+            hit = self.cache.get("segment", f"{s.id}|{qkey}")
+            if hit is not None:
+                parts.append(hit)
+            else:
+                to_compute.append(s)
+        for s in to_compute:
+            ap = make_aggregate_partials(query, [s], clamp=False)
+            if self.cache_config.populate_segment_cache:
+                self.cache.put("segment", f"{s.id}|{qkey}", ap)
+            parts.append(ap)
+        return AggregatePartials.concat(parts), served
+
+    def run_rows(self, query: Query, segment_ids: Sequence[str]
+                 ) -> Tuple[List[dict], Set[str]]:
+        """Row path (scan/select/search/timeBoundary/metadata queries):
+        run the local engine to finished rows; the broker row-merges."""
+        if not self.alive:
+            raise ConnectionError(f"server [{self.name}] is down")
+        segs, served = self._select(segment_ids)
+        from druid_tpu.engine.executor import QueryExecutor
+        ex = QueryExecutor(mesh=self.mesh)
+        rows = ex.run(query, segments=segs)
+        return rows, served
+
+
+class ReplicaSet:
+    """Which servers hold one segment chunk (ServerSelector analog);
+    pick() implements the replica-choice strategy
+    (client/selector/TierSelectorStrategy.java — random within tier)."""
+
+    def __init__(self, descriptor: SegmentDescriptor):
+        self.descriptor = descriptor
+        self.servers: Set[str] = set()
+
+    def pick(self, rng: random.Random,
+             exclude: Optional[Set[str]] = None) -> Optional[str]:
+        pool = sorted(self.servers - (exclude or set()))
+        if not pool:
+            return None
+        return pool[rng.randrange(len(pool))]
+
+
+class InventoryView:
+    """The live cluster map: node registry + per-datasource timelines whose
+    payloads are ReplicaSets. Announcements are direct method calls (the
+    in-process stand-in for ZK ephemeral nodes / HTTP sync)."""
+
+    def __init__(self):
+        self._nodes: Dict[str, DataNode] = {}
+        self._timelines: Dict[str, VersionedIntervalTimeline] = {}
+        self._replicas: Dict[str, ReplicaSet] = {}   # segment id → replicas
+        self._lock = threading.RLock()
+        self._listeners: List[Callable[[str, str, str], None]] = []
+
+    # ---- node lifecycle ------------------------------------------------
+    def register(self, node: DataNode) -> None:
+        with self._lock:
+            self._nodes[node.name] = node
+
+    def remove_node(self, name: str) -> None:
+        """Server death: drop it from every replica set instantly; segments
+        it was the last holder of leave the timeline (the broker's reaction
+        to a ZK ephemeral node vanishing)."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is None:
+                return
+            orphaned = []
+            for sid, rs in self._replicas.items():
+                rs.servers.discard(name)
+                if not rs.servers:
+                    orphaned.append(sid)
+            for sid in orphaned:
+                d = self._replicas.pop(sid).descriptor
+                tl = self._timelines.get(d.datasource)
+                if tl is not None:
+                    tl.remove(d.interval, d.version,
+                              d.shard_spec.partition_num if d.shard_spec
+                              else d.partition)
+
+    def node(self, name: str) -> Optional[DataNode]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def nodes(self) -> List[DataNode]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # ---- announcements -------------------------------------------------
+    def announce(self, server: str, descriptor: SegmentDescriptor) -> None:
+        with self._lock:
+            sid = descriptor.id
+            rs = self._replicas.get(sid)
+            if rs is None:
+                rs = self._replicas[sid] = ReplicaSet(descriptor)
+                tl = self._timelines.setdefault(
+                    descriptor.datasource, VersionedIntervalTimeline())
+                spec = descriptor.shard_spec or NoneShardSpec(descriptor.partition)
+                tl.add(descriptor.interval, descriptor.version,
+                       PartitionChunk(spec, rs))
+            rs.servers.add(server)
+        for fn in list(self._listeners):
+            fn("announce", server, sid)
+
+    def unannounce(self, server: str, segment_id: str) -> None:
+        with self._lock:
+            rs = self._replicas.get(segment_id)
+            if rs is None:
+                return
+            rs.servers.discard(server)
+            if not rs.servers:
+                d = rs.descriptor
+                tl = self._timelines.get(d.datasource)
+                if tl is not None:
+                    tl.remove(d.interval, d.version,
+                              d.shard_spec.partition_num if d.shard_spec
+                              else d.partition)
+                del self._replicas[segment_id]
+        for fn in list(self._listeners):
+            fn("unannounce", server, segment_id)
+
+    def add_listener(self, fn: Callable[[str, str, str], None]) -> None:
+        self._listeners.append(fn)
+
+    # ---- lookup ---------------------------------------------------------
+    def timeline(self, datasource: str) -> Optional[VersionedIntervalTimeline]:
+        with self._lock:
+            return self._timelines.get(datasource)
+
+    def datasources(self) -> List[str]:
+        with self._lock:
+            return sorted(ds for ds, tl in self._timelines.items()
+                          if not tl.is_empty())
+
+    def replica_set(self, segment_id: str) -> Optional[ReplicaSet]:
+        with self._lock:
+            return self._replicas.get(segment_id)
+
+    def served_segments(self, server: str) -> List[SegmentDescriptor]:
+        with self._lock:
+            return [rs.descriptor for rs in self._replicas.values()
+                    if server in rs.servers]

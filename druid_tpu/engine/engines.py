@@ -123,17 +123,18 @@ def _bucket_starts(granularity: Granularity,
 
 
 def _covered_buckets(granularity: Granularity, starts: np.ndarray,
-                     segments: Sequence[Segment],
+                     data_spans: Sequence[Tuple[int, int]],
                      intervals: Sequence[Interval]) -> np.ndarray:
     """Buckets whose span intersects actual segment data (mirrors the
-    reference emitting one row per cursor bucket)."""
+    reference emitting one row per cursor bucket). `data_spans` are
+    (min_time, max_time) extents of the contributing segments."""
     if len(starts) == 0:
         return np.zeros(0, dtype=bool)
     spans = []
-    for s in segments:
+    for mn, mx in data_spans:
         for iv in intervals:
-            lo = max(s.min_time, iv.start)
-            hi = min(s.max_time + 1, iv.end)
+            lo = max(mn, iv.start)
+            hi = min(mx + 1, iv.end)
             if lo < hi:
                 spans.append((lo, hi))
     if not spans:
@@ -176,25 +177,93 @@ def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg):
 
 
 # ---------------------------------------------------------------------------
+# Partial production / finish split (the broker's scatter-gather seam)
+# ---------------------------------------------------------------------------
+
+class AggregatePartials:
+    """Partial aggregation states from one producer (data node / local run).
+
+    The unit shipped from data nodes to the broker: states are plain
+    host arrays, dim_values are merged-dictionary string lists, spans are
+    (min_time, max_time) data extents for bucket-coverage accounting.
+    Reference analog: the non-finalized per-segment sequences a historical
+    streams back before the broker's mergeResults."""
+
+    def __init__(self, partials, dim_values, spans, intervals):
+        self.partials = partials          # List[SegmentPartial]
+        self.dim_values = dim_values      # parallel: List[List[List[str]]]
+        self.spans = spans                # List[(min_ms, max_ms)]
+        self.intervals = intervals        # intervals partials were built with
+
+    @staticmethod
+    def concat(parts: Sequence["AggregatePartials"]) -> "AggregatePartials":
+        parts = [p for p in parts if p is not None]
+        out = AggregatePartials([], [], [], None)
+        for p in parts:
+            out.partials += list(p.partials)
+            out.dim_values += list(p.dim_values)
+            out.spans += list(p.spans)
+            if out.intervals is None:
+                out.intervals = p.intervals
+        return out
+
+
+def make_aggregate_partials(query, segments: Sequence[Segment],
+                            clamp: bool = True) -> AggregatePartials:
+    """Produce partial states for a timeseries/topN/groupBy query over local
+    segments. `clamp=False` is used by the broker path: it pre-bounds the
+    query intervals globally so bucket index spaces align across nodes."""
+    intervals = condense(query.intervals)
+    segs = _segments_for(segments, intervals)
+    if clamp and not query.granularity.is_all:
+        intervals = _clamp_to_data(intervals, segs)
+    if not segs:
+        return AggregatePartials([], [], [], intervals)
+    if isinstance(query, TimeseriesQuery):
+        kds_per_seg = [[] for _ in segs]
+        vals_per_seg = [[] for _ in segs]
+    elif isinstance(query, TopNQuery):
+        keydims = [_keydim_for(s, query.dimension) for s in segs]
+        kds_per_seg = [[kd] for kd, _ in keydims]
+        vals_per_seg = [[values] for _, values in keydims]
+    elif isinstance(query, GroupByQuery):
+        kds_per_seg, vals_per_seg = [], []
+        for s in segs:
+            kds, vals = [], []
+            for d in query.dimensions:
+                kd, v = _keydim_for(s, d)
+                kds.append(kd)
+                vals.append(v)
+            kds_per_seg.append(kds)
+            vals_per_seg.append(vals)
+    else:
+        raise TypeError(f"not an aggregate query: {type(query).__name__}")
+    partials, dim_values = _make_partials(segs, intervals, query,
+                                          kds_per_seg, vals_per_seg)
+    spans = [(s.min_time, s.max_time) for s in segs]
+    return AggregatePartials(partials, dim_values, spans, intervals)
+
+
+# ---------------------------------------------------------------------------
 # Timeseries
 # ---------------------------------------------------------------------------
 
 def run_timeseries(query: TimeseriesQuery, segments: Sequence[Segment]) -> List[dict]:
-    intervals = condense(query.intervals)
-    segs = _segments_for(segments, intervals)
-    if not query.granularity.is_all:
-        intervals = _clamp_to_data(intervals, segs)
-    starts = _bucket_starts(query.granularity, intervals)
-    if not segs or len(starts) == 0:
-        return []
+    return finish_timeseries(query, make_aggregate_partials(query, segments))
 
-    partials, _ = _make_partials(segs, intervals, query,
-                                 [[] for _ in segs], [[] for _ in segs])
+
+def finish_timeseries(query: TimeseriesQuery,
+                      ap: AggregatePartials) -> List[dict]:
+    intervals = ap.intervals if ap.intervals is not None \
+        else condense(query.intervals)
+    starts = _bucket_starts(query.granularity, intervals)
+    if not ap.partials or len(starts) == 0:
+        return []
     buckets, _, counts, states, kernels = merge_partials(
-        partials, [[] for _ in partials])
+        ap.partials, [[] for _ in ap.partials])
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
 
-    covered = _covered_buckets(query.granularity, starts, segs, intervals)
+    covered = _covered_buckets(query.granularity, starts, ap.spans, intervals)
     empty_defaults = {k.name: k.finalize_array(k.empty_state(1))[0]
                       for k in kernels}
 
@@ -232,20 +301,17 @@ def _scalar(v):
 # ---------------------------------------------------------------------------
 
 def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
-    intervals = condense(query.intervals)
-    segs = _segments_for(segments, intervals)
-    if not query.granularity.is_all:
-        intervals = _clamp_to_data(intervals, segs)
+    return finish_topn(query, make_aggregate_partials(query, segments))
+
+
+def finish_topn(query: TopNQuery, ap: AggregatePartials) -> List[dict]:
+    intervals = ap.intervals if ap.intervals is not None \
+        else condense(query.intervals)
     starts = _bucket_starts(query.granularity, intervals)
-    if not segs or len(starts) == 0:
+    if not ap.partials or len(starts) == 0:
         return []
-
-    keydims = [_keydim_for(s, query.dimension) for s in segs]
-    partials, dim_values = _make_partials(
-        segs, intervals, query, [[kd] for kd, _ in keydims],
-        [[values] for _, values in keydims])
-
-    buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
+    buckets, dim_vals, counts, states, kernels = merge_partials(
+        ap.partials, ap.dim_values)
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
     arrays = _vectorized_postaggs(query.post_aggregations, finalized)
     values = dim_vals[0] if dim_vals else np.zeros(0, dtype=object)
@@ -258,7 +324,7 @@ def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
 
     ordering = query.metric_ordering
     rows = []
-    covered = _covered_buckets(query.granularity, starts, segs, intervals)
+    covered = _covered_buckets(query.granularity, starts, ap.spans, intervals)
     for bi, st in enumerate(starts):
         sel = buckets == bi
         if not sel.any():
@@ -293,28 +359,17 @@ def run_topn(query: TopNQuery, segments: Sequence[Segment]) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 def run_groupby(query: GroupByQuery, segments: Sequence[Segment]) -> List[dict]:
-    intervals = condense(query.intervals)
-    segs = _segments_for(segments, intervals)
-    if not query.granularity.is_all:
-        intervals = _clamp_to_data(intervals, segs)
+    return finish_groupby(query, make_aggregate_partials(query, segments))
+
+
+def finish_groupby(query: GroupByQuery, ap: AggregatePartials) -> List[dict]:
+    intervals = ap.intervals if ap.intervals is not None \
+        else condense(query.intervals)
     starts = _bucket_starts(query.granularity, intervals)
-    if not segs or len(starts) == 0:
+    if not ap.partials or len(starts) == 0:
         return []
-
-    per_seg = []
-    for s in segs:
-        kds, vals = [], []
-        for d in query.dimensions:
-            kd, v = _keydim_for(s, d)
-            kds.append(kd)
-            vals.append(v)
-        per_seg.append((kds, vals))
-
-    partials, dim_values = _make_partials(
-        segs, intervals, query, [kds for kds, _ in per_seg],
-        [vals for _, vals in per_seg])
-
-    buckets, dim_vals, counts, states, kernels = merge_partials(partials, dim_values)
+    buckets, dim_vals, counts, states, kernels = merge_partials(
+        ap.partials, ap.dim_values)
     finalized = {k.name: k.finalize_array(states[k.name]) for k in kernels}
     arrays = _vectorized_postaggs(query.post_aggregations, finalized)
 

@@ -746,10 +746,12 @@ def _plan_grouped(sel: P.Select, table: str, schema: SqlSchema,
 
     # ---- timeseries: no dimensions
     if not dimspecs:
-        # pure MIN/MAX(__time) → timeBoundary
-        tb = _time_boundary(sel, table, intervals, flt)
-        if tb is not None:
-            return tb
+        # pure ungrouped MIN/MAX(__time) → timeBoundary (a time-bucketed or
+        # HAVING-filtered variant must keep the timeseries machinery)
+        if granularity == "all" and sel.having is None:
+            tb = _time_boundary(sel, table, intervals, flt)
+            if tb is not None:
+                return tb
         for a in builder.aggs:
             if TIME_COL in a.required_columns():
                 raise PlannerError("aggregating __time requires timeBoundary "

@@ -1,0 +1,314 @@
+"""Simulated-cluster tests (no sockets), modeled on the reference's
+CachingClusteredClientTest (server/src/test/.../client/
+CachingClusteredClientTest.java:171 — fake servers + hand-built timelines)
+and DruidCoordinatorRuleRunnerTest."""
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import (Broker, CacheConfig, Coordinator, DataNode,
+                               DynamicConfig, ForeverLoadRule, InventoryView,
+                               LruCache, MetadataStore, MissingSegmentsError,
+                               PeriodDropRule, descriptor_for)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import (CardinalityAggregator,
+                                         CountAggregator, LongSumAggregator)
+from druid_tpu.query.filters import SelectorFilter
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   ScanQuery, SearchQuery, TimeBoundaryQuery,
+                                   TimeseriesQuery, TopNQuery)
+from druid_tpu.utils.intervals import Interval
+from tests.conftest import rows_as_frame
+
+
+@pytest.fixture()
+def cluster(segments):
+    """3 data nodes, segments spread round-robin with replica 2."""
+    view = InventoryView()
+    nodes = [DataNode(f"node{i}", cache=LruCache()) for i in range(3)]
+    for n in nodes:
+        view.register(n)
+    for i, s in enumerate(segments):
+        for j in (i % 3, (i + 1) % 3):
+            nodes[j].load_segment(s)
+            view.announce(nodes[j].name, descriptor_for(s))
+    broker = Broker(view, cache=LruCache())
+    return view, nodes, broker
+
+
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+AGGS = [CountAggregator("rows"), LongSumAggregator("ls", "metLong")]
+
+
+def _local(segments, q):
+    return QueryExecutor(segments).run(q)
+
+
+def test_broker_timeseries_matches_local(cluster, segments):
+    _, _, broker = cluster
+    q = TimeseriesQuery.of("test", [WEEK], AGGS, granularity="day")
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_topn_matches_local(cluster, segments):
+    _, _, broker = cluster
+    q = TopNQuery.of("test", [WEEK], "dimB", "ls", 10, AGGS)
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_groupby_matches_local(cluster, segments):
+    _, _, broker = cluster
+    q = GroupByQuery.of("test", [WEEK],
+                        [DefaultDimensionSpec("dimA")], AGGS,
+                        granularity="day")
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_hll_exact_state_merge(cluster, segments):
+    """Cardinality states (HLL registers) must merge across nodes exactly:
+    broker result == single-process result."""
+    _, _, broker = cluster
+    q = TimeseriesQuery.of("test", [WEEK],
+                           [CardinalityAggregator("u", ("dimHi",))])
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_row_queries(cluster, segments):
+    _, _, broker = cluster
+    tb = TimeBoundaryQuery.of("test", [WEEK])
+    assert broker.run(tb) == _local(segments, tb)
+    sc = ScanQuery.of("test", [WEEK], columns=("dimA", "metLong"), limit=17,
+                      order="ascending")
+    b = broker.run(sc)
+    l = _local(segments, sc)
+    assert sum(len(x["events"]) for x in b) == \
+        sum(len(x["events"]) for x in l) == 17
+    se = SearchQuery.of("test", [WEEK], "0000", limit=5)
+    assert broker.run(se) == _local(segments, se)
+
+
+def test_broker_retry_on_dead_server(cluster, segments):
+    view, nodes, broker = cluster
+    # kill one node AFTER announcement: broker must fail over to replicas
+    nodes[0].alive = False
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_missing_segments_error(segments):
+    view = InventoryView()
+    node = DataNode("only")
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("only", descriptor_for(s))
+    broker = Broker(view)
+    node.alive = False
+    with pytest.raises(MissingSegmentsError):
+        broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+
+
+def test_server_removal_updates_view(cluster, segments):
+    view, nodes, broker = cluster
+    # removing a node drops it from replica sets; queries still complete
+    view.remove_node("node1")
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_result_level_cache(cluster, segments):
+    _, _, broker = cluster
+    q = TopNQuery.of("test", [WEEK], "dimA", "ls", 5, AGGS)
+    first = broker.run(q)
+    assert broker.cache.stats.misses >= 1
+    hits_before = broker.cache.stats.hits
+    second = broker.run(q)
+    assert second == first
+    assert broker.cache.stats.hits == hits_before + 1
+
+
+def test_segment_level_cache(cluster, segments):
+    view, nodes, broker = cluster
+    broker.cache_config = CacheConfig(use_result_cache=False,
+                                      populate_result_cache=False)
+    q = GroupByQuery.of("test", [WEEK], [DefaultDimensionSpec("dimA")], AGGS)
+    broker.run(q)
+    puts = sum(n.cache.stats.puts for n in nodes)
+    assert puts >= len(segments)  # every (segment, query) partial cached
+    before_hits = sum(n.cache.stats.hits for n in nodes)
+    assert broker.run(q) == _local(segments, q)
+    assert sum(n.cache.stats.hits for n in nodes) > before_hits
+
+
+def test_sql_over_broker(cluster, segments):
+    from druid_tpu.sql import SqlExecutor
+    _, _, broker = cluster
+    sq = SqlExecutor(broker)
+    cols, rows = sq.execute(
+        "SELECT dimA, SUM(metLong) s FROM test GROUP BY dimA ORDER BY s DESC")
+    frames = [rows_as_frame(s) for s in segments]
+    a = np.concatenate([f["dimA"] for f in frames])
+    m = np.concatenate([f["metLong"] for f in frames])
+    want = sorted(((v, int(m[a == v].sum())) for v in set(a)),
+                  key=lambda kv: -kv[1])
+    assert [(r[0], int(r[1])) for r in rows] == want
+
+
+def test_broker_scan_offset_without_limit(cluster, segments):
+    _, _, broker = cluster
+    q = ScanQuery.of("test", [WEEK], columns=("dimA",), offset=10,
+                     order="ascending")
+    total = sum(s.n_rows for s in segments)
+    got = sum(len(b["events"]) for b in broker.run(q))
+    assert got == total - 10  # offset applied exactly once
+
+
+def test_broker_all_granularity_timestamp_matches_local(cluster, segments):
+    _, _, broker = cluster
+    wide = Interval.of("2020-01-01", "2030-01-01")
+    q = TimeseriesQuery.of("test", [wide], AGGS)  # granularity all
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_remove_last_holder_removes_from_timeline(segments):
+    view = InventoryView()
+    node = DataNode("only")
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("only", descriptor_for(s))
+    assert view.datasources() == ["test"]
+    view.remove_node("only")
+    assert view.datasources() == []
+    broker = Broker(view)
+    assert broker.run(TimeseriesQuery.of("test", [WEEK], AGGS)) == []
+
+
+# ---------------------------------------------------------------------------
+# Metadata store
+# ---------------------------------------------------------------------------
+
+def test_metadata_publish_and_cas(segments):
+    md = MetadataStore()
+    descs = [descriptor_for(s) for s in segments]
+    assert md.publish_segments(descs[:2])
+    assert len(md.used_segments("test")) == 2
+    # CAS success: expected None → {"offset": 10}
+    assert md.publish_segments(
+        [descs[2]], ("test", None, {"offset": 10}))
+    assert md.datasource_metadata("test") == {"offset": 10}
+    # CAS failure: wrong expected — nothing committed
+    assert not md.publish_segments(
+        [descs[3]], ("test", {"offset": 99}, {"offset": 20}))
+    assert md.datasource_metadata("test") == {"offset": 10}
+    assert len(md.used_segments("test")) == 3
+    # CAS success continues the chain
+    assert md.publish_segments(
+        [descs[3]], ("test", {"offset": 10}, {"offset": 20}))
+    assert len(md.used_segments("test")) == 4
+
+
+def test_metadata_unused_and_rules(segments):
+    md = MetadataStore()
+    descs = [descriptor_for(s) for s in segments]
+    md.publish_segments(descs)
+    assert md.mark_unused([descs[0].id]) == 1
+    assert len(md.used_segments("test")) == len(descs) - 1
+    md.set_rules("test", [{"type": "loadForever",
+                           "tieredReplicants": {"_default_tier": 1}}])
+    md.set_rules("_default", [{"type": "dropForever"}])
+    assert [r["type"] for r in md.rules_for("test")] == \
+        ["loadForever", "dropForever"]
+    md.audit("rules", "rules", "admin", "set rules", {"x": 1})
+    assert md.audit_log("rules")[0]["author"] == "admin"
+
+
+# ---------------------------------------------------------------------------
+# Coordinator
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def coordinated(segments):
+    md = MetadataStore()
+    view = InventoryView()
+    nodes = [DataNode(f"node{i}") for i in range(3)]
+    for n in nodes:
+        view.register(n)
+    by_id = {descriptor_for(s).id: s for s in segments}
+    md.publish_segments([descriptor_for(s) for s in segments])
+    coord = Coordinator(md, view, lambda d: by_id.get(d.id),
+                        DynamicConfig(max_segments_to_move=10,
+                                      replication_throttle_limit=100))
+    return md, view, nodes, coord
+
+
+def test_coordinator_assigns_replicas(coordinated, segments):
+    md, view, nodes, coord = coordinated
+    md.set_rules("_default", [{"type": "loadForever",
+                               "tieredReplicants": {"_default_tier": 2}}])
+    stats = coord.run_once()
+    assert stats.assigned == 2 * len(segments)
+    for s in segments:
+        rs = view.replica_set(descriptor_for(s).id)
+        assert rs is not None and len(rs.servers) == 2
+    # idempotent second run
+    stats2 = coord.run_once()
+    assert stats2.assigned == 0
+
+
+def test_coordinator_queries_after_assignment(coordinated, segments):
+    md, view, nodes, coord = coordinated
+    coord.run_once()
+    broker = Broker(view)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_coordinator_drop_rule(coordinated, segments):
+    md, view, nodes, coord = coordinated
+    coord.run_once()
+    # everything older than "now" by a hair → drop everything
+    md.set_rules("_default", [{"type": "dropByPeriod", "periodMs": 1}])
+    far_future = int(4e12)
+    stats = coord.run_once(now_ms=far_future)
+    assert stats.dropped > 0
+    assert all(n.segment_count() == 0 for n in nodes)
+
+
+def test_coordinator_overshadow_cleanup(coordinated, segments, generator):
+    md, view, nodes, coord = coordinated
+    # publish a v2 segment covering segment[0]'s interval → v1 overshadowed
+    s0 = segments[0]
+    v2 = generator.segment(1000, s0.id.interval, datasource="test",
+                           version="v2")
+    by_id_v2 = descriptor_for(v2)
+    md.publish_segments([by_id_v2])
+    coord.segment_source = (lambda orig: lambda d:
+                            v2 if d.id == by_id_v2.id else orig(d)
+                            )(coord.segment_source)
+    stats = coord.run_once()
+    assert stats.overshadowed_marked == 1
+    used_ids = {d.id for d in md.used_segments("test")}
+    assert descriptor_for(s0).id not in used_ids
+    assert by_id_v2.id in used_ids
+    assert coord.kill_unused("test") == 1
+
+
+def test_coordinator_balances(segments):
+    md = MetadataStore()
+    view = InventoryView()
+    nodes = [DataNode("a"), DataNode("b")]
+    for n in nodes:
+        view.register(n)
+    by_id = {descriptor_for(s).id: s for s in segments}
+    md.publish_segments([descriptor_for(s) for s in segments])
+    md.set_rules("_default", [{"type": "loadForever",
+                               "tieredReplicants": {"_default_tier": 1}}])
+    # preload everything onto node a
+    for s in segments:
+        nodes[0].load_segment(s)
+        view.announce("a", descriptor_for(s))
+    coord = Coordinator(md, view, lambda d: by_id.get(d.id),
+                        DynamicConfig(max_segments_to_move=10))
+    stats = coord.run_once()
+    assert stats.moved >= 1
+    assert abs(nodes[0].segment_count() - nodes[1].segment_count()) <= 1
