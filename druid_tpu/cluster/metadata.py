@@ -23,7 +23,12 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from druid_tpu.cluster.shardspec import NoneShardSpec, ShardSpec, shardspec_from_json
-from druid_tpu.utils.intervals import Interval
+from druid_tpu.utils.intervals import Interval, ts_to_iso
+
+
+class SegmentAllocationError(RuntimeError):
+    """Allocation refused: the bucket conflicts with differently-aligned
+    committed segments (SegmentAllocateAction returns null there)."""
 
 
 @dataclass(frozen=True)
@@ -100,6 +105,11 @@ class MetadataStore:
               created_ms INTEGER, payload TEXT);
             CREATE TABLE IF NOT EXISTS supervisors (
               id TEXT PRIMARY KEY, payload TEXT NOT NULL);
+            CREATE TABLE IF NOT EXISTS pending_segments (
+              id TEXT PRIMARY KEY, datasource TEXT NOT NULL,
+              start INTEGER NOT NULL, end INTEGER NOT NULL,
+              version TEXT NOT NULL, partition_num INTEGER NOT NULL,
+              created_ms INTEGER NOT NULL);
             """)
 
     # ---- segments ------------------------------------------------------
@@ -138,6 +148,8 @@ class MetadataStore:
                         (d.id, d.datasource, d.interval.start, d.interval.end,
                          d.version, d.partition, now,
                          json.dumps(d.to_json(), sort_keys=True)))
+                    self._conn.execute(
+                        "DELETE FROM pending_segments WHERE id = ?", (d.id,))
                 self._conn.execute("COMMIT")
                 return True
             except BaseException:
@@ -210,6 +222,78 @@ class MetadataStore:
                 (datasource, version, interval.start, interval.end))
             row = cur.fetchone()
             return -1 if row is None or row[0] is None else int(row[0])
+
+    def allocate_segment(self, datasource: str, interval: Interval,
+                         version: Optional[str] = None
+                         ) -> Tuple[str, int]:
+        """Atomically allocate (version, partition) for a new segment in the
+        given time bucket — the overlord's SegmentAllocateAction: all
+        concurrent writers to one bucket get the SAME version (appends are
+        siblings, not overshadowing) and unique ascending partitions, by
+        transacting against used + pending segments together."""
+        now = int(time.time() * 1000)
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                # refuse buckets that overlap differently-aligned committed
+                # segments: minting a newer version there would partially
+                # overshadow (hide) their data
+                cur = self._conn.execute(
+                    "SELECT COUNT(*) FROM segments WHERE datasource = ? AND "
+                    "used = 1 AND start < ? AND end > ? AND NOT "
+                    "(start = ? AND end = ?)",
+                    (datasource, interval.end, interval.start,
+                     interval.start, interval.end))
+                if cur.fetchone()[0]:
+                    self._conn.execute("ROLLBACK")
+                    raise SegmentAllocationError(
+                        f"bucket {interval} overlaps existing segments of a "
+                        f"different granularity in [{datasource}]")
+                if version is None:
+                    cur = self._conn.execute(
+                        "SELECT version FROM pending_segments WHERE "
+                        "datasource = ? AND start = ? AND end = ? "
+                        "UNION SELECT version FROM segments WHERE "
+                        "datasource = ? AND start = ? AND end = ? AND used = 1",
+                        (datasource, interval.start, interval.end) * 2)
+                    versions = sorted(r[0] for r in cur.fetchall())
+                    version = versions[-1] if versions else ts_to_iso(now)
+                cur = self._conn.execute(
+                    "SELECT MAX(partition_num) FROM (SELECT partition_num "
+                    "FROM pending_segments WHERE datasource = ? AND "
+                    "start = ? AND end = ? AND version = ? UNION ALL "
+                    "SELECT partition_num FROM segments WHERE datasource = ? "
+                    "AND start = ? AND end = ? AND version = ?)",
+                    (datasource, interval.start, interval.end, version) * 2)
+                row = cur.fetchone()
+                part = 0 if row is None or row[0] is None else int(row[0]) + 1
+                sid = f"{datasource}_{interval}_{version}_{part}"
+                self._conn.execute(
+                    "INSERT INTO pending_segments(id, datasource, start, end, "
+                    "version, partition_num, created_ms) VALUES(?,?,?,?,?,?,?)",
+                    (sid, datasource, interval.start, interval.end, version,
+                     part, now))
+                self._conn.execute("COMMIT")
+                return version, part
+            except BaseException:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                raise
+
+    def kill_pending_segments(self, datasource: str,
+                              created_before_ms: Optional[int] = None) -> int:
+        """Drop allocation leftovers from failed/discarded tasks
+        (overlord killPendingSegments)."""
+        with self._lock, self._conn as c:
+            if created_before_ms is None:
+                return c.execute(
+                    "DELETE FROM pending_segments WHERE datasource = ?",
+                    (datasource,)).rowcount
+            return c.execute(
+                "DELETE FROM pending_segments WHERE datasource = ? AND "
+                "created_ms < ?", (datasource, created_before_ms)).rowcount
 
     # ---- datasource commit metadata (streaming offsets) ----------------
     def datasource_metadata(self, datasource: str) -> Optional[dict]:

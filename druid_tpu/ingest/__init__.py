@@ -4,10 +4,19 @@ from druid_tpu.ingest.input import (CombiningFirehose, DimensionsSpec,
                                     LocalFirehose, RowBatch, TimestampSpec,
                                     TransformSpec, firehose_from_json)
 from druid_tpu.ingest.merger import merge_segments
+from druid_tpu.ingest.appenderator import (Appenderator, SegmentAllocator,
+                                           Sink, StreamAppenderatorDriver)
+from druid_tpu.ingest.streaming import (SimulatedStream, StreamIngestTask,
+                                        StreamSource, StreamSupervisor,
+                                        StreamSupervisorSpec,
+                                        StreamTuningConfig)
 
 __all__ = [
     "IncrementalIndex", "merge_segments", "InputRowParser", "TimestampSpec",
     "DimensionsSpec", "TransformSpec", "RowBatch", "Firehose",
     "InlineFirehose", "LocalFirehose", "CombiningFirehose",
-    "firehose_from_json",
+    "firehose_from_json", "Appenderator", "SegmentAllocator", "Sink",
+    "StreamAppenderatorDriver", "SimulatedStream", "StreamIngestTask",
+    "StreamSource", "StreamSupervisor", "StreamSupervisorSpec",
+    "StreamTuningConfig",
 ]

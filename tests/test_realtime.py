@@ -1,0 +1,254 @@
+"""Appenderator + streaming exactly-once tests (reference: §3.4 Kafka
+exactly-once call stack; AppenderatorImpl/StreamAppenderatorDriver tests)."""
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import (Broker, DataNode, InventoryView, MetadataStore,
+                               descriptor_for)
+from druid_tpu.cluster.metadata import SegmentDescriptor
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.ingest import (Appenderator, RowBatch, SegmentAllocator,
+                              SimulatedStream, StreamAppenderatorDriver,
+                              StreamSupervisor, StreamSupervisorSpec,
+                              StreamTuningConfig)
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+SPECS = [CountAggregator("rows"), LongSumAggregator("v", "value")]
+# querying rolled-up data uses the combining form over STORED metric columns
+# (reference: AggregatorFactory.getCombiningFactory — count re-queries as
+# longSum of the stored row-count column)
+QSPECS = [LongSumAggregator("rows", "rows"), LongSumAggregator("v", "v")]
+DAY = Interval.of("2026-03-01", "2026-03-02")
+T0 = DAY.start
+
+
+def _records(n, t_start=T0, dim_card=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"timestamp": int(t_start + i * 1000),
+             "page": f"p{int(rng.integers(dim_card))}",
+             "value": int(rng.integers(0, 10))} for i in range(n)]
+
+
+def _batch(records):
+    return RowBatch([r["timestamp"] for r in records],
+                    {"page": [r["page"] for r in records],
+                     "value": [r["value"] for r in records]})
+
+
+# ---------------------------------------------------------------------------
+# Appenderator
+# ---------------------------------------------------------------------------
+
+def test_allocator_partitions_and_versions():
+    md = MetadataStore()
+    alloc = SegmentAllocator(md, "hour")
+    a = alloc.allocate("ds", T0)
+    b = alloc.allocate("ds", T0)          # same bucket → next partition
+    c = alloc.allocate("ds", T0 + 3_600_000)
+    assert a.interval == b.interval and a.partition == 0 and b.partition == 1
+    assert c.interval.start == T0 + 3_600_000 and c.partition == 0
+    # allocation continues from published partitions after restart
+    md.publish_segments([SegmentDescriptor("ds", a.interval, a.version, 5)])
+    alloc2 = SegmentAllocator(md, "hour")
+    d = alloc2.allocate("ds", T0, version=a.version)
+    assert d.partition == 6
+
+
+def test_concurrent_allocators_share_version():
+    """Two independent allocators (= two task groups) hitting one bucket
+    must get the SAME version with distinct partitions — different versions
+    would let MVCC overshadow one task's data (the overlord-side
+    SegmentAllocateAction guarantee)."""
+    md = MetadataStore()
+    a1 = SegmentAllocator(md, "hour")
+    a2 = SegmentAllocator(md, "hour")
+    x = a1.allocate("ds", T0)
+    y = a2.allocate("ds", T0)
+    z = a1.allocate("ds", T0)
+    assert x.version == y.version == z.version
+    assert sorted([x.partition, y.partition, z.partition]) == [0, 1, 2]
+
+
+def test_allocation_refuses_conflicting_granularity():
+    """Allocating an hour bucket inside a committed day segment must fail —
+    a newer version there would partially overshadow the day's data."""
+    from druid_tpu.cluster.metadata import SegmentAllocationError
+    md = MetadataStore()
+    md.publish_segments([SegmentDescriptor("ds", DAY, "v1", 0)])
+    alloc = SegmentAllocator(md, "hour")
+    with pytest.raises(SegmentAllocationError):
+        alloc.allocate("ds", T0)
+    # same-granularity appends still work
+    alloc_day = SegmentAllocator(md, "day")
+    ident = alloc_day.allocate("ds", T0)
+    assert ident.version == "v1" and ident.partition == 1
+
+
+def test_pending_segments_cleanup():
+    md = MetadataStore()
+    alloc = SegmentAllocator(md, "hour")
+    a = alloc.allocate("ds", T0)
+    b = alloc.allocate("ds", T0)
+    # publish consumes a's pending row; kill clears the rest
+    md.publish_segments([SegmentDescriptor("ds", a.interval, a.version,
+                                           a.partition)])
+    assert md.kill_pending_segments("ds") == 1
+    assert md.kill_pending_segments("ds") == 0
+
+
+def test_appenderator_rollup_and_query():
+    app = Appenderator("rt", SPECS, query_granularity="none",
+                       max_rows_per_hydrant=300)
+    alloc = SegmentAllocator(MetadataStore(), "day")
+    ident = alloc.allocate("rt", T0)
+    recs = _records(1000)
+    for i in range(0, 1000, 100):   # incremental adds → hydrant persists
+        app.add(ident, _batch(recs[i:i + 100]))
+    sink = app._sinks[ident.id]
+    assert len(sink.hydrants) >= 3
+    # in-flight data queryable with the standard engines
+    ex = QueryExecutor(app.query_segments())
+    rows = ex.run(TimeseriesQuery.of("rt", [DAY], QSPECS))
+    assert rows[0]["result"]["rows"] == 1000
+    assert rows[0]["result"]["v"] == sum(r["value"] for r in recs)
+    # push merges hydrants into one segment with rollup preserved
+    pushed = app.push([ident])
+    assert len(pushed) == 1
+    desc, seg = pushed[0]
+    assert desc.id == ident.id
+    ex2 = QueryExecutor([seg])
+    assert ex2.run(TimeseriesQuery.of("rt", [DAY], QSPECS)) == rows
+
+
+def test_driver_routes_by_segment_granularity():
+    md = MetadataStore()
+    app = Appenderator("rt", SPECS)
+    driver = StreamAppenderatorDriver(app, SegmentAllocator(md, "hour"), md)
+    recs = _records(100) + _records(100, t_start=T0 + 2 * 3_600_000)
+    driver.add_batch(_batch(recs))
+    idents = app.sink_ids()
+    assert len(idents) == 2
+    assert {i.interval.start for i in idents} == {T0, T0 + 2 * 3_600_000}
+
+
+def test_driver_publish_cas():
+    md = MetadataStore()
+    app = Appenderator("rt", SPECS)
+    driver = StreamAppenderatorDriver(app, SegmentAllocator(md, "day"), md)
+    driver.add_batch(_batch(_records(50)))
+    assert driver.publish_all(None, {"offset": 50})
+    assert md.datasource_metadata("rt") == {"offset": 50}
+    assert len(md.used_segments("rt")) == 1
+    # a stale publisher (expected None again) must be rejected atomically
+    app2 = Appenderator("rt", SPECS)
+    d2 = StreamAppenderatorDriver(app2, SegmentAllocator(md, "day"), md)
+    d2.add_batch(_batch(_records(50)))
+    assert not d2.publish_all(None, {"offset": 50})
+    assert len(md.used_segments("rt")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Streaming supervisor: exactly-once under failure
+# ---------------------------------------------------------------------------
+
+def _supervisor(md, stream, handoff=None, task_count=1,
+                max_rows=10**9):
+    spec = StreamSupervisorSpec(
+        "stream_ds", SPECS, dimensions=["page"], task_count=task_count,
+        max_rows_per_task=max_rows,
+        tuning=StreamTuningConfig(segment_granularity="day"))
+    return StreamSupervisor(spec, stream, md, handoff=handoff)
+
+
+def test_stream_ingest_end_to_end():
+    md = MetadataStore()
+    stream = SimulatedStream(n_partitions=2)
+    stream.append(0, _records(500, seed=1))
+    stream.append(1, _records(300, t_start=T0 + 1000, seed=2))
+    sup = _supervisor(md, stream, task_count=2)
+    sup.run_once()
+    # in-flight rows queryable before publish
+    ex = QueryExecutor(sup.query_segments())
+    rows = ex.run(TimeseriesQuery.of("stream_ds", [DAY], QSPECS))
+    assert rows[0]["result"]["rows"] == 800
+    assert sup.checkpoint_all()
+    meta = md.datasource_metadata("stream_ds")
+    assert meta["partitions"] == {"0": 500, "1": 300}
+    total = sum(d.num_rows for d in md.used_segments("stream_ds"))
+    assert total > 0
+
+
+def test_stream_exactly_once_on_task_failure():
+    """Task dies after reading but before publish → replacement re-reads
+    from committed offsets; no loss, no duplicates."""
+    md = MetadataStore()
+    published = []
+    stream = SimulatedStream(n_partitions=1)
+    stream.append(0, _records(400, seed=3))
+    sup = _supervisor(md, stream,
+                      handoff=lambda pushed: published.extend(pushed))
+    sup.run_once()
+    assert sup.checkpoint_all()          # commit offset 400
+
+    stream.append(0, _records(200, t_start=T0 + 500_000, seed=4))
+    sup.run_once()                       # task reads 200 more, NOT committed
+    task = list(sup.tasks.values())[0]
+    assert task.current_offsets[0] == 600
+    task.status = "FAILED"               # simulated crash before publish
+
+    sup.run_once()                       # replacement resumes at 400
+    new_task = list(sup.tasks.values())[0]
+    assert new_task is not task
+    assert new_task.start_offsets[0] == 400
+    assert sup.checkpoint_all()
+    assert md.datasource_metadata("stream_ds")["partitions"] == {"0": 600}
+
+    # every appended record lands in the published segments EXACTLY once
+    ex = QueryExecutor([seg for _, seg in published])
+    rows = ex.run(TimeseriesQuery.of("stream_ds", [DAY], QSPECS))
+    all_recs = _records(400, seed=3) + _records(200, t_start=T0 + 500_000,
+                                                seed=4)
+    assert rows[0]["result"]["rows"] == 600
+    assert rows[0]["result"]["v"] == sum(r["value"] for r in all_recs)
+
+
+def test_stream_duplicate_publish_rejected():
+    """Two replica tasks over the same offsets: only one CAS wins."""
+    md = MetadataStore()
+    stream = SimulatedStream(n_partitions=1)
+    stream.append(0, _records(100, seed=5))
+    sup_a = _supervisor(md, stream)
+    sup_b = _supervisor(md, stream)
+    sup_a.run_once()
+    sup_b.run_once()
+    assert sup_a.checkpoint_all()
+    assert not sup_b.checkpoint_all()    # loser discarded
+    assert md.datasource_metadata("stream_ds")["partitions"] == {"0": 100}
+    # each record published exactly once (distinct timestamps → no rollup)
+    assert sum(d.num_rows for d in md.used_segments("stream_ds")) == 100
+
+
+def test_stream_handoff_to_cluster():
+    """Published segments hand off to a data node and serve via broker."""
+    md = MetadataStore()
+    view = InventoryView()
+    node = DataNode("historical0")
+    view.register(node)
+
+    def handoff(pushed):
+        for desc, seg in pushed:
+            node.load_segment(seg)
+            view.announce(node.name, desc)
+
+    stream = SimulatedStream(n_partitions=1)
+    recs = _records(250, seed=6)
+    stream.append(0, recs)
+    sup = _supervisor(md, stream, handoff=handoff)
+    sup.run_once()
+    assert sup.checkpoint_all()
+    broker = Broker(view)
+    rows = broker.run(TimeseriesQuery.of("stream_ds", [DAY], QSPECS))
+    assert rows[0]["result"]["rows"] == 250
+    assert rows[0]["result"]["v"] == sum(r["value"] for r in recs)
