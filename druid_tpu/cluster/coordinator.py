@@ -340,6 +340,30 @@ class Coordinator:
                 stats.moved += 1
                 moves_left -= 1
 
+    # ---- auto-compaction (DruidCoordinatorSegmentCompactor +
+    # NewestSegmentFirstPolicy) -------------------------------------------
+    def schedule_compaction(self, overlord, datasource: str,
+                            metric_specs,
+                            min_segments_per_bucket: int = 2,
+                            max_tasks: int = 1) -> List[str]:
+        """Submit CompactionTasks for the newest intervals fragmented into
+        >= min_segments_per_bucket MVCC-visible segments."""
+        from druid_tpu.indexing.task import CompactionTask
+        by_bucket: Dict[Tuple[int, int], List[SegmentDescriptor]] = {}
+        for d in self.metadata.visible_segments(datasource):
+            by_bucket.setdefault((d.interval.start, d.interval.end),
+                                 []).append(d)
+        candidates = sorted(
+            (b for b, descs in by_bucket.items()
+             if len(descs) >= min_segments_per_bucket),
+            key=lambda b: -b[0])    # newest first
+        out = []
+        for start, end in candidates[:max_tasks]:
+            task = CompactionTask(datasource, Interval(start, end),
+                                  metric_specs)
+            out.append(overlord.submit(task))
+        return out
+
     # ---- kill (permanent deletion of unused segments) -------------------
     def kill_unused(self, datasource: str) -> int:
         """KillTask analog: permanently delete unused segments' metadata."""

@@ -197,6 +197,44 @@ class MetadataStore:
                                (sid,)).rowcount
             return n
 
+    def unused_segments(self, datasource: str,
+                        interval: Optional[Interval] = None
+                        ) -> List[SegmentDescriptor]:
+        with self._lock:
+            if interval is None:
+                cur = self._conn.execute(
+                    "SELECT payload FROM segments WHERE used = 0 AND "
+                    "datasource = ?", (datasource,))
+            else:
+                cur = self._conn.execute(
+                    "SELECT payload FROM segments WHERE used = 0 AND "
+                    "datasource = ? AND start >= ? AND end <= ?",
+                    (datasource, interval.start, interval.end))
+            return [SegmentDescriptor.from_json(json.loads(r[0]))
+                    for r in cur.fetchall()]
+
+    def visible_segments(self, datasource: str,
+                         interval: Optional[Interval] = None
+                         ) -> List[SegmentDescriptor]:
+        """Used segments VISIBLE under MVCC (overshadowed versions excluded)
+        — what queries and compaction must operate on, vs. raw
+        used_segments which may still contain not-yet-cleaned old versions."""
+        from druid_tpu.cluster.shardspec import NoneShardSpec as _None
+        from druid_tpu.cluster.timeline import (PartitionChunk,
+                                                VersionedIntervalTimeline)
+        tl: VersionedIntervalTimeline = VersionedIntervalTimeline()
+        for d in self.used_segments(datasource):
+            spec = d.shard_spec or _None(d.partition)
+            tl.add(d.interval, d.version, PartitionChunk(spec, d))
+        iv = interval if interval is not None else Interval.eternity()
+        out, seen = [], set()
+        for holder in tl.lookup(iv):
+            for chunk in holder.partitions:
+                if chunk.obj.id not in seen:
+                    seen.add(chunk.obj.id)
+                    out.append(chunk.obj)
+        return out
+
     def datasources(self) -> List[str]:
         with self._lock:
             cur = self._conn.execute(
@@ -239,11 +277,14 @@ class MetadataStore:
                 # segments: minting a newer version there would partially
                 # overshadow (hide) their data
                 cur = self._conn.execute(
-                    "SELECT COUNT(*) FROM segments WHERE datasource = ? AND "
-                    "used = 1 AND start < ? AND end > ? AND NOT "
-                    "(start = ? AND end = ?)",
+                    "SELECT (SELECT COUNT(*) FROM segments WHERE "
+                    "datasource = ? AND used = 1 AND start < ? AND end > ? "
+                    "AND NOT (start = ? AND end = ?)) + "
+                    "(SELECT COUNT(*) FROM pending_segments WHERE "
+                    "datasource = ? AND start < ? AND end > ? "
+                    "AND NOT (start = ? AND end = ?))",
                     (datasource, interval.end, interval.start,
-                     interval.start, interval.end))
+                     interval.start, interval.end) * 2)
                 if cur.fetchone()[0]:
                     self._conn.execute("ROLLBACK")
                     raise SegmentAllocationError(

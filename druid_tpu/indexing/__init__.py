@@ -1,0 +1,9 @@
+from druid_tpu.indexing.locks import LockType, TaskLock, TaskLockbox
+from druid_tpu.indexing.task import (CompactionTask, IndexTask, KillTask,
+                                     Task, TaskStatus, task_from_json)
+from druid_tpu.indexing.overlord import Overlord, TaskToolbox
+
+__all__ = [
+    "TaskLockbox", "TaskLock", "LockType", "Task", "TaskStatus", "IndexTask",
+    "CompactionTask", "KillTask", "task_from_json", "Overlord", "TaskToolbox",
+]

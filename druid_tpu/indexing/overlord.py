@@ -1,0 +1,128 @@
+"""Overlord: task queue, toolbox, and the local task runner.
+
+Reference analogs (indexing-service/.../overlord/):
+  TaskMaster/TaskQueue.java — task submission, state machine, persistence
+  TaskLockbox.java          — via druid_tpu/indexing/locks.py
+  ForkingTaskRunner         — here a thread-pool runner (tasks are
+    numpy/JAX-bound; processes add nothing on one host — multi-host
+    runners would dispatch over the wire like RemoteTaskRunner)
+  TaskToolbox + TaskActionClient — the peon-side service locator whose
+    actions (lock acquire, segment push, transactional insert) all land on
+    the overlord/metadata exactly like actions/SegmentTransactionalInsertAction
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence
+
+from druid_tpu.cluster.metadata import MetadataStore, SegmentDescriptor
+from druid_tpu.data.segment import Segment
+from druid_tpu.indexing.locks import TaskLock, TaskLockbox
+from druid_tpu.indexing.task import Task, TaskStatus
+from druid_tpu.storage.deep import DeepStorage, InMemoryDeepStorage
+from druid_tpu.utils.intervals import Interval, condense
+
+
+class TaskToolbox:
+    """What a running task may touch (reference TaskToolbox): metadata
+    actions, the lockbox, deep storage push/pull."""
+
+    def __init__(self, metadata: MetadataStore, lockbox: TaskLockbox,
+                 deep_storage: DeepStorage):
+        self.metadata = metadata
+        self.lockbox = lockbox
+        self.deep_storage = deep_storage
+
+    def lock(self, task: Task, intervals: Sequence[Interval]
+             ) -> Optional[TaskLock]:
+        """LockAcquireAction: one lock covering the task's intervals."""
+        locks = []
+        for iv in condense(intervals):
+            l = self.lockbox.acquire(task.id, task.datasource, iv,
+                                     priority=task.priority)
+            if l is None:
+                self.lockbox.release_all(task.id)
+                return None
+            locks.append(l)
+        return locks[0] if locks else None
+
+    def push(self, segment: Segment, descriptor: SegmentDescriptor
+             ) -> SegmentDescriptor:
+        return self.deep_storage.push(segment, descriptor)
+
+    def pull(self, descriptor: SegmentDescriptor) -> Optional[Segment]:
+        return self.deep_storage.pull(descriptor)
+
+    def publish(self, task: Task,
+                descriptors: Sequence[SegmentDescriptor]) -> bool:
+        """SegmentTransactionalInsertAction: refuse if the task's lock was
+        revoked, else publish atomically."""
+        if self.lockbox.is_revoked(task.id):
+            return False
+        return self.metadata.publish_segments(descriptors)
+
+
+class Overlord:
+    """Task queue + local thread runner + status persistence."""
+
+    def __init__(self, metadata: MetadataStore,
+                 deep_storage: Optional[DeepStorage] = None,
+                 max_workers: int = 4):
+        self.metadata = metadata
+        self.deep_storage = deep_storage or InMemoryDeepStorage()
+        self.lockbox = TaskLockbox()
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._futures: Dict[str, Future] = {}
+        self._statuses: Dict[str, TaskStatus] = {}
+        self._lock = threading.Lock()
+        self._listeners: List[Callable[[TaskStatus], None]] = []
+
+    def toolbox(self) -> TaskToolbox:
+        return TaskToolbox(self.metadata, self.lockbox, self.deep_storage)
+
+    def add_listener(self, fn: Callable[[TaskStatus], None]) -> None:
+        self._listeners.append(fn)
+
+    # ---- submission -----------------------------------------------------
+    def submit(self, task: Task) -> str:
+        with self._lock:
+            if task.id in self._futures:
+                return task.id
+            self.metadata.insert_task(task.id, task.datasource, "RUNNING",
+                                      task.to_json())
+            self._statuses[task.id] = TaskStatus(task.id, "RUNNING")
+            self._futures[task.id] = self._pool.submit(self._run, task)
+            return task.id
+
+    def _run(self, task: Task) -> TaskStatus:
+        try:
+            status = task.run(self.toolbox())
+        except Exception as e:          # task crash = failure, not overlord crash
+            status = TaskStatus.failure(task.id, e)
+        finally:
+            self.lockbox.release_all(task.id)
+        with self._lock:
+            self._statuses[task.id] = status
+        self.metadata.update_task_status(task.id, status.state)
+        for fn in list(self._listeners):
+            fn(status)
+        return status
+
+    # ---- status ---------------------------------------------------------
+    def status(self, task_id: str) -> Optional[TaskStatus]:
+        with self._lock:
+            return self._statuses.get(task_id)
+
+    def await_task(self, task_id: str, timeout: float = 300.0) -> TaskStatus:
+        fut = self._futures.get(task_id)
+        if fut is None:
+            raise KeyError(task_id)
+        return fut.result(timeout=timeout)
+
+    def run_task(self, task: Task, timeout: float = 300.0) -> TaskStatus:
+        self.submit(task)
+        return self.await_task(task.id, timeout)
+
+    def shutdown(self):
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,321 @@
+"""Task model: batch index, compaction, kill.
+
+Reference analogs (indexing-service/src/main/java/org/apache/druid/indexing/):
+  common/task/Task.java      — JSON-polymorphic task SPI
+  common/task/IndexTask.java:406 — batch index: determineShardSpecs (:435)
+    then generateAndPublishSegments (:872)
+  common/task/CompactionTask.java — re-index an interval into fewer/newer
+    segments (drives auto-compaction)
+  common/task/KillTask.java  — permanently delete unused segments
+  §3.3 call stack: firehose → IncrementalIndex.add (rollup hot loop) →
+    persist → merge → push → SegmentTransactionalInsertAction
+
+TPU-first: the ingest hot loop is the vectorized IncrementalIndex; shard
+determination is a single pass over parsed batches (numpy bucketing), not a
+separate M/R-style cardinality job.
+"""
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, TYPE_CHECKING
+
+import numpy as np
+
+from druid_tpu.cluster.metadata import SegmentDescriptor
+from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
+                                         NoneShardSpec, NumberedShardSpec)
+from druid_tpu.data.segment import Segment, SegmentId
+from druid_tpu.ingest.incremental import IncrementalIndex
+from druid_tpu.ingest.input import (Firehose, InputRowParser, RowBatch,
+                                    TransformSpec)
+from druid_tpu.ingest.merger import merge_segments
+from druid_tpu.query import aggregators as A
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval, condense
+
+if TYPE_CHECKING:
+    from druid_tpu.indexing.overlord import TaskToolbox
+
+
+@dataclass
+class TaskStatus:
+    task_id: str
+    state: str                   # RUNNING | SUCCESS | FAILED
+    error: Optional[str] = None
+
+    @staticmethod
+    def success(task_id):
+        return TaskStatus(task_id, "SUCCESS")
+
+    @staticmethod
+    def failure(task_id, error):
+        return TaskStatus(task_id, "FAILED", str(error))
+
+
+class Task:
+    """SPI: id, type, datasource, priority; run(toolbox) does the work."""
+    task_type = "base"
+    priority = 0
+
+    def __init__(self, task_id: Optional[str], datasource: str):
+        self.id = task_id or f"{self.task_type}_{datasource}_{uuid.uuid4().hex[:8]}"
+        self.datasource = datasource
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        raise NotImplementedError
+
+    def to_json(self) -> dict:
+        return {"type": self.task_type, "id": self.id,
+                "dataSource": self.datasource}
+
+
+@dataclass
+class IndexTuningConfig:
+    max_rows_per_segment: int = 5_000_000
+    max_rows_in_memory: int = 1_000_000
+    partition_dimensions: Sequence[str] = ()   # hash partitioning dims
+
+
+class IndexTask(Task):
+    """Single-phase batch ingest (reference IndexTask 'index' type).
+
+    determineShardSpecs + generateAndPublishSegments collapse into one
+    vectorized pass: parse → transform → bucket by segment granularity →
+    split buckets over max_rows_per_segment into hash partitions → rollup
+    per partition → push + transactional publish under the task lock's
+    version."""
+    task_type = "index"
+    priority = 50   # batch replaces: above compaction, below streaming
+
+    def __init__(self, datasource: str, firehose: Firehose,
+                 parser: Optional[InputRowParser],
+                 metric_specs: Sequence[A.AggregatorSpec],
+                 dimensions: Optional[Sequence[str]] = None,
+                 transform: Optional[TransformSpec] = None,
+                 segment_granularity: str = "day",
+                 query_granularity: str = "none",
+                 rollup: bool = True,
+                 tuning: Optional[IndexTuningConfig] = None,
+                 task_id: Optional[str] = None,
+                 appending: bool = False):
+        super().__init__(task_id, datasource)
+        self.firehose = firehose
+        self.parser = parser
+        self.metric_specs = list(metric_specs)
+        self.dimensions = list(dimensions) if dimensions else None
+        self.transform = transform
+        self.segment_granularity = Granularity.of(segment_granularity)
+        self.query_granularity = query_granularity
+        self.rollup = rollup
+        self.tuning = tuning or IndexTuningConfig()
+        self.appending = appending
+
+    def _parse(self, raw: List) -> RowBatch:
+        if self.parser is not None:
+            batch = self.parser.parse_batch(raw)
+        else:
+            ts = [r["timestamp"] for r in raw]
+            keys = {k for r in raw for k in r if k != "timestamp"}
+            batch = RowBatch(ts, {k: [r.get(k) for r in raw]
+                                  for k in sorted(keys)})
+        if self.transform is not None:
+            batch = self.transform.apply(batch)
+        return batch
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        # phase 1: read + bucket (determineShardSpecs analog)
+        buckets: Dict[int, List[RowBatch]] = {}
+        bucket_rows: Dict[int, int] = {}
+        for raw in self.firehose.batches(self.tuning.max_rows_in_memory):
+            batch = self._parse(raw)
+            if not len(batch):
+                continue
+            ts = np.asarray(batch.timestamps, dtype=np.int64)
+            starts = self.segment_granularity.bucket_start_array(ts)
+            for st in np.unique(starts):
+                sel = starts == st
+                sub = RowBatch(
+                    ts[sel].tolist(),
+                    {k: [v for v, m in zip(col, sel) if m]
+                     for k, col in batch.columns.items()})
+                buckets.setdefault(int(st), []).append(sub)
+                bucket_rows[int(st)] = bucket_rows.get(int(st), 0) + len(sub)
+        if not buckets:
+            return TaskStatus.success(self.id)
+
+        intervals = condense([
+            Interval(st, self.segment_granularity.next_bucket(st))
+            for st in buckets])
+        lock = toolbox.lock(self, intervals)
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
+
+        # phase 2: build + publish per bucket
+        published: List[SegmentDescriptor] = []
+        pushed_segments: List[Segment] = []
+        for st, batches in sorted(buckets.items()):
+            iv = Interval(st, self.segment_granularity.next_bucket(st))
+            n_parts = max(1, -(-bucket_rows[st] //
+                               self.tuning.max_rows_per_segment))
+            part_batches: List[List[RowBatch]] = [[] for _ in range(n_parts)]
+            for b in batches:
+                if n_parts == 1:
+                    part_batches[0].append(b)
+                    continue
+                pids = self._partition_ids(b, n_parts)
+                for p in range(n_parts):
+                    sel = pids == p
+                    if not sel.any():
+                        continue
+                    part_batches[p].append(RowBatch(
+                        [t for t, m in zip(b.timestamps, sel) if m],
+                        {k: [v for v, m in zip(col, sel) if m]
+                         for k, col in b.columns.items()}))
+            hash_partitioned = (n_parts > 1
+                                and bool(self.tuning.partition_dimensions)
+                                and not self.appending)
+            for p, pbs in enumerate(part_batches):
+                if not pbs and not hash_partitioned:
+                    continue
+                # hash partitioning publishes EMPTY partitions too — the
+                # timeline only shows a numbered set once it is complete
+                index = IncrementalIndex(
+                    self.datasource, iv, self.metric_specs,
+                    dimensions=self.dimensions,
+                    query_granularity=self.query_granularity,
+                    rollup=self.rollup,
+                    max_rows_in_memory=10 ** 12)
+                for b in pbs:
+                    index.add_batch(b)
+                if self.appending:
+                    version, pnum = toolbox.metadata.allocate_segment(
+                        self.datasource, iv)
+                else:
+                    version, pnum = lock.version, p
+                seg = index.to_segment(version, pnum)
+                if n_parts == 1 and not self.appending:
+                    spec = NoneShardSpec(0)
+                elif self.tuning.partition_dimensions and not self.appending:
+                    spec = HashBasedNumberedShardSpec(
+                        pnum, n_parts,
+                        tuple(self.tuning.partition_dimensions))
+                else:
+                    spec = NumberedShardSpec(pnum,
+                                             0 if self.appending else n_parts)
+                desc = SegmentDescriptor(self.datasource, iv, version, pnum,
+                                         spec, num_rows=seg.n_rows)
+                desc = toolbox.push(seg, desc)
+                published.append(desc)
+                pushed_segments.append(seg)
+        if toolbox.lockbox.is_revoked(self.id):
+            return TaskStatus.failure(self.id, "lock revoked")
+        if not toolbox.publish(self, published):
+            return TaskStatus.failure(self.id, "transactional publish failed")
+        return TaskStatus.success(self.id)
+
+    def _partition_ids(self, batch: RowBatch, n_parts: int) -> np.ndarray:
+        dims = list(self.tuning.partition_dimensions)
+        if dims:
+            # MUST match HashBasedNumberedShardSpec's routing hash, or the
+            # broker's shard pruning drops rows the spec claims aren't here
+            from druid_tpu.cluster.shardspec import _hash_row
+            cols = [batch.columns.get(d, [None] * len(batch)) for d in dims]
+            return np.asarray(
+                [_hash_row([None if v is None else str(v)
+                            for v in (col[i] for col in cols)]) % n_parts
+                 for i in range(len(batch))], dtype=np.int64)
+        return np.arange(len(batch), dtype=np.int64) % n_parts
+
+
+class CompactionTask(Task):
+    """Merge an interval's segments into one new-version segment
+    (reference CompactionTask; scheduled by the coordinator's
+    auto-compaction — NewestSegmentFirstPolicy)."""
+    task_type = "compact"
+    priority = 25   # below batch/streaming: loses lock races to fresh data
+
+    def __init__(self, datasource: str, interval: Interval,
+                 metric_specs: Sequence[A.AggregatorSpec],
+                 query_granularity: str = "none",
+                 task_id: Optional[str] = None):
+        super().__init__(task_id, datasource)
+        self.interval = interval
+        self.metric_specs = list(metric_specs)
+        self.query_granularity = query_granularity
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        # only MVCC-visible segments: merging a not-yet-cleaned overshadowed
+        # version would resurrect replaced data
+        descs = [d for d in
+                 toolbox.metadata.visible_segments(self.datasource,
+                                                   self.interval)
+                 if self.interval.contains_interval(d.interval)]
+        if not descs:
+            return TaskStatus.success(self.id)
+        lock = toolbox.lock(self, [self.interval])
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
+        segments = [toolbox.pull(d) for d in descs]
+        if any(s is None for s in segments):
+            return TaskStatus.failure(self.id, "segment missing from deep storage")
+        merged = merge_segments(
+            segments, self.metric_specs, datasource=self.datasource,
+            interval=self.interval, version=lock.version, partition=0,
+            query_granularity=self.query_granularity)
+        desc = SegmentDescriptor(self.datasource, self.interval, lock.version,
+                                 0, NoneShardSpec(0), num_rows=merged.n_rows)
+        desc = toolbox.push(merged, desc)
+        if toolbox.lockbox.is_revoked(self.id):
+            return TaskStatus.failure(self.id, "lock revoked")
+        if not toolbox.publish(self, [desc]):
+            return TaskStatus.failure(self.id, "transactional publish failed")
+        return TaskStatus.success(self.id)
+
+
+class KillTask(Task):
+    """Permanently remove UNUSED segments in an interval: metadata rows and
+    deep-storage files (reference KillTask)."""
+    task_type = "kill"
+    priority = 0
+
+    def __init__(self, datasource: str, interval: Interval,
+                 task_id: Optional[str] = None):
+        super().__init__(task_id, datasource)
+        self.interval = interval
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        descs = toolbox.metadata.unused_segments(self.datasource,
+                                                 self.interval)
+        for d in descs:
+            toolbox.deep_storage.kill(d)
+        toolbox.metadata.delete_segments([d.id for d in descs])
+        return TaskStatus.success(self.id)
+
+
+def task_from_json(j: dict) -> Task:
+    t = j["type"]
+    if t == "index":
+        from druid_tpu.ingest.input import firehose_from_json
+        spec = j["spec"]
+        io = spec["ioConfig"]
+        schema = spec["dataSchema"]
+        parser = InputRowParser.from_json(schema["parser"]) \
+            if "parser" in schema else None
+        gran = schema.get("granularitySpec", {})
+        return IndexTask(
+            schema["dataSource"], firehose_from_json(io["firehose"]), parser,
+            [A.agg_from_json(a) for a in schema.get("metricsSpec", [])],
+            segment_granularity=gran.get("segmentGranularity", "day"),
+            query_granularity=gran.get("queryGranularity", "none"),
+            rollup=gran.get("rollup", True),
+            task_id=j.get("id"))
+    if t == "compact":
+        return CompactionTask(
+            j["dataSource"], Interval.parse(j["interval"]),
+            [A.agg_from_json(a) for a in j.get("metricsSpec", [])],
+            task_id=j.get("id"))
+    if t == "kill":
+        return KillTask(j["dataSource"], Interval.parse(j["interval"]),
+                        task_id=j.get("id"))
+    raise ValueError(f"unknown task type {t!r}")

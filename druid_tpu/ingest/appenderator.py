@@ -233,11 +233,13 @@ class StreamAppenderatorDriver:
                  allocator: SegmentAllocator,
                  metadata: MetadataStore,
                  handoff: Optional[Callable[
-                     [List[Tuple[SegmentDescriptor, Segment]]], None]] = None):
+                     [List[Tuple[SegmentDescriptor, Segment]]], None]] = None,
+                 deep_storage=None):
         self.appenderator = appenderator
         self.allocator = allocator
         self.metadata = metadata
         self.handoff = handoff        # e.g. load onto a DataNode + announce
+        self.deep_storage = deep_storage  # durable home before publish
         self._active: Dict[int, SegmentIdWithShard] = {}  # bucket start → id
         # serializes add_batch vs publish_all so a concurrently-allocated
         # sink can't be evicted from _active without being published
@@ -276,6 +278,12 @@ class StreamAppenderatorDriver:
         with self._lock:
             idents = list(self._active.values())
             pushed = self.appenderator.push(idents)
+            if self.deep_storage is not None:
+                # durable copy BEFORE the metadata commit, so the published
+                # descriptors are loadable by the coordinator forever —
+                # without this, the only copy dies with this process
+                pushed = [(self.deep_storage.push(seg, d), seg)
+                          for d, seg in pushed]
             ok = self.metadata.publish_segments(
                 [d for d, _ in pushed],
                 (self.appenderator.datasource, start_metadata, end_metadata))

@@ -100,7 +100,8 @@ class StreamIngestTask:
                  transform: Optional[TransformSpec] = None,
                  dimensions: Optional[Sequence[str]] = None,
                  tuning: Optional[StreamTuningConfig] = None,
-                 handoff: Optional[Callable] = None):
+                 handoff: Optional[Callable] = None,
+                 deep_storage=None):
         self.task_id = task_id
         self.datasource = datasource
         self.source = source
@@ -118,7 +119,7 @@ class StreamIngestTask:
         allocator = SegmentAllocator(metadata,
                                      self.tuning.segment_granularity)
         self.driver = StreamAppenderatorDriver(appender, allocator, metadata,
-                                               handoff)
+                                               handoff, deep_storage)
         self.paused = False
         self.status = "READING"
         self.rows_read = 0
@@ -223,13 +224,15 @@ class StreamSupervisor:
                  metadata: MetadataStore,
                  parser: Optional[InputRowParser] = None,
                  transform: Optional[TransformSpec] = None,
-                 handoff: Optional[Callable] = None):
+                 handoff: Optional[Callable] = None,
+                 deep_storage=None):
         self.spec = spec
         self.source = source
         self.metadata = metadata
         self.parser = parser
         self.transform = transform
         self.handoff = handoff
+        self.deep_storage = deep_storage
         self.tasks: Dict[int, StreamIngestTask] = {}   # group → task
         self._task_seq = 0
         self.metadata.set_supervisor(
@@ -271,7 +274,7 @@ class StreamSupervisor:
                     list(self.spec.metric_specs), self.metadata,
                     parser=self.parser, transform=self.transform,
                     dimensions=self.spec.dimensions, tuning=self.spec.tuning,
-                    handoff=self.handoff)
+                    handoff=self.handoff, deep_storage=self.deep_storage)
                 self.tasks[group] = task
                 self.metadata.insert_task(task.task_id, self.spec.datasource,
                                           "RUNNING", {"group": group})
@@ -300,8 +303,9 @@ class StreamSupervisor:
         for group, task in list(self.tasks.items()):
             if publish:
                 ok = task.finish() and ok
-            self.metadata.update_task_status(
-                task.task_id, task.status)
+            elif task.status == "READING":
+                task.status = "FAILED"   # discarded without publishing
+            self.metadata.update_task_status(task.task_id, task.status)
             del self.tasks[group]
         return ok
 

@@ -1,0 +1,93 @@
+"""Deep storage SPI: where immutable segment files live.
+
+Reference analog: api/.../segment/loading/DataSegmentPusher + DataSegmentPuller
+and their impls (LocalDataSegmentPuller/Pusher; s3/hdfs in extensions).
+Segment files use the on-disk format from druid_tpu/storage/format.py
+(smoosh container + LZ4 columns), so a pulled segment mmaps straight back.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, Optional
+
+from druid_tpu.cluster.metadata import SegmentDescriptor
+from druid_tpu.data.segment import Segment
+
+
+class DeepStorage:
+    def push(self, segment: Segment, descriptor: SegmentDescriptor
+             ) -> SegmentDescriptor:
+        """Store the segment; returns the descriptor with its loadSpec set."""
+        raise NotImplementedError
+
+    def pull(self, descriptor: SegmentDescriptor) -> Optional[Segment]:
+        raise NotImplementedError
+
+    def kill(self, descriptor: SegmentDescriptor) -> bool:
+        """Delete the stored segment file (KillTask's storage step)."""
+        raise NotImplementedError
+
+
+class InMemoryDeepStorage(DeepStorage):
+    """Test/local double — the role S3 plays in production."""
+
+    def __init__(self):
+        self._store: Dict[str, Segment] = {}
+        self._lock = threading.Lock()
+
+    def push(self, segment, descriptor):
+        with self._lock:
+            self._store[descriptor.id] = segment
+        return SegmentDescriptor(
+            descriptor.datasource, descriptor.interval, descriptor.version,
+            descriptor.partition, descriptor.shard_spec,
+            descriptor.size_bytes, descriptor.num_rows,
+            {"type": "memory", "key": descriptor.id})
+
+    def pull(self, descriptor):
+        with self._lock:
+            return self._store.get(descriptor.id)
+
+    def kill(self, descriptor):
+        with self._lock:
+            return self._store.pop(descriptor.id, None) is not None
+
+
+class LocalDeepStorage(DeepStorage):
+    """Directory-per-segment local deep storage using the V9-analog on-disk
+    format (smoosh + LZ4) — LocalDataSegmentPusher/Puller."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def _dir(self, descriptor: SegmentDescriptor) -> str:
+        safe = descriptor.id.replace("/", "_")
+        return os.path.join(self.base_dir, descriptor.datasource, safe)
+
+    def push(self, segment, descriptor):
+        from druid_tpu.storage.format import persist_segment
+        d = self._dir(descriptor)
+        os.makedirs(d, exist_ok=True)
+        persist_segment(segment, d)
+        size = sum(os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
+        return SegmentDescriptor(
+            descriptor.datasource, descriptor.interval, descriptor.version,
+            descriptor.partition, descriptor.shard_spec, size,
+            descriptor.num_rows, {"type": "local", "path": d})
+
+    def pull(self, descriptor):
+        from druid_tpu.storage.format import load_segment
+        d = (descriptor.load_spec or {}).get("path") or self._dir(descriptor)
+        if not os.path.isdir(d):
+            return None
+        return load_segment(d)
+
+    def kill(self, descriptor):
+        d = (descriptor.load_spec or {}).get("path") or self._dir(descriptor)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+            return True
+        return False

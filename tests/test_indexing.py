@@ -1,0 +1,320 @@
+"""Indexing service tests: batch index, compaction, kill, locks
+(reference: IndexTaskTest, CompactionTaskTest, TaskLockbox tests)."""
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import MetadataStore
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.indexing import (CompactionTask, IndexTask, KillTask, Overlord,
+                                TaskLockbox, task_from_json)
+from druid_tpu.indexing.task import IndexTuningConfig
+from druid_tpu.ingest import InlineFirehose
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.storage.deep import InMemoryDeepStorage, LocalDeepStorage
+from druid_tpu.utils.intervals import Interval
+
+SPECS = [CountAggregator("rows"), LongSumAggregator("v", "value")]
+QSPECS = [LongSumAggregator("rows", "rows"), LongSumAggregator("v", "v")]
+WEEK = Interval.of("2026-04-01", "2026-04-08")
+T0 = WEEK.start
+
+
+def _records(n, days=3, seed=0):
+    rng = np.random.default_rng(seed)
+    day = 86_400_000
+    return [{"timestamp": int(T0 + (i % days) * day + i * 1000 % day),
+             "page": f"p{int(rng.integers(10))}",
+             "value": int(rng.integers(0, 10))} for i in range(n)]
+
+
+def _overlord():
+    md = MetadataStore()
+    return md, Overlord(md, InMemoryDeepStorage())
+
+
+def _pull_all(md, deep, ds):
+    return [deep.pull(d) for d in md.used_segments(ds)]
+
+
+def test_index_task_end_to_end():
+    md, ov = _overlord()
+    recs = _records(3000, days=3)
+    task = IndexTask("batch_ds", InlineFirehose(recs), None, SPECS,
+                     segment_granularity="day")
+    status = ov.run_task(task)
+    assert status.state == "SUCCESS", status.error
+    descs = md.used_segments("batch_ds")
+    assert len(descs) == 3                      # one segment per day
+    assert all(d.version == descs[0].version for d in descs)
+    segs = _pull_all(md, ov.deep_storage, "batch_ds")
+    rows = QueryExecutor(segs).run(TimeseriesQuery.of("batch_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 3000
+    assert rows[0]["result"]["v"] == sum(r["value"] for r in recs)
+
+
+def test_index_task_partitions_large_buckets():
+    md, ov = _overlord()
+    recs = _records(2000, days=1)
+    task = IndexTask("big_ds", InlineFirehose(recs), None, SPECS,
+                     segment_granularity="day",
+                     tuning=IndexTuningConfig(max_rows_per_segment=600))
+    assert ov.run_task(task).state == "SUCCESS"
+    descs = md.used_segments("big_ds")
+    assert len(descs) >= 3                      # 2000/600 → ≥4 partitions
+    assert sorted(d.partition for d in descs) == list(range(len(descs)))
+    segs = _pull_all(md, ov.deep_storage, "big_ds")
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("big_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 2000
+
+
+def test_index_replace_overshadows():
+    """Re-indexing the same interval produces a newer version that
+    overshadows the old one (MVCC batch replace)."""
+    md, ov = _overlord()
+    ov.run_task(IndexTask("r_ds", InlineFirehose(_records(500, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    v1 = md.used_segments("r_ds")[0].version
+    import time
+    time.sleep(0.002)  # newer wall-clock version
+    ov.run_task(IndexTask("r_ds", InlineFirehose(_records(200, days=1,
+                                                          seed=9)),
+                          None, SPECS, segment_granularity="day"))
+    descs = md.used_segments("r_ds")
+    versions = {d.version for d in descs}
+    assert len(versions) == 2
+    # coordinator cleanup marks the overshadowed version unused
+    from druid_tpu.cluster import Coordinator, InventoryView
+    coord = Coordinator(md, InventoryView(), lambda d: None)
+    stats = coord.run_once()
+    assert stats.overshadowed_marked == 1
+    remaining = md.used_segments("r_ds")
+    assert len(remaining) == 1 and remaining[0].version != v1
+
+
+def test_compaction_task():
+    md, ov = _overlord()
+    # ingest day-granularity, three runs appending into one day via allocate
+    day = Interval.of("2026-04-01", "2026-04-02")
+    for seed in (1, 2, 3):
+        t = IndexTask("c_ds", InlineFirehose(_records(300, days=1, seed=seed)),
+                      None, SPECS, segment_granularity="day", appending=True)
+        assert ov.run_task(t).state == "SUCCESS"
+    assert len(md.used_segments("c_ds")) == 3
+    before = QueryExecutor(_pull_all(md, ov.deep_storage, "c_ds")).run(
+        TimeseriesQuery.of("c_ds", [WEEK], QSPECS))
+    import time
+    time.sleep(0.002)
+    ct = CompactionTask("c_ds", day, QSPECS)   # combining specs re-aggregate
+    assert ov.run_task(ct).state == "SUCCESS"
+    # old segments overshadowed by compacted one
+    from druid_tpu.cluster import Coordinator, InventoryView
+    Coordinator(md, InventoryView(), lambda d: None).run_once()
+    descs = md.used_segments("c_ds")
+    assert len(descs) == 1
+    after = QueryExecutor([ov.deep_storage.pull(descs[0])]).run(
+        TimeseriesQuery.of("c_ds", [WEEK], QSPECS))
+    assert after == before
+
+
+def test_kill_task():
+    md, ov = _overlord()
+    ov.run_task(IndexTask("k_ds", InlineFirehose(_records(100, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    desc = md.used_segments("k_ds")[0]
+    md.mark_unused([desc.id])
+    assert ov.run_task(KillTask("k_ds", WEEK)).state == "SUCCESS"
+    assert md.used_segments("k_ds") == []
+    assert ov.deep_storage.pull(desc) is None
+
+
+def test_lockbox_priority_revocation():
+    lb = TaskLockbox()
+    day = Interval.of("2026-04-01", "2026-04-02")
+    low = lb.acquire("compact1", "ds", day, priority=25)
+    assert low is not None
+    # equal priority conflicts
+    assert lb.acquire("compact2", "ds", day, priority=25) is None
+    # higher priority revokes
+    high = lb.acquire("index1", "ds", day, priority=50)
+    assert high is not None
+    assert lb.is_revoked("compact1")
+    lb.release_all("index1")
+    lb.release_all("compact1")
+    # disjoint intervals coexist
+    a = lb.acquire("t1", "ds", Interval.of("2026-04-01", "2026-04-02"))
+    b = lb.acquire("t2", "ds", Interval.of("2026-04-02", "2026-04-03"))
+    assert a is not None and b is not None
+
+
+def test_compaction_loses_lock_race_to_index():
+    """A compaction holding a lock gets revoked by a batch index; its
+    publish must be refused."""
+    md, ov = _overlord()
+    ov.run_task(IndexTask("race_ds", InlineFirehose(_records(100, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    day = Interval.of("2026-04-01", "2026-04-02")
+    tb = ov.toolbox()
+    ct = CompactionTask("race_ds", day, QSPECS)
+    lock = tb.lock(ct, [day])
+    assert lock is not None
+    it = IndexTask("race_ds", InlineFirehose(_records(50, days=1)), None,
+                   SPECS, segment_granularity="day")
+    assert tb.lock(it, [day]) is not None      # revokes compaction
+    assert tb.lockbox.is_revoked(ct.id)
+    assert not tb.publish(ct, [])              # refused
+
+
+def test_local_deep_storage_round_trip(tmp_path):
+    md = MetadataStore()
+    ov = Overlord(md, LocalDeepStorage(str(tmp_path)))
+    recs = _records(500, days=2)
+    assert ov.run_task(
+        IndexTask("disk_ds", InlineFirehose(recs), None, SPECS,
+                  segment_granularity="day")).state == "SUCCESS"
+    descs = md.used_segments("disk_ds")
+    assert all(d.load_spec["type"] == "local" for d in descs)
+    assert all(d.size_bytes > 0 for d in descs)
+    segs = [ov.deep_storage.pull(d) for d in descs]
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("disk_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 500
+
+
+def test_auto_compaction_scheduling():
+    md, ov = _overlord()
+    for seed in (1, 2):
+        ov.run_task(IndexTask("ac_ds",
+                              InlineFirehose(_records(200, days=2, seed=seed)),
+                              None, SPECS, segment_granularity="day",
+                              appending=True))
+    from druid_tpu.cluster import Coordinator, InventoryView
+    coord = Coordinator(md, InventoryView(), lambda d: None)
+    import time
+    time.sleep(0.002)
+    task_ids = coord.schedule_compaction(ov, "ac_ds", QSPECS, max_tasks=2)
+    assert len(task_ids) == 2
+    for tid in task_ids:
+        assert ov.await_task(tid).state == "SUCCESS"
+    coord.run_once()
+    descs = md.used_segments("ac_ds")
+    assert len(descs) == 2      # one compacted segment per day
+    rows = QueryExecutor([ov.deep_storage.pull(d) for d in descs]).run(
+        TimeseriesQuery.of("ac_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 400
+
+
+def test_hash_partitioning_matches_shard_pruning():
+    """Rows routed by IndexTask's hash MUST satisfy the published
+    HashBasedNumberedShardSpec, or broker shard pruning drops data."""
+    md, ov = _overlord()
+    recs = _records(2000, days=1, seed=4)
+    ov.run_task(IndexTask(
+        "h_ds", InlineFirehose(recs), None, SPECS,
+        segment_granularity="day",
+        tuning=IndexTuningConfig(max_rows_per_segment=500,
+                                 partition_dimensions=("page",))))
+    descs = md.used_segments("h_ds")
+    assert len(descs) >= 3
+    # every row must be in the chunk its shard spec claims
+    for d in descs:
+        seg = ov.deep_storage.pull(d)
+        if seg.n_rows == 0:     # empty partitions complete the numbered set
+            continue
+        col = seg.dims["page"]
+        for vid in np.unique(col.ids):
+            v = col.dictionary.values[vid]
+            assert d.shard_spec.is_in_chunk({"page": v}), (d.id, v)
+    # broker with pruning returns exact filtered counts
+    from druid_tpu.cluster import Broker, DataNode, InventoryView
+    from druid_tpu.query.filters import SelectorFilter
+    view = InventoryView()
+    node = DataNode("n0")
+    view.register(node)
+    for d in descs:
+        node.load_segment(ov.deep_storage.pull(d))
+        view.announce("n0", d)
+    broker = Broker(view)
+    for page in ("p0", "p7"):
+        q = TimeseriesQuery.of("h_ds", [WEEK], QSPECS,
+                               filter=SelectorFilter("page", page))
+        got = broker.run(q)[0]["result"]["rows"]
+        want = sum(1 for r in recs if r["page"] == page)
+        assert got == want, (page, got, want)
+
+
+def test_compaction_skips_overshadowed_versions():
+    """Compacting while an overshadowed version is still marked used must
+    NOT resurrect the replaced data."""
+    md, ov = _overlord()
+    ov.run_task(IndexTask("ov_ds", InlineFirehose(_records(400, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    import time
+    time.sleep(0.002)
+    ov.run_task(IndexTask("ov_ds", InlineFirehose(_records(100, days=1,
+                                                           seed=8)),
+                          None, SPECS, segment_granularity="day"))
+    assert len(md.used_segments("ov_ds")) == 2      # v1 not yet cleaned
+    time.sleep(0.002)
+    day = Interval.of("2026-04-01", "2026-04-02")
+    assert ov.run_task(CompactionTask("ov_ds", day, QSPECS)).state == "SUCCESS"
+    from druid_tpu.cluster import Coordinator, InventoryView
+    Coordinator(md, InventoryView(), lambda d: None).run_once()
+    descs = md.used_segments("ov_ds")
+    assert len(descs) == 1
+    rows = QueryExecutor([ov.deep_storage.pull(descs[0])]).run(
+        TimeseriesQuery.of("ov_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 100          # NOT 500
+
+
+def test_streaming_publishes_to_deep_storage():
+    """Streamed segments must be durably pushed so the coordinator can load
+    them without the ingest process."""
+    from druid_tpu.ingest import (SimulatedStream, StreamSupervisor,
+                                  StreamSupervisorSpec, StreamTuningConfig)
+    from druid_tpu.cluster import (Coordinator, DataNode, DynamicConfig,
+                                   InventoryView)
+    md = MetadataStore()
+    deep = InMemoryDeepStorage()
+    stream = SimulatedStream(n_partitions=1)
+    stream.append(0, _records(150, days=1, seed=3))
+    sup = StreamSupervisor(
+        StreamSupervisorSpec("s_ds", SPECS, dimensions=["page"],
+                             tuning=StreamTuningConfig(
+                                 segment_granularity="day")),
+        stream, md, deep_storage=deep)
+    sup.run_once()
+    assert sup.checkpoint_all()
+    descs = md.used_segments("s_ds")
+    assert descs and all(d.load_spec is not None for d in descs)
+    # coordinator loads from deep storage with no ingest process involved
+    view = InventoryView()
+    node = DataNode("hist")
+    view.register(node)
+    md.set_rules("_default", [{"type": "loadForever",
+                               "tieredReplicants": {"_default_tier": 1}}])
+    coord = Coordinator(md, view, deep.pull,
+                        DynamicConfig(replication_throttle_limit=100))
+    stats = coord.run_once()
+    assert stats.assigned == len(descs) and stats.unassigned == 0
+    from druid_tpu.cluster import Broker
+    rows = Broker(view).run(TimeseriesQuery.of("s_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 150
+
+
+def test_task_from_json():
+    t = task_from_json({
+        "type": "index",
+        "spec": {"dataSchema": {
+            "dataSource": "j_ds",
+            "metricsSpec": [{"type": "count", "name": "rows"}],
+            "granularitySpec": {"segmentGranularity": "hour"}},
+            "ioConfig": {"firehose": {
+                "type": "inline",
+                "data": [{"timestamp": T0, "d": "x"}]}}}})
+    assert isinstance(t, IndexTask)
+    assert str(t.segment_granularity) == "hour"
+    t2 = task_from_json({"type": "kill", "dataSource": "x",
+                         "interval": str(WEEK)})
+    assert isinstance(t2, KillTask)
