@@ -286,6 +286,9 @@ def _dictionary_lut(d: Dictionary, pred) -> np.ndarray:
 def _string_predicate(flt: F.DimFilter):
     """Value-level predicate for a single-dim string filter (used for LUTs and
     for row-level evaluation in having specs)."""
+    # extension filters (e.g. bloom) expose a value_predicate() hook
+    if hasattr(flt, "value_predicate"):
+        return flt.value_predicate()
     if isinstance(flt, F.SelectorFilter):
         target = "" if flt.value is None else flt.value
         return lambda v: v == target

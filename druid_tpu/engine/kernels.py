@@ -399,9 +399,11 @@ class HllKernel(AggKernel):
                     hf = tbl[cols[f]]
                 elif kind == "numeric":
                     v = cols[f] if f != "__time" else cols["__time_offset"]
+                    # floats hash by bit pattern — truncating to int would
+                    # collapse every value sharing an integer part
                     hf = hll_mod.splitmix64_device(
-                        v.astype(jnp.int64).view(jnp.uint64)
-                        if v.dtype == jnp.float64 else
+                        v.astype(jnp.float64).view(jnp.uint64)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else
                         v.astype(jnp.int64).astype(jnp.uint64))
                 else:
                     continue
@@ -427,7 +429,10 @@ class HllKernel(AggKernel):
                 rho = rho_t[cols[f]]
             elif kind == "numeric":
                 v = cols[f] if f != "__time" else cols["__time_offset"]
-                h = hll_mod.splitmix64_device(v.astype(jnp.int64).astype(jnp.uint64))
+                h = hll_mod.splitmix64_device(
+                    v.astype(jnp.float64).view(jnp.uint64)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else
+                    v.astype(jnp.int64).astype(jnp.uint64))
                 reg, rho = hll_mod.register_of_device(h, self.log2m)
             else:
                 continue
@@ -462,7 +467,18 @@ def _numeric_type(segment: Segment, field: str, default=ValueType.DOUBLE) -> Val
     return default
 
 
+# extension-registered kernels: spec class → factory(spec, segment)
+_EXTENSION_KERNELS: Dict[type, object] = {}
+
+
+def register_kernel(spec_cls: type, factory) -> None:
+    _EXTENSION_KERNELS[spec_cls] = factory
+
+
 def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
+    factory = _EXTENSION_KERNELS.get(type(spec))
+    if factory is not None:
+        return factory(spec, segment)
     if isinstance(spec, A.CountAggregator):
         return CountKernel(spec)
     if isinstance(spec, A.LongSumAggregator):

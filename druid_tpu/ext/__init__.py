@@ -1,0 +1,27 @@
+"""Extensions: sketches, histogram, stats, bloom filter.
+
+Reference analog: extensions-core/ (datasketches, histogram, stats,
+druid-bloom-filter) loaded via the DruidModule SPI
+(server/.../initialization/Initialization.java:132). Here each module
+registers its aggregators / post-aggregators / filters / kernels into the
+core registries at import; importing druid_tpu.ext activates everything.
+"""
+from druid_tpu.ext.stats import (StandardDeviationPostAgg, VarianceAggregator)
+from druid_tpu.ext.sketches import (QuantilePostAgg, QuantilesPostAgg,
+                                    QuantilesSketchAggregator,
+                                    ThetaSketchAggregator,
+                                    ThetaSketchEstimatePostAgg,
+                                    ThetaSketchSetOpPostAgg, ThetaSketchValue)
+from druid_tpu.ext.histogram import (ApproximateHistogramAggregator,
+                                     HistogramQuantilePostAgg, HistogramValue)
+from druid_tpu.ext.bloom import (BloomFilterAggregator, BloomFilterValue,
+                                 BloomDimFilter)
+
+__all__ = [
+    "VarianceAggregator", "StandardDeviationPostAgg",
+    "ThetaSketchAggregator", "ThetaSketchValue", "ThetaSketchEstimatePostAgg",
+    "ThetaSketchSetOpPostAgg", "QuantilesSketchAggregator", "QuantilePostAgg",
+    "QuantilesPostAgg", "ApproximateHistogramAggregator", "HistogramValue",
+    "HistogramQuantilePostAgg", "BloomFilterAggregator", "BloomFilterValue",
+    "BloomDimFilter",
+]

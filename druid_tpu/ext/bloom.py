@@ -1,0 +1,177 @@
+"""Bloom filter (reference: extensions-core/druid-bloom-filter —
+BloomDimFilter for membership-tested filtering and BloomFilterAggregator
+for building filters from query results).
+
+TPU-first: the FILTER side is pure host work — membership is tested once
+per dictionary value (O(cardinality)), producing an id mask like every
+other string filter. The AGGREGATOR builds per-group bit arrays on device:
+k hash positions per dictionary value precomputed host-side, bits set via
+scatter-add + clamp (merge = elementwise OR ≡ max over ICI).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from druid_tpu.data.segment import Segment
+from druid_tpu.engine.kernels import AggKernel, _seg_max, register_kernel
+from druid_tpu.query.aggregators import AggregatorSpec, register_aggregator
+from druid_tpu.query.filters import DimFilter, register_filter
+
+NUM_HASHES = 7
+
+
+def _bit_positions(value: str, m_bits: int, k: int = NUM_HASHES) -> np.ndarray:
+    """k bit positions via double hashing of md5 halves (Kirsch-Mitzenmacher)."""
+    d = hashlib.md5(value.encode()).digest()
+    h1 = int.from_bytes(d[:8], "big")
+    h2 = int.from_bytes(d[8:], "big") | 1
+    return np.asarray([(h1 + i * h2) % m_bits for i in range(k)],
+                      dtype=np.int64)
+
+
+class BloomFilterValue:
+    """Serializable bloom filter (bit array + membership test)."""
+
+    __slots__ = ("bits",)
+
+    def __init__(self, bits: np.ndarray):
+        self.bits = np.asarray(bits, dtype=np.uint8)
+
+    @property
+    def m_bits(self) -> int:
+        return len(self.bits)
+
+    def test(self, value: Optional[str]) -> bool:
+        v = "" if value is None else str(value)
+        return bool(self.bits[_bit_positions(v, self.m_bits)].all())
+
+    def union(self, other: "BloomFilterValue") -> "BloomFilterValue":
+        return BloomFilterValue(np.maximum(self.bits, other.bits))
+
+    def serialize(self) -> str:
+        return base64.b64encode(np.packbits(self.bits).tobytes()).decode()
+
+    @staticmethod
+    def deserialize(b64: str, m_bits: int) -> "BloomFilterValue":
+        raw = np.frombuffer(base64.b64decode(b64), dtype=np.uint8)
+        return BloomFilterValue(np.unpackbits(raw)[:m_bits])
+
+    def __repr__(self):
+        return f"BloomFilterValue(m={self.m_bits}, set={int(self.bits.sum())})"
+
+
+def optimal_m_bits(max_entries: int, fpp: float = 0.01) -> int:
+    m = -max_entries * np.log(fpp) / (np.log(2) ** 2)
+    return max(64, int(np.ceil(m)))
+
+
+@dataclass(frozen=True)
+class BloomDimFilter(DimFilter):
+    """Rows whose dim value is (probably) in the provided filter."""
+    dimension: str
+    bloom_b64: str
+    m_bits: int
+
+    def required_columns(self):
+        return {self.dimension}
+
+    def value_predicate(self):
+        blm = BloomFilterValue.deserialize(self.bloom_b64, self.m_bits)
+        return blm.test
+
+    def optimize(self):
+        return self
+
+    def to_json(self):
+        return {"type": "bloom", "dimension": self.dimension,
+                "bloomKFilter": self.bloom_b64, "mBits": self.m_bits}
+
+
+@dataclass(frozen=True)
+class BloomFilterAggregator(AggregatorSpec):
+    name: str
+    field: str
+    max_num_entries: int = 1500
+
+    @property
+    def m_bits(self) -> int:
+        return optimal_m_bits(self.max_num_entries)
+
+    def combining(self):
+        return BloomFilterAggregator(self.name, self.name,
+                                     self.max_num_entries)
+
+    def to_json(self):
+        return {"type": "bloom", "name": self.name, "fieldName": self.field,
+                "maxNumEntries": self.max_num_entries}
+
+
+class BloomKernel(AggKernel):
+    reduce_kind = "max"   # bit OR
+
+    def __init__(self, spec: BloomFilterAggregator, segment: Segment):
+        super().__init__(spec)
+        self.field = spec.field
+        self.m = spec.m_bits
+        col = segment.dims.get(self.field)
+        if col is None:
+            raise ValueError(
+                f"bloom aggregator needs a string dimension, got {self.field!r}")
+        self._pos_tbl = segment.aux_cached(
+            ("bloom_pos", self.field, self.m),
+            lambda: np.stack([_bit_positions(v, self.m)
+                              for v in col.dictionary.values]).astype(np.int32))
+
+    def signature(self):
+        return f"bloom({self.field},{self.m})"
+
+    def aux_arrays(self):
+        return [self._pos_tbl]
+
+    def update(self, cols, mask, keys, num, aux):
+        import jax.numpy as jnp
+        ids = cols[self.field]
+        pos = next(aux)[ids]                       # [n, k] bit positions
+        flat = (keys[:, None] * self.m + pos).reshape(-1)
+        ones = jnp.broadcast_to(mask[:, None],
+                                pos.shape).reshape(-1).astype(jnp.int32)
+        bits = _seg_max(ones, flat, num * self.m)
+        return bits.reshape(num, self.m)
+
+    def host_post(self, state, segment):
+        return np.asarray(state, dtype=np.uint8)
+
+    def host_from_device(self, state):
+        return np.asarray(state, dtype=np.uint8)
+
+    def device_combine(self, a, b):
+        import jax.numpy as jnp
+        return jnp.maximum(a, b)
+
+    def combine(self, a, b):
+        return np.maximum(a, b)
+
+    def empty_state(self, n):
+        return np.zeros((n, self.m), dtype=np.uint8)
+
+    def finalize_array(self, state):
+        arr = np.asarray(state, dtype=np.uint8)
+        out = np.empty(arr.shape[0], dtype=object)
+        for i in range(arr.shape[0]):
+            out[i] = BloomFilterValue(arr[i])
+        return out
+
+
+register_aggregator(
+    "bloom",
+    lambda j: BloomFilterAggregator(j["name"], j["fieldName"],
+                                    j.get("maxNumEntries", 1500)))
+register_kernel(BloomFilterAggregator, BloomKernel)
+register_filter(
+    "bloom",
+    lambda j: BloomDimFilter(j["dimension"], j["bloomKFilter"], j["mBits"]))

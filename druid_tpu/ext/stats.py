@@ -1,0 +1,103 @@
+"""Variance / standard deviation (reference: extensions-core/stats —
+VarianceAggregatorFactory with Welford-style combinable state, and the
+variance/stddev SQL bindings).
+
+TPU-first: the state is {count, sum, sumsq} in float64 — three segment_sums
+in one pass; combine is elementwise add (psum over ICI). Finalization
+computes population or sample variance host-side.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from druid_tpu.engine.kernels import AggKernel, _seg_sum, register_kernel
+from druid_tpu.query.aggregators import AggregatorSpec, register_aggregator
+from druid_tpu.query.postaggs import (PostAggregator, register_postagg)
+
+
+@dataclass(frozen=True)
+class VarianceAggregator(AggregatorSpec):
+    name: str
+    field: str
+    estimator: str = "population"   # population | sample
+
+    def required_columns(self):
+        return {self.field}
+
+    def combining(self):
+        return VarianceAggregator(self.name, self.name, self.estimator)
+
+    def to_json(self):
+        return {"type": "variance", "name": self.name,
+                "fieldName": self.field, "estimator": self.estimator}
+
+
+class VarianceKernel(AggKernel):
+    reduce_kind = "sum"
+
+    def __init__(self, spec: VarianceAggregator, segment):
+        super().__init__(spec)
+        self.field = spec.field
+        self.sample = spec.estimator == "sample"
+
+    def signature(self):
+        return f"variance({self.field},{self.sample})"
+
+    def update(self, cols, mask, keys, num, aux):
+        import jax.numpy as jnp
+        v = cols[self.field].astype(jnp.float64)
+        vm = jnp.where(mask, v, 0.0)
+        return {"n": _seg_sum(mask.astype(jnp.int64), keys, num),
+                "sum": _seg_sum(vm, keys, num),
+                "sumsq": _seg_sum(vm * vm, keys, num)}
+
+    def host_post(self, state, segment):
+        return {k: np.asarray(v) for k, v in state.items()}
+
+    def host_from_device(self, state):
+        return {k: np.asarray(v) for k, v in state.items()}
+
+    def device_combine(self, a, b):
+        return {k: a[k] + b[k] for k in a}
+
+    def combine(self, a, b):
+        return {k: a[k] + b[k] for k in a}
+
+    def empty_state(self, n):
+        return {"n": np.zeros(n, dtype=np.int64),
+                "sum": np.zeros(n, dtype=np.float64),
+                "sumsq": np.zeros(n, dtype=np.float64)}
+
+    def finalize_array(self, state):
+        n = np.asarray(state["n"], dtype=np.float64)
+        s = np.asarray(state["sum"])
+        ss = np.asarray(state["sumsq"])
+        denom = np.maximum(n - (1.0 if self.sample else 0.0), 1.0)
+        var = np.maximum(ss - s * s / np.maximum(n, 1.0), 0.0) / denom
+        return np.where(n > 0, var, 0.0)
+
+
+@dataclass(frozen=True)
+class StandardDeviationPostAgg(PostAggregator):
+    """reference: stats ext StandardDeviationPostAggregator."""
+    name: str
+    field: str
+
+    def compute(self, row):
+        v = row.get(self.field)
+        return np.sqrt(np.maximum(np.asarray(v, dtype=np.float64), 0.0)) \
+            if v is not None else None
+
+    def to_json(self):
+        return {"type": "stddev", "name": self.name, "fieldName": self.field}
+
+
+register_aggregator(
+    "variance",
+    lambda j: VarianceAggregator(j["name"], j["fieldName"],
+                                 j.get("estimator", "population")))
+register_kernel(VarianceAggregator, VarianceKernel)
+register_postagg("stddev",
+                 lambda j: StandardDeviationPostAgg(j["name"], j["fieldName"]))

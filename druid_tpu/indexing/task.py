@@ -245,6 +245,12 @@ class CompactionTask(Task):
         self.query_granularity = query_granularity
 
     def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        # lock FIRST, then snapshot: reading before the lock races a batch
+        # replace — the stale snapshot would republish replaced data under
+        # a newer version, silently reverting the replacement
+        lock = toolbox.lock(self, [self.interval])
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
         # only MVCC-visible segments: merging a not-yet-cleaned overshadowed
         # version would resurrect replaced data
         descs = [d for d in
@@ -253,9 +259,6 @@ class CompactionTask(Task):
                  if self.interval.contains_interval(d.interval)]
         if not descs:
             return TaskStatus.success(self.id)
-        lock = toolbox.lock(self, [self.interval])
-        if lock is None:
-            return TaskStatus.failure(self.id, "could not acquire lock")
         segments = [toolbox.pull(d) for d in descs]
         if any(s is None for s in segments):
             return TaskStatus.failure(self.id, "segment missing from deep storage")

@@ -266,8 +266,19 @@ _SIMPLE = {
 }
 
 
+# extension-registered aggregator types (the DruidModule Jackson-module
+# registration analog — see druid_tpu/ext/)
+_EXTENSION_AGGS: dict = {}
+
+
+def register_aggregator(type_name: str, from_json) -> None:
+    _EXTENSION_AGGS[type_name] = from_json
+
+
 def agg_from_json(j: dict) -> AggregatorSpec:
     t = j["type"]
+    if t in _EXTENSION_AGGS:
+        return _EXTENSION_AGGS[t](j)
     if t in _SIMPLE:
         return _SIMPLE[t](j)
     for kind in ("long", "double", "float"):

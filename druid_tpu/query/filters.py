@@ -309,12 +309,22 @@ def not_(f: DimFilter) -> DimFilter:
     return NotFilter(f).optimize()
 
 
+# extension-registered filter types (druid_tpu/ext/)
+_EXTENSION_FILTERS: dict = {}
+
+
+def register_filter(type_name: str, from_json) -> None:
+    _EXTENSION_FILTERS[type_name] = from_json
+
+
 def filter_from_json(j: Optional[dict]) -> Optional[DimFilter]:
     """JSON-polymorphic deserialization, mirroring the reference's Jackson
     @JsonSubTypes registration on DimFilter."""
     if j is None:
         return None
     t = j["type"]
+    if t in _EXTENSION_FILTERS:
+        return _EXTENSION_FILTERS[t](j)
     if t == "selector":
         return SelectorFilter(j["dimension"], j.get("value"))
     if t == "in":

@@ -146,8 +146,18 @@ class HyperUniqueFinalizingPostAgg(PostAggregator):
                 "fieldName": self.field}
 
 
+# extension-registered post-aggregator types (druid_tpu/ext/)
+_EXTENSION_POSTAGGS: dict = {}
+
+
+def register_postagg(type_name: str, from_json) -> None:
+    _EXTENSION_POSTAGGS[type_name] = from_json
+
+
 def postagg_from_json(j: dict) -> PostAggregator:
     t = j["type"]
+    if t in _EXTENSION_POSTAGGS:
+        return _EXTENSION_POSTAGGS[t](j)
     # "name" is optional on nested fields of arithmetic/greatest/least
     # (reference: ArithmeticPostAggregator's field list carries unnamed
     # fieldAccess entries in wire JSON)

@@ -484,6 +484,47 @@ class _AggBuilder:
             cls = A.FirstAggregator if e.name == "EARLIEST" else A.LastAggregator
             kind = "long" if ctype == "long" else "double"
             return reg(cls(alias, col, kind))
+        if e.name in ("VARIANCE", "VAR_POP", "VAR_SAMP", "STDDEV",
+                      "STDDEV_POP", "STDDEV_SAMP"):
+            from druid_tpu.ext.stats import (StandardDeviationPostAgg,
+                                             VarianceAggregator)
+            col, _ = self._field_for(e.args[0])
+            # SQL/Druid default: VARIANCE ≡ VAR_SAMP, STDDEV ≡ STDDEV_SAMP
+            estimator = "population" if e.name.endswith("_POP") else "sample"
+            if e.name.startswith("STDDEV"):
+                vname = self.fresh("var")
+                reg(VarianceAggregator(vname, col, estimator))
+                self.postaggs.append(StandardDeviationPostAgg(alias, vname))
+                self._agg_by_key[key] = alias
+                return alias
+            return reg(VarianceAggregator(alias, col, estimator))
+        if e.name == "APPROX_QUANTILE":
+            from druid_tpu.ext.sketches import (QuantilePostAgg,
+                                                QuantilesSketchAggregator)
+            col, _ = self._field_for(e.args[0])
+            if len(e.args) < 2 or not isinstance(e.args[1], P.Lit):
+                raise PlannerError("APPROX_QUANTILE needs a literal fraction")
+            # one sketch per (column, filter) feeds every fraction over it
+            skey = repr(("__qsketch", col, e.filter))
+            sname = self._agg_by_key.get(skey)
+            if sname is None:
+                sname = self.fresh("qs")
+                agg = QuantilesSketchAggregator(sname, col)
+                if e.filter is not None:
+                    agg = A.FilteredAggregator(
+                        sname, agg, to_filter(e.filter, self.table,
+                                              self.schema))
+                self.aggs.append(agg)
+                self._agg_by_key[skey] = sname
+            self.postaggs.append(QuantilePostAgg(
+                alias, PA.FieldAccessPostAgg(sname, sname),
+                float(e.args[1].value)))
+            self._agg_by_key[key] = alias
+            return alias
+        if e.name == "DS_THETA":
+            from druid_tpu.ext.sketches import ThetaSketchAggregator
+            col, _ = self._field_for(e.args[0])
+            return reg(ThetaSketchAggregator(alias, col, should_finalize=True))
         raise PlannerError(f"aggregate {e.name} not supported")
 
 

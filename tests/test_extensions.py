@@ -1,0 +1,261 @@
+"""Extension tests: theta/quantiles sketches, histogram, variance, bloom
+(reference: extensions-core datasketches/histogram/stats/bloom test suites)."""
+import numpy as np
+import pytest
+
+import druid_tpu.ext  # noqa: F401  (registers everything)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.ext import (ApproximateHistogramAggregator,
+                           BloomFilterAggregator, BloomFilterValue,
+                           BloomDimFilter, HistogramQuantilePostAgg,
+                           QuantilePostAgg, QuantilesSketchAggregator,
+                           ThetaSketchAggregator, ThetaSketchEstimatePostAgg,
+                           ThetaSketchSetOpPostAgg, ThetaSketchValue,
+                           VarianceAggregator, StandardDeviationPostAgg)
+from druid_tpu.query.aggregators import agg_from_json
+from druid_tpu.query.filters import filter_from_json
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   TimeseriesQuery, query_from_json)
+from druid_tpu.query.postaggs import FieldAccessPostAgg, postagg_from_json
+from tests.conftest import DAY, rows_as_frame
+
+
+@pytest.fixture(scope="module")
+def ex(segment):
+    return QueryExecutor([segment])
+
+
+def test_variance_and_stddev(ex, segment):
+    frame = rows_as_frame(segment)
+    q = TimeseriesQuery.of(
+        "test", [DAY],
+        [VarianceAggregator("var", "metFloat"),
+         VarianceAggregator("vars", "metFloat", "sample")],
+        post_aggregations=[StandardDeviationPostAgg("sd", "var")])
+    r = ex.run(q)[0]["result"]
+    x = frame["metFloat"].astype(np.float64)
+    assert r["var"] == pytest.approx(x.var(), rel=1e-6)
+    assert r["vars"] == pytest.approx(x.var(ddof=1), rel=1e-6)
+    assert r["sd"] == pytest.approx(x.std(), rel=1e-6)
+
+
+def test_variance_grouped(ex, segment):
+    frame = rows_as_frame(segment)
+    q = GroupByQuery.of("test", [DAY], [DefaultDimensionSpec("dimA")],
+                        [VarianceAggregator("var", "metLong")])
+    rows = ex.run(q)
+    for r in rows:
+        sel = frame["dimA"] == r["event"]["dimA"]
+        want = frame["metLong"][sel].astype(np.float64).var()
+        assert r["event"]["var"] == pytest.approx(want, rel=1e-6)
+
+
+def test_theta_fractional_doubles_distinct():
+    """Distinct fractional values must count distinctly (bit-pattern hash,
+    not integer truncation)."""
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.utils.intervals import Interval
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    gen = DataGenerator((ColumnSpec("m", "double", low=0.0, high=1.0),),
+                        seed=1)
+    seg = gen.segment(20_000, iv, datasource="frac")
+    exact = len(set(seg.metrics["m"].values.tolist()))
+    q = TimeseriesQuery.of("frac", [iv], [ThetaSketchAggregator("u", "m")])
+    r = QueryExecutor([seg]).run(q)[0]["result"]
+    assert r["u"] == pytest.approx(exact, rel=0.06)
+    # HLL kernel shares the fix
+    from druid_tpu.query.aggregators import CardinalityAggregator
+    q2 = TimeseriesQuery.of("frac", [iv],
+                            [CardinalityAggregator("u", ("m",), by_row=True)])
+    r2 = QueryExecutor([seg]).run(q2)[0]["result"]
+    assert r2["u"] == pytest.approx(exact, rel=0.08)
+
+
+def test_theta_estimate(ex, segment):
+    frame = rows_as_frame(segment)
+    q = TimeseriesQuery.of(
+        "test", [DAY], [ThetaSketchAggregator("u", "dimHi")])
+    r = ex.run(q)[0]["result"]
+    exact = len(set(frame["dimHi"]))
+    assert r["u"] == pytest.approx(exact, rel=0.06)
+
+
+def test_theta_set_ops(ex, segment):
+    frame = rows_as_frame(segment)
+    from druid_tpu.query.filters import BoundFilter
+    from druid_tpu.query.aggregators import FilteredAggregator
+    lo = FilteredAggregator(
+        "lo", ThetaSketchAggregator("lo", "dimHi", should_finalize=False),
+        BoundFilter("metLong", upper="60", ordering="numeric"))
+    hi = FilteredAggregator(
+        "hi", ThetaSketchAggregator("hi", "dimHi", should_finalize=False),
+        BoundFilter("metLong", lower="40", ordering="numeric"))
+    q = TimeseriesQuery.of(
+        "test", [DAY], [lo, hi],
+        post_aggregations=[
+            ThetaSketchSetOpPostAgg("u", "UNION",
+                                    (FieldAccessPostAgg("lo", "lo"),
+                                     FieldAccessPostAgg("hi", "hi"))),
+            ThetaSketchSetOpPostAgg("i", "INTERSECT",
+                                    (FieldAccessPostAgg("lo", "lo"),
+                                     FieldAccessPostAgg("hi", "hi")))])
+    r = ex.run(q)[0]["result"]
+    m = frame["metLong"]
+    a = set(frame["dimHi"][m <= 60])
+    b = set(frame["dimHi"][m >= 40])
+    assert r["u"] == pytest.approx(len(a | b), rel=0.08)
+    assert r["i"] == pytest.approx(len(a & b), rel=0.15)
+
+
+def test_quantiles_sketch(ex, segment):
+    frame = rows_as_frame(segment)
+    q = TimeseriesQuery.of(
+        "test", [DAY], [QuantilesSketchAggregator("qs", "metFloat")],
+        post_aggregations=[
+            QuantilePostAgg("p50", FieldAccessPostAgg("qs", "qs"), 0.5),
+            QuantilePostAgg("p95", FieldAccessPostAgg("qs", "qs"), 0.95)])
+    r = ex.run(q)[0]["result"]
+    x = np.sort(frame["metFloat"].astype(np.float64))
+    assert r["p50"] == pytest.approx(np.quantile(x, 0.5), rel=0.05)
+    assert r["p95"] == pytest.approx(np.quantile(x, 0.95), rel=0.05)
+
+
+def test_quantiles_negative_values():
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.utils.intervals import Interval
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    gen = DataGenerator((ColumnSpec("m", "double", distribution="normal",
+                                    mean=0.0, std=100.0),), seed=3)
+    seg = gen.segment(50_000, iv, datasource="neg")
+    q = TimeseriesQuery.of(
+        "neg", [iv], [QuantilesSketchAggregator("qs", "m")],
+        post_aggregations=[
+            QuantilePostAgg("p10", FieldAccessPostAgg("qs", "qs"), 0.10),
+            QuantilePostAgg("p90", FieldAccessPostAgg("qs", "qs"), 0.90)])
+    r = QueryExecutor([seg]).run(q)[0]["result"]
+    x = seg.metrics["m"].values.astype(np.float64)
+    assert r["p10"] == pytest.approx(np.quantile(x, 0.10), rel=0.06)
+    assert r["p90"] == pytest.approx(np.quantile(x, 0.90), rel=0.06)
+
+
+def test_histogram(ex, segment):
+    frame = rows_as_frame(segment)
+    q = TimeseriesQuery.of(
+        "test", [DAY],
+        [ApproximateHistogramAggregator("h", "metLong", 50, 0.0, 101.0)],
+        post_aggregations=[
+            HistogramQuantilePostAgg("med", FieldAccessPostAgg("h", "h"),
+                                     0.5)])
+    r = ex.run(q)[0]["result"]
+    x = frame["metLong"].astype(np.float64)
+    assert r["h"].count == len(x)
+    assert r["h"].min == x.min() and r["h"].max == x.max()
+    assert r["med"] == pytest.approx(np.quantile(x, 0.5), abs=3.0)
+    j = r["h"].to_json()
+    assert sum(j["counts"]) == len(x) and len(j["breaks"]) == 51
+
+
+def test_bloom_aggregator_and_filter(ex, segment):
+    frame = rows_as_frame(segment)
+    q = TimeseriesQuery.of(
+        "test", [DAY], [BloomFilterAggregator("b", "dimA")])
+    blm = ex.run(q)[0]["result"]["b"]
+    for v in set(frame["dimA"]):
+        assert blm.test(v)
+    misses = sum(blm.test(f"nope{i}") for i in range(1000))
+    assert misses < 30                      # ~1% target fpp
+    # serde round trip + filter usage
+    b64 = blm.serialize()
+    restored = BloomFilterValue.deserialize(b64, blm.m_bits)
+    assert np.array_equal(restored.bits, blm.bits)
+    some = sorted(set(frame["dimA"]))[:3]
+    partial = TimeseriesQuery.of(
+        "test", [DAY], [BloomFilterAggregator("b", "dimA")],
+        filter=filter_from_json({"type": "in", "dimension": "dimA",
+                                 "values": some}))
+    blm2 = ex.run(partial)[0]["result"]["b"]
+    flt = BloomDimFilter("dimA", blm2.serialize(), blm2.m_bits)
+    from druid_tpu.query.aggregators import CountAggregator
+    n = ex.run(TimeseriesQuery.of("test", [DAY], [CountAggregator("n")],
+                                  filter=flt))[0]["result"]["n"]
+    want = int(np.isin(frame["dimA"], some).sum())
+    assert n == want
+
+
+def test_extension_json_serde(segment):
+    for j in [
+        {"type": "variance", "name": "v", "fieldName": "m"},
+        {"type": "thetaSketch", "name": "t", "fieldName": "d"},
+        {"type": "quantilesDoublesSketch", "name": "q", "fieldName": "m"},
+        {"type": "approxHistogram", "name": "h", "fieldName": "m",
+         "numBuckets": 10, "lowerLimit": 0.0, "upperLimit": 1.0},
+        {"type": "bloom", "name": "b", "fieldName": "d"},
+    ]:
+        spec = agg_from_json(j)
+        j2 = spec.to_json()
+        assert agg_from_json(j2).to_json() == j2
+    pa = postagg_from_json({
+        "type": "quantilesDoublesSketchToQuantile", "name": "p",
+        "field": {"type": "fieldAccess", "fieldName": "q"}, "fraction": 0.9})
+    assert pa.to_json()["fraction"] == 0.9
+    # full query through JSON wire with extension aggs
+    q = query_from_json({
+        "queryType": "timeseries", "dataSource": "test",
+        "intervals": [str(DAY)], "granularity": "all",
+        "aggregations": [{"type": "variance", "name": "v",
+                          "fieldName": "metFloat"}]})
+    r = QueryExecutor([segment]).run(q)
+    assert r[0]["result"]["v"] > 0
+
+
+def test_extension_sql(segment):
+    from druid_tpu.sql import SqlExecutor
+    frame = rows_as_frame(segment)
+    sq = SqlExecutor(QueryExecutor([segment]))
+    _, rows = sq.execute(
+        "SELECT STDDEV(metFloat) sd, STDDEV_POP(metFloat) sdp, "
+        "VARIANCE(metFloat) v, APPROX_QUANTILE(metFloat, 0.5) med, "
+        "APPROX_QUANTILE(metFloat, 0.9) p90, DS_THETA(dimHi) u FROM test")
+    x = frame["metFloat"].astype(np.float64)
+    sd, sdp, v, med, p90, u = rows[0]
+    # SQL STDDEV/VARIANCE are the SAMPLE estimators (Druid parity)
+    assert sd == pytest.approx(x.std(ddof=1), rel=1e-6)
+    assert sdp == pytest.approx(x.std(), rel=1e-6)
+    assert v == pytest.approx(x.var(ddof=1), rel=1e-6)
+    assert med == pytest.approx(np.quantile(x, 0.5), rel=0.05)
+    assert p90 == pytest.approx(np.quantile(x, 0.9), rel=0.05)
+    assert u == pytest.approx(len(set(frame["dimHi"])), rel=0.06)
+    # the two quantiles share ONE sketch aggregator
+    plan = sq.explain("SELECT APPROX_QUANTILE(metFloat, 0.5), "
+                      "APPROX_QUANTILE(metFloat, 0.9) FROM test")
+    assert len(plan["aggregations"]) == 1
+
+
+def test_extension_sharded_merge(segments):
+    """Extension states must merge across segments (and the broker path)."""
+    from druid_tpu.cluster import Broker, DataNode, InventoryView, descriptor_for
+    from druid_tpu.utils.intervals import Interval
+    week = Interval.of("2026-01-01", "2026-01-08")
+    frames = [rows_as_frame(s) for s in segments]
+    allf = np.concatenate([f["metFloat"] for f in frames]).astype(np.float64)
+    q = TimeseriesQuery.of(
+        "test", [week],
+        [VarianceAggregator("v", "metFloat"),
+         QuantilesSketchAggregator("qs", "metFloat"),
+         ThetaSketchAggregator("u", "dimHi")],
+        post_aggregations=[
+            QuantilePostAgg("p50", FieldAccessPostAgg("qs", "qs"), 0.5)])
+    local = QueryExecutor(segments).run(q)[0]["result"]
+    assert local["v"] == pytest.approx(allf.var(), rel=1e-6)
+    assert local["p50"] == pytest.approx(np.quantile(allf, 0.5), rel=0.05)
+    view = InventoryView()
+    nodes = [DataNode(f"n{i}") for i in range(2)]
+    for n in nodes:
+        view.register(n)
+    for i, s in enumerate(segments):
+        nodes[i % 2].load_segment(s)
+        view.announce(nodes[i % 2].name, descriptor_for(s))
+    remote = Broker(view).run(q)[0]["result"]
+    assert remote["v"] == pytest.approx(local["v"], rel=1e-12)
+    assert remote["p50"] == local["p50"]
+    assert remote["u"] == local["u"]       # exact state merge across nodes
