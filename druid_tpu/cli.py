@@ -1,0 +1,169 @@
+"""CLI: node commands + tools.
+
+Reference analog: services/src/main/java/org/apache/druid/cli/Main.java:52-112
+— server commands (historical, broker, coordinator, overlord, …) and tools
+(DumpSegment, ValidateSegments, CreateTables, ResetCluster).
+
+`python -m druid_tpu <command>`:
+  server  — one process hosting the whole stack (metadata + coordinator +
+            data nodes + broker + overlord + HTTP endpoints); the
+            in-process analog of a single-server deployment
+  dump-segment     — segment introspection (cli/DumpSegment.java)
+  validate-segment — verify an on-disk segment loads and self-checks
+  version
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+VERSION = "druid-tpu-0.1"
+
+
+def cmd_server(args) -> int:
+    from druid_tpu.cluster import (Broker, Coordinator, DataNode,
+                                   DynamicConfig, InventoryView, LruCache,
+                                   MetadataStore)
+    from druid_tpu.indexing import Overlord
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle, RequestLogger
+    from druid_tpu.sql import SqlExecutor
+    from druid_tpu.storage.deep import LocalDeepStorage
+    from druid_tpu.utils.config import Config
+    from druid_tpu.utils.emitter import (MonitorScheduler, ProcessMonitor,
+                                         ServiceEmitter, SysMonitor,
+                                         emitter_from_config)
+    import druid_tpu.ext  # noqa: F401  (activate extensions)
+
+    cfg = Config.load(args.config)
+    metadata = MetadataStore(cfg.get("metadata.path", ":memory:"))
+    deep = LocalDeepStorage(cfg.get("storage.dir", "./deep-storage"))
+    view = InventoryView()
+    n_nodes = cfg.get_int("server.dataNodes", 1)
+    for i in range(n_nodes):
+        view.register(DataNode(f"data{i}", cache=LruCache()))
+    coordinator = Coordinator(metadata, view, deep.pull, DynamicConfig())
+    broker = Broker(view, cache=LruCache())
+    overlord = Overlord(metadata, deep)
+
+    emitter = ServiceEmitter(
+        "druid-tpu/server", "localhost",
+        emitter_from_config(cfg.get("emitter.type", "noop"),
+                            **cfg.subtree("emitter")
+                            if cfg.get("emitter.type") == "file" else {}))
+    logger = RequestLogger(cfg.get("request.log.path"))
+    lifecycle = QueryLifecycle(broker, emitter, logger)
+    sql = SqlExecutor(broker)
+    http = QueryHttpServer(lifecycle, sql, port=cfg.get_int("server.port",
+                                                            8082))
+    http.start()
+    monitors = MonitorScheduler(emitter, [SysMonitor(), ProcessMonitor()],
+                                cfg.get_float("monitor.period", 60.0))
+    monitors.start()
+    print(f"druid-tpu server listening on :{http.port} "
+          f"({n_nodes} data node(s))", flush=True)
+
+    period = cfg.get_float("coordinator.period", 10.0)
+    try:
+        while True:
+            coordinator.run_once()
+            time.sleep(period)
+    except KeyboardInterrupt:
+        http.stop()
+        overlord.shutdown()
+        return 0
+
+
+def cmd_dump_segment(args) -> int:
+    """Segment forensics (cli/DumpSegment.java)."""
+    from druid_tpu.storage.format import load_segment, read_segment_meta
+    meta = read_segment_meta(args.directory)
+    out = {"metadata": meta}
+    if args.rows:
+        args.full = True   # --rows implies loading the segment
+    if args.full:
+        seg = load_segment(args.directory)
+        cols = {}
+        for name, col in seg.dims.items():
+            cols[name] = {"type": "string",
+                          "cardinality": col.cardinality,
+                          "hasBitmapIndex": True}
+        for name, m in seg.metrics.items():
+            t = m.type.value if hasattr(m.type, "value") else str(m.type)
+            cols[name] = {"type": t}
+        out["columns"] = cols
+        out["numRows"] = seg.n_rows
+        out["interval"] = str(seg.interval)
+        if args.rows:
+            from druid_tpu.query.model import ScanQuery
+            from druid_tpu.engine.engines import run_scan
+            batches = run_scan(
+                ScanQuery.of(seg.id.datasource, [seg.interval],
+                             limit=args.rows), [seg])
+            out["rows"] = [e for b in batches for e in b["events"]]
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def cmd_validate_segment(args) -> int:
+    """Load + self-check an on-disk segment (cli/ValidateSegments.java)."""
+    from druid_tpu.storage.format import load_segment
+    try:
+        seg = load_segment(args.directory)
+    except Exception as e:
+        print(f"INVALID: cannot load: {e}", file=sys.stderr)
+        return 1
+    problems = []
+    n = seg.n_rows
+    if len(seg.time_ms) != n:
+        problems.append("time column length mismatch")
+    for name, col in seg.dims.items():
+        if len(col.ids) != n:
+            problems.append(f"dim {name}: id column length {len(col.ids)}")
+        if n and (col.ids.max() >= col.cardinality or col.ids.min() < 0):
+            problems.append(f"dim {name}: id out of dictionary range")
+        vals = col.dictionary.values
+        if list(vals) != sorted(vals):
+            problems.append(f"dim {name}: dictionary not sorted")
+    for name, m in seg.metrics.items():
+        if len(m.values) != n:
+            problems.append(f"metric {name}: length {len(m.values)}")
+    if n and not (seg.time_ms[:-1] <= seg.time_ms[1:]).all():
+        problems.append("rows not time-sorted")
+    if problems:
+        print("INVALID: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    print(f"OK: {seg.id} rows={n} dims={len(seg.dims)} "
+          f"metrics={len(seg.metrics)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="druid_tpu",
+                                description="TPU-native analytics engine")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("server", help="run the single-process cluster")
+    s.add_argument("--config", default=None, help="properties/json file")
+    s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("dump-segment", help="inspect an on-disk segment")
+    s.add_argument("directory")
+    s.add_argument("--full", action="store_true", help="load + column stats")
+    s.add_argument("--rows", type=int, default=0, help="dump first N rows")
+    s.set_defaults(fn=cmd_dump_segment)
+
+    s = sub.add_parser("validate-segment", help="check an on-disk segment")
+    s.add_argument("directory")
+    s.set_defaults(fn=cmd_validate_segment)
+
+    s = sub.add_parser("version")
+    s.set_defaults(fn=lambda a: (print(VERSION), 0)[1])
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,13 +41,19 @@ class VarianceKernel(AggKernel):
         super().__init__(spec)
         self.field = spec.field
         self.sample = spec.estimator == "sample"
+        if self.field in segment.dims:
+            raise ValueError(
+                f"variance over string dimension {self.field!r} — it would "
+                f"aggregate dictionary ids, not values")
 
     def signature(self):
         return f"variance({self.field},{self.sample})"
 
     def update(self, cols, mask, keys, num, aux):
         import jax.numpy as jnp
-        v = cols[self.field].astype(jnp.float64)
+        v = cols[self.field] if self.field != "__time" \
+            else cols["__time_offset"]
+        v = v.astype(jnp.float64)
         vm = jnp.where(mask, v, 0.0)
         return {"n": _seg_sum(mask.astype(jnp.int64), keys, num),
                 "sum": _seg_sum(vm, keys, num),

@@ -1,0 +1,4 @@
+from druid_tpu.server.lifecycle import QueryLifecycle, RequestLogger
+from druid_tpu.server.http import QueryHttpServer
+
+__all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer"]

@@ -1,0 +1,126 @@
+"""HTTP query endpoints.
+
+Reference analogs:
+  server/QueryResource.java:77,126,153-156 — POST /druid/v2/ (native JSON),
+    DELETE /druid/v2/{id} cancel, datasource listing
+  sql/.../http/SqlResource.java:58,75-78 — POST /druid/v2/sql
+  /status — the common status endpoint every node serves
+
+stdlib ThreadingHTTPServer stands in for Jetty; the wire format (JSON
+payloads/results) matches the reference so existing Druid HTTP clients map
+1:1. Streaming chunked responses collapse to one JSON body — results are
+materialized host-side anyway after device execution.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from druid_tpu.server.lifecycle import QueryLifecycle, Unauthorized
+
+
+def _json_value(obj):
+    """Render extension values (sketches, histograms, bloom filters) the way
+    the reference serializes complex agg results: structured JSON where the
+    type defines one (histogram), base64 where it's opaque bits (bloom),
+    estimates for sketches."""
+    if hasattr(obj, "serialize"):
+        return obj.serialize()
+    if hasattr(obj, "to_json"):
+        return obj.to_json()
+    if hasattr(obj, "estimate"):
+        return obj.estimate
+    import numpy as np
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"not JSON serializable: {type(obj).__name__}")
+
+
+class QueryHttpServer:
+    """Serves a QueryLifecycle (+ optional SqlExecutor) over HTTP."""
+
+    def __init__(self, lifecycle: QueryLifecycle, sql_executor=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.lifecycle = lifecycle
+        self.sql_executor = sql_executor
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet
+                pass
+
+            def _reply(self, code: int, body: dict | list):
+                data = json.dumps(body, default=_json_value).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._reply(200, {"version": "druid-tpu-0.1",
+                                      "modules": []})
+                elif self.path in ("/druid/v2/datasources",
+                                   "/druid/v2/datasources/"):
+                    self._reply(200, outer._datasources())
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                try:
+                    payload = self._body()
+                    if self.path.rstrip("/") == "/druid/v2/sql":
+                        if outer.sql_executor is None:
+                            self._reply(404, {"error": "SQL not enabled"})
+                            return
+                        cols, rows = outer.sql_executor.execute(
+                            payload["query"],
+                            payload.get("parameters") or ())
+                        fmt = payload.get("resultFormat", "object")
+                        if fmt == "array":
+                            self._reply(200, rows)
+                        else:
+                            self._reply(200, [dict(zip(cols, r))
+                                              for r in rows])
+                    elif self.path.rstrip("/") == "/druid/v2":
+                        rows = outer.lifecycle.run_json(
+                            payload, identity=self.headers.get(
+                                "X-Druid-Identity"))
+                        self._reply(200, rows)
+                    else:
+                        self._reply(404, {"error": "unknown path"})
+                except Unauthorized as e:
+                    self._reply(403, {"error": str(e)})
+                except (ValueError, KeyError) as e:
+                    # bad query = client error (QueryResource's
+                    # BadJsonQueryException handling)
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _datasources(self):
+        r = self.lifecycle.runner
+        return list(getattr(r, "datasources", []) or [])
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,110 @@
+"""Per-query lifecycle: initialize → authorize → execute → emit logs/metrics.
+
+Reference analogs:
+  server/QueryLifecycle.java:61-69,120-133 — the four-phase lifecycle every
+    query goes through, emitting query/time metrics and request logs
+  processing/.../query/QueryMetrics.java + MetricsEmittingQueryRunner —
+    per-query timing dims (query id, type, datasource, success)
+  server/log/FileRequestLogger.java / EmittingRequestLogger — request logs
+  server/security/Authenticator/Authorizer — pluggable auth SPI chain
+    (allow-all default, like the reference's AllowAllAuthorizer)
+"""
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Callable, List, Optional
+
+from druid_tpu.query.model import Query, query_from_json
+from druid_tpu.utils.emitter import ServiceEmitter
+
+
+class Unauthorized(PermissionError):
+    pass
+
+
+class RequestLogger:
+    """NDJSON request log (FileRequestLogger pattern); None path = memory,
+    bounded to the most recent `max_entries` so long-running servers don't
+    grow without bound."""
+
+    def __init__(self, path: Optional[str] = None, max_entries: int = 10_000):
+        from collections import deque
+        self.path = path
+        self.entries = deque(maxlen=max_entries)
+        self._fh = open(path, "a") if path else None
+
+    def log(self, entry: dict) -> None:
+        if self._fh is not None:
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+        else:
+            self.entries.append(entry)
+
+
+class QueryLifecycle:
+    """Wraps any runner (QueryExecutor / Broker) with auth, metrics,
+    request logging, and query-id bookkeeping."""
+
+    def __init__(self, runner,
+                 emitter: Optional[ServiceEmitter] = None,
+                 request_logger: Optional[RequestLogger] = None,
+                 authorizer: Optional[Callable[[Optional[str], Query], bool]] = None,
+                 on_result: Optional[Callable[[bool], None]] = None):
+        self.runner = runner
+        self.emitter = emitter
+        self.request_logger = request_logger
+        self.authorizer = authorizer          # (identity, query) → allowed
+        self.on_result = on_result            # QueryCountStatsMonitor hook
+
+    def run_json(self, payload: dict, identity: Optional[str] = None):
+        try:
+            query = query_from_json(payload)
+        except (ValueError, KeyError, TypeError):
+            # malformed queries count as failures at the resource layer
+            if self.on_result:
+                self.on_result(False)
+            raise
+        return self.run(query, identity)
+
+    def run(self, query: Query, identity: Optional[str] = None):
+        qid = query.context_map.get("queryId") or str(uuid.uuid4())
+        if self.authorizer is not None and not self.authorizer(identity, query):
+            self._log(query, qid, 0.0, False, error="unauthorized")
+            raise Unauthorized(f"identity {identity!r} denied on "
+                               f"[{query.datasource}]")
+        t0 = time.monotonic()
+        try:
+            rows = self.runner.run(query)
+        except Exception as e:
+            ms = (time.monotonic() - t0) * 1000
+            self._log(query, qid, ms, False, error=str(e))
+            if self.on_result:
+                self.on_result(False)
+            raise
+        ms = (time.monotonic() - t0) * 1000
+        self._log(query, qid, ms, True, n_rows=_count_rows(rows))
+        if self.on_result:
+            self.on_result(True)
+        return rows
+
+    def _log(self, query: Query, qid: str, ms: float, ok: bool,
+             error: Optional[str] = None, n_rows: int = 0) -> None:
+        if self.emitter is not None:
+            self.emitter.metric("query/time", ms, dataSource=query.datasource,
+                                type=query.query_type, id=qid,
+                                success=str(ok).lower())
+        if self.request_logger is not None:
+            self.request_logger.log({
+                "timestamp": int(time.time() * 1000), "queryId": qid,
+                "queryType": query.query_type,
+                "dataSource": query.datasource, "query/time": ms,
+                "success": ok, "error": error, "rows": n_rows})
+
+
+def _count_rows(rows) -> int:
+    try:
+        return len(rows)
+    except TypeError:
+        return 0

@@ -1,0 +1,271 @@
+"""Aux subsystems: emitter/monitors, config, query lifecycle, HTTP
+endpoints, CLI tools (reference: emitter core, JsonConfigProvider,
+QueryLifecycle, QueryResource/SqlResource, DumpSegment)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import CountAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.server import QueryHttpServer, QueryLifecycle, RequestLogger
+from druid_tpu.server.lifecycle import Unauthorized
+from druid_tpu.sql import SqlExecutor
+from druid_tpu.utils.config import Config
+from druid_tpu.utils.emitter import (BatchingEmitter, CacheMonitor,
+                                     ComposingEmitter, Event, FileEmitter,
+                                     InMemoryEmitter, MonitorScheduler,
+                                     ProcessMonitor, QueryCountStatsMonitor,
+                                     ServiceEmitter, SysMonitor)
+from tests.conftest import DAY
+
+
+# ---------------------------------------------------------------------------
+# Emitter + monitors
+# ---------------------------------------------------------------------------
+
+def test_service_emitter_stamps_dims():
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("druid-tpu/test", "h1", sink)
+    em.metric("query/time", 12.5, dataSource="wiki")
+    e = sink.metrics("query/time")[0]
+    assert e.dims == {"dataSource": "wiki", "service": "druid-tpu/test",
+                      "host": "h1"}
+    j = e.to_json()
+    assert j["feed"] == "metrics" and j["value"] == 12.5
+
+
+def test_batching_emitter():
+    batches = []
+    be = BatchingEmitter(batches.append, batch_size=3)
+    em = ServiceEmitter("s", "h", be)
+    for i in range(7):
+        em.metric("m", i)
+    assert len(batches) == 2 and all(len(b) == 3 for b in batches)
+    be.flush()
+    assert sum(len(b) for b in batches) == 7
+
+
+def test_file_emitter(tmp_path):
+    path = str(tmp_path / "metrics.log")
+    em = ServiceEmitter("s", "h", FileEmitter(path))
+    em.metric("a", 1)
+    em.metric("b", 2)
+    em.flush()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["metric"] for l in lines] == ["a", "b"]
+
+
+def test_monitors_emit():
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("s", "h", sink)
+    qc = QueryCountStatsMonitor()
+    qc.on_query(True)
+    qc.on_query(False)
+    from druid_tpu.cluster import LruCache
+    cache = LruCache()
+    cache.put("x", "k", 1)
+    cache.get("x", "k")
+    sched = MonitorScheduler(em, [SysMonitor(), ProcessMonitor(), qc,
+                                  CacheMonitor(cache)], 999)
+    sched.tick()
+    sched.tick()   # SysMonitor cpu needs two samples
+    names = {e.metric for e in sink.metrics()}
+    assert {"proc/rss", "query/count", "query/success/count",
+            "query/cache/total/hits"} <= names
+    assert sink.metrics("query/success/count")[0].value == 1
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+def test_config_layers(tmp_path):
+    f = tmp_path / "runtime.properties"
+    f.write_text("server.port=8082\n# comment\nquery.cache=true\n")
+    cfg = Config.load(str(f), env={"DRUID_TPU_SERVER_PORT": "9000"},
+                      overrides={"metadata.path": ":memory:"})
+    assert cfg.get_int("server.port") == 9000      # env beats file
+    assert cfg.get_bool("query.cache")
+    assert cfg.get("metadata.path") == ":memory:"
+
+
+def test_config_json_and_select(tmp_path):
+    f = tmp_path / "conf.json"
+    f.write_text(json.dumps({"storage": {"type": "local", "dir": "/x"}}))
+    cfg = Config.load(str(f), env={})
+    assert cfg.get("storage.type") == "local"
+    assert cfg.subtree("storage") == {"type": "local", "dir": "/x"}
+    made = cfg.select("storage.type",
+                      {"local": lambda: "L", "memory": lambda: "M"},
+                      default="memory")
+    assert made == "L"
+    with pytest.raises(ValueError):
+        cfg.with_overrides({"storage.type": "bogus"}).select(
+            "storage.type", {"local": lambda: 1}, default="local")
+
+
+# ---------------------------------------------------------------------------
+# Query lifecycle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lifecycle_parts(segment):
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("broker", "h", sink)
+    logger = RequestLogger()
+    qc = QueryCountStatsMonitor()
+    lc = QueryLifecycle(QueryExecutor([segment]), em, logger,
+                        authorizer=lambda ident, q: ident != "evil",
+                        on_result=qc.on_query)
+    return lc, sink, logger, qc
+
+
+def test_lifecycle_metrics_and_logs(lifecycle_parts, segment):
+    lc, sink, logger, qc = lifecycle_parts
+    rows = lc.run(TimeseriesQuery.of("test", [DAY], [CountAggregator("n")]))
+    assert rows[0]["result"]["n"] == segment.n_rows
+    m = sink.metrics("query/time")[0]
+    assert m.dims["dataSource"] == "test" and m.dims["success"] == "true"
+    assert logger.entries[0]["queryType"] == "timeseries"
+    assert logger.entries[0]["success"] is True
+    assert qc.success == 1
+
+
+def test_lifecycle_auth_and_errors(lifecycle_parts):
+    lc, sink, logger, qc = lifecycle_parts
+    q = TimeseriesQuery.of("test", [DAY], [CountAggregator("n")])
+    with pytest.raises(Unauthorized):
+        lc.run(q, identity="evil")
+    assert logger.entries[-1]["error"] == "unauthorized"
+    with pytest.raises(Exception):
+        lc.run_json({"queryType": "timeseries", "dataSource": "test",
+                     "intervals": [str(DAY)], "granularity": "all",
+                     "aggregations": [{"type": "nope", "name": "x"}]})
+    assert qc.failed >= 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def http_server(segment):
+    ex = QueryExecutor([segment])
+    lc = QueryLifecycle(ex)
+    srv = QueryHttpServer(lc, SqlExecutor(ex), port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_http_native_query(http_server, segment):
+    base = f"http://127.0.0.1:{http_server.port}"
+    status, rows = _post(f"{base}/druid/v2", {
+        "queryType": "timeseries", "dataSource": "test",
+        "intervals": [str(DAY)], "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}]})
+    assert status == 200 and rows[0]["result"]["n"] == segment.n_rows
+
+
+def test_http_sql(http_server, segment):
+    base = f"http://127.0.0.1:{http_server.port}"
+    status, rows = _post(f"{base}/druid/v2/sql",
+                         {"query": "SELECT COUNT(*) n FROM test"})
+    assert status == 200 and rows == [{"n": segment.n_rows}]
+    status, rows = _post(f"{base}/druid/v2/sql",
+                         {"query": "SELECT COUNT(*) FROM test",
+                          "resultFormat": "array"})
+    assert status == 200 and rows == [[segment.n_rows]]
+
+
+def test_http_status_and_errors(http_server):
+    base = f"http://127.0.0.1:{http_server.port}"
+    with urllib.request.urlopen(f"{base}/status") as r:
+        assert json.loads(r.read())["version"].startswith("druid-tpu")
+    with urllib.request.urlopen(f"{base}/druid/v2/datasources") as r:
+        assert json.loads(r.read()) == ["test"]
+    status, err = _post(f"{base}/druid/v2", {"queryType": "bogus"})
+    assert status == 400 and "error" in err
+    status, err = _post(f"{base}/druid/v2/sql", {"query": "SELECT x FROM"})
+    assert status == 400
+
+
+# ---------------------------------------------------------------------------
+# CLI tools
+# ---------------------------------------------------------------------------
+
+def test_cli_dump_and_validate(tmp_path, segment, capsys):
+    from druid_tpu.cli import main
+    from druid_tpu.storage.format import persist_segment
+    d = str(tmp_path / "seg")
+    persist_segment(segment, d)
+    assert main(["validate-segment", d]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out and f"rows={segment.n_rows}" in out
+    assert main(["dump-segment", d, "--full", "--rows", "2"]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert dump["numRows"] == segment.n_rows
+    assert dump["columns"]["dimA"]["cardinality"] == \
+        segment.dims["dimA"].cardinality
+    assert len(dump["rows"]) == 2
+    assert main(["version"]) == 0
+
+
+def test_http_serializes_extension_values(segment):
+    import druid_tpu.ext  # noqa: F401
+    ex = QueryExecutor([segment])
+    srv = QueryHttpServer(QueryLifecycle(ex), port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, rows = _post(f"{base}/druid/v2", {
+            "queryType": "timeseries", "dataSource": "test",
+            "intervals": [str(DAY)], "granularity": "all",
+            "aggregations": [
+                {"type": "bloom", "name": "b", "fieldName": "dimA"},
+                {"type": "approxHistogram", "name": "h",
+                 "fieldName": "metLong", "numBuckets": 8,
+                 "lowerLimit": 0.0, "upperLimit": 101.0}]})
+        assert status == 200
+        r = rows[0]["result"]
+        assert isinstance(r["b"], str)                  # base64 bloom
+        assert sum(r["h"]["counts"]) == segment.n_rows  # structured hist
+    finally:
+        srv.stop()
+
+
+def test_variance_field_handling(segment):
+    from druid_tpu.ext import VarianceAggregator
+    ex = QueryExecutor([segment])
+    with pytest.raises(ValueError):
+        ex.run(TimeseriesQuery.of("test", [DAY],
+                                  [VarianceAggregator("v", "dimA")]))
+    rows = ex.run(TimeseriesQuery.of("test", [DAY],
+                                     [VarianceAggregator("v", "__time")]))
+    t = segment.time_ms.astype(np.float64)
+    assert rows[0]["result"]["v"] == pytest.approx(t.var(), rel=1e-9)
+
+
+def test_config_env_camelcase(tmp_path):
+    cfg = Config.load(env={"DRUID_TPU_SERVER_DATANODES": "4"})
+    assert cfg.get_int("server.dataNodes", 1) == 4
+
+
+def test_cli_validate_rejects_garbage(tmp_path, capsys):
+    from druid_tpu.cli import main
+    d = tmp_path / "bad"
+    d.mkdir()
+    (d / "meta.smoosh").write_text("garbage")
+    assert main(["validate-segment", str(d)]) == 1
