@@ -69,11 +69,15 @@ def main():
         granularity="all",
         filter=BoundFilter("metLong", lower=100, upper=9_900,
                            ordering="numeric"))
+    # filter on REAL dictionary values (half of dimA) — a padded-format
+    # mismatch here would silently benchmark an empty-result query
+    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
+    assert len(dimA_vals) >= 100, "unexpected dimA cardinality"
     topn = TopNQuery.of(
         "bench", [interval], "dimB", "lsum", 100,
         [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
         granularity="all",
-        filter=InFilter("dimA", [f"v{i}" for i in range(0, 100, 2)]))
+        filter=InFilter("dimA", dimA_vals[0:100:2]))
 
     executor = QueryExecutor(segments, mesh=make_mesh(1))
 

@@ -103,6 +103,15 @@ class Broker:
 
     # ---- the signature path (§3.1) -------------------------------------
     def run(self, query: Query):
+        if query.inner_query is not None:
+            # subquery: inner runs cluster-wide; the outer re-groups the
+            # materialized inner rows broker-locally (as the reference's
+            # broker does for nested groupBys)
+            from druid_tpu.engine.executor import (QueryExecutor,
+                                                   subquery_segment)
+            inner_rows = self.run(query.inner_query)
+            seg = subquery_segment(query.inner_query, inner_rows)
+            return QueryExecutor().run(query, segments=[seg])
         segments = self._segments_to_query(query)
         if not segments:
             return []
@@ -112,11 +121,16 @@ class Broker:
 
     def _segments_to_query(self, query: Query) -> List[SegmentDescriptor]:
         """Timeline lookup + shard pruning (computeSegmentsToQuery)."""
-        tl = self.view.timeline(query.datasource)
-        if tl is None:
-            return []
-        domain = _filter_domain(query.filter) if query.filter is not None else {}
+        datasources = query.union_datasources or (query.datasource,)
         out, seen = [], set()
+        for ds in datasources:
+            tl = self.view.timeline(ds)
+            if tl is not None:
+                self._collect(tl, query, out, seen)
+        return out
+
+    def _collect(self, tl, query: Query, out, seen) -> None:
+        domain = _filter_domain(query.filter) if query.filter is not None else {}
         for iv in condense(query.intervals):
             for holder in tl.lookup(iv):
                 for chunk in holder.partitions:
@@ -129,7 +143,6 @@ class Broker:
                             and not d.shard_spec.possible_in_domain(domain):
                         continue
                     out.append(d)
-        return out
 
     # ---- aggregate path: partials + broker-side finish -----------------
     def _run_aggregate(self, query: Query,

@@ -57,8 +57,20 @@ class QueryExecutor:
 
     # ---- execution -----------------------------------------------------
     def run(self, query: Query, segments: Optional[Sequence[Segment]] = None):
-        segs = list(segments) if segments is not None \
-            else self._by_ds.get(query.datasource, [])
+        if segments is not None:
+            segs = list(segments)
+        elif query.inner_query is not None:
+            # subquery: materialize inner results as a segment (the analog
+            # of GroupByStrategyV2.processSubqueryResult re-grouping inner
+            # rows through an in-memory index)
+            inner_rows = self.run(query.inner_query)
+            segs = [subquery_segment(query.inner_query, inner_rows)]
+        elif query.union_datasources:
+            segs = []
+            for d in query.union_datasources:
+                segs.extend(self._by_ds.get(d, []))
+        else:
+            segs = self._by_ds.get(query.datasource, [])
         if self.mesh is not None:
             from druid_tpu.parallel import use_mesh
             with use_mesh(self.mesh):
@@ -89,3 +101,29 @@ class QueryExecutor:
     def run_json(self, query_json: dict):
         """Execute a reference-wire-format JSON query."""
         return self.run(query_from_json(query_json))
+
+
+def subquery_segment(inner_query: Query, rows) -> Segment:
+    """Materialize inner groupBy results as an in-memory segment so the
+    outer query runs through the ordinary engines (the reference re-groups
+    subquery rows through an IncrementalIndex —
+    GroupByStrategyV2.processSubqueryResult :322)."""
+    from druid_tpu.data.segment import SegmentBuilder
+    from druid_tpu.utils.intervals import Interval, condense
+
+    if not isinstance(inner_query, GroupByQuery):
+        raise ValueError("query dataSource requires a groupBy inner query")
+    dim_names = [d.output_name for d in inner_query.dimensions]
+    ivs = condense(inner_query.intervals)
+    interval = Interval(min(iv.start for iv in ivs),
+                        max(iv.end for iv in ivs)) if ivs \
+        else Interval.eternity()
+    b = SegmentBuilder("__subquery__", interval, version="sub")
+    for r in rows:
+        event = r["event"]
+        dims = {d: (None if event.get(d) is None else str(event.get(d)))
+                for d in dim_names}
+        metrics = {k: v for k, v in event.items()
+                   if k not in dims and isinstance(v, (int, float))}
+        b.add_row(int(r["timestamp"]), dims, metrics)
+    return b.build()

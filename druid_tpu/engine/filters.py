@@ -406,8 +406,15 @@ def _plan(flt: F.DimFilter, segment: Segment,
                                  -(2**31) + 1), 2**31 - 2)
     elif dim in segment.metrics:
         vt = segment.metrics[dim].type
-        dtype, colname = vt.numpy_dtype, dim
+        # compare in the column's STAGED dtype — an int64 constant against
+        # an int32-narrowed column would promote the whole compare to
+        # emulated 64-bit ops on device
+        dtype, colname = segment.staged_dtype(dim), dim
         conv = (int if vt == ValueType.LONG else float)
+        if vt == ValueType.LONG and dtype == np.int32:
+            # constants outside int32 have constant outcomes (every value
+            # fits int32 — that is why the column staged narrow)
+            return _plan_narrow_long(flt, colname)
     elif dim in vc_types:
         t = vc_types[dim]
         dtype = {"long": np.int64, "float": np.float32}.get(t, np.float64)
@@ -431,6 +438,43 @@ def _plan(flt: F.DimFilter, segment: Segment,
         hi = conv(flt.upper) if flt.upper is not None else None
         return NumericCmpNode(colname, lo, hi, flt.lower_strict, flt.upper_strict,
                               dtype)
+    raise ValueError(f"cannot plan filter {type(flt).__name__} on numeric column")
+
+
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+
+
+def _plan_narrow_long(flt: F.DimFilter, colname: str) -> FilterNode:
+    """Numeric filters over int32-staged long columns: in-range constants
+    compare in int32; out-of-range constants fold to constants."""
+    if isinstance(flt, F.SelectorFilter):
+        if flt.value is None:
+            return ConstNode(False)
+        v = int(flt.value)
+        if not (_I32_MIN <= v <= _I32_MAX):
+            return ConstNode(False)
+        return NumericEqNode(colname, v, np.int32)
+    if isinstance(flt, F.InFilter):
+        vals = [int(v) for v in flt.values if v is not None]
+        vals = [v for v in vals if _I32_MIN <= v <= _I32_MAX]
+        if not vals:
+            return ConstNode(False)
+        return NumericInNode(colname, np.asarray(vals, dtype=np.int32))
+    if isinstance(flt, F.BoundFilter):
+        lo = int(flt.lower) if flt.lower is not None else None
+        hi = int(flt.upper) if flt.upper is not None else None
+        if lo is not None and lo > _I32_MAX:
+            return ConstNode(False)       # nothing is that large
+        if hi is not None and hi < _I32_MIN:
+            return ConstNode(False)
+        if lo is not None and lo < _I32_MIN:
+            lo = None                      # everything passes the lower bound
+        if hi is not None and hi > _I32_MAX:
+            hi = None
+        if lo is None and hi is None:
+            return ConstNode(True)
+        return NumericCmpNode(colname, lo, hi, flt.lower_strict,
+                              flt.upper_strict, np.int32)
     raise ValueError(f"cannot plan filter {type(flt).__name__} on numeric column")
 
 

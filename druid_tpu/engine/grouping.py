@@ -237,13 +237,82 @@ def fuse_filter_update(arrays: Dict, mask, key, it,
         mask = mask & filter_node.build(arrays, it)
 
     key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
-    counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
-                                 num_segments=num_total)
+
+    # small group spaces: scanned [block, G] masked broadcast-reduce beats
+    # scatter ~5x on TPU (scatter serializes; this runs at VPU width).
+    # Kernels that can't express their update this way scatter as before.
+    blocked_idx = []
+    if num_total <= BLOCKED_GROUP_LIMIT:
+        col_dtypes = {c: a.dtype for c, a in arrays.items()}
+        blocked_idx = [i for i, k in enumerate(kernels)
+                       if k.blocked_supported(col_dtypes)]
+    blocked_states = {}
+    counts = None
+    if blocked_idx:
+        bk = [kernels[i] for i in blocked_idx]
+        counts, bstates = _blocked_reduce(arrays, mask, key, bk, num_total)
+        blocked_states = dict(zip(blocked_idx, bstates))
+    if counts is None:
+        counts = jax.ops.segment_sum(mask.astype(jnp.int32), key,
+                                     num_segments=num_total)
     # positional states: the jit cache is shared across queries whose
     # aggregators differ only by output name
-    states = tuple(k.update(arrays, mask, key, num_total, it)
-                   for k in kernels)
+    states = tuple(blocked_states[i] if i in blocked_states
+                   else k.update(arrays, mask, key, num_total, it)
+                   for i, k in enumerate(kernels))
     return counts, states
+
+
+BLOCKED_GROUP_LIMIT = 2048
+BLOCK_ROWS = 2048
+
+
+def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
+                    num_total: int):
+    """Scanned masked broadcast-reduce over row blocks. Returns (counts,
+    per-kernel states) shaped exactly like the scatter path's."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mask.shape[0]
+    fields = sorted({k.spec.field for k in kernels
+                     if getattr(k.spec, "field", None) in arrays})
+    c = max(1, -(-n // BLOCK_ROWS))
+    padded = c * BLOCK_ROWS
+
+    def pad(a, fill=0):
+        if padded == n:
+            return a
+        return jnp.concatenate(
+            [a, jnp.full((padded - n,), fill, a.dtype)])
+
+    keyb = pad(key).reshape(c, BLOCK_ROWS)
+    maskb = pad(mask, False).reshape(c, BLOCK_ROWS)
+    colsb = {f: pad(arrays[f]).reshape(c, BLOCK_ROWS) for f in fields}
+    iota = jnp.arange(num_total, dtype=key.dtype)
+
+    # data-derived zero so carries inherit the varying-axis type under
+    # shard_map (a plain zeros init trips the scan vma check)
+    vary0 = (key[0] * 0)
+    inits = [jax.tree.map(lambda x: x + vary0.astype(x.dtype),
+                          k.blocked_init(num_total, arrays))
+             for k in kernels]
+    count0 = jnp.zeros(num_total, jnp.int32) + vary0.astype(jnp.int32)
+
+    def body(carry, xs):
+        cnt, states = carry
+        kb, mb = xs[0], xs[1]
+        cblk = dict(zip(fields, xs[2:]))
+        valid = (kb[:, None] == iota[None, :]) & mb[:, None]
+        cnt = cnt + valid.astype(jnp.int32).sum(axis=0)
+        states = tuple(k.blocked_step(s, cblk, valid, num_total)
+                       for k, s in zip(kernels, states))
+        return (cnt, states), None
+
+    xs = (keyb, maskb) + tuple(colsb[f] for f in fields)
+    (counts, states), _ = jax.lax.scan(body, (count0, tuple(inits)), xs)
+    return counts, tuple(k.blocked_finish(s)
+                         for k, s in zip(kernels, states))
 
 
 def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
@@ -309,7 +378,10 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
             elif bucket_mode == "uniform":
                 first_off = next(it)
                 period = next(it)
-                b = (t.astype(jnp.int64) - first_off) // period
+                # int32 bucket math: offsets are int32 by construction and
+                # uniform periods (≤ week) fit int32; 64-bit div would be
+                # limb-emulated on TPU
+                b = (t - first_off) // period
                 nb = next(it)  # num buckets as device scalar
                 mask = mask & (b >= 0) & (b < nb)
                 key = b.astype(jnp.int32)
@@ -344,9 +416,9 @@ def _assemble_aux(spec: GroupSpec, segment: Segment, intervals: Sequence[Interva
     aux.append(iv)
     if spec.key_mode == "dense":
         if spec.bucket_mode == "uniform":
-            aux.append(np.asarray(spec.uniform_first_offset, dtype=np.int64))
-            aux.append(np.asarray(spec.uniform_period, dtype=np.int64))
-            aux.append(np.asarray(spec.num_buckets, dtype=np.int64))
+            aux.append(np.asarray(spec.uniform_first_offset, dtype=np.int32))
+            aux.append(np.asarray(spec.uniform_period, dtype=np.int32))
+            aux.append(np.asarray(spec.num_buckets, dtype=np.int32))
         for d in spec.dims:
             if d.column is None:
                 continue

@@ -98,6 +98,27 @@ class AggKernel:
     def finalize_value(self, v):
         return self.spec.finalize(v)
 
+    # ---- blocked path (small group spaces) ------------------------------
+    # For num_groups ≲ 2k, a scanned [block, G] masked broadcast-reduce is
+    # ~5x faster than scatter on TPU (scatter serializes; broadcast-reduce
+    # runs at VPU width). Kernels opting in implement a per-block partial
+    # from the `valid` (rows × groups bool) matrix.
+
+    def blocked_supported(self, cols_avail) -> bool:
+        return False
+
+    def blocked_init(self, num: int, cols: Dict):
+        """Zero carry; `cols` is the full traced array dict (for dtypes)."""
+        raise NotImplementedError
+
+    def blocked_step(self, carry, cols_block: Dict, valid, num: int):
+        """valid: bool [B, num]; returns updated carry ([num]-shaped)."""
+        raise NotImplementedError
+
+    def blocked_finish(self, carry):
+        """Carry → the same state `update` would produce."""
+        return carry
+
 
 class CountKernel(AggKernel):
     reduce_kind = "sum"
@@ -118,26 +139,75 @@ class CountKernel(AggKernel):
     def empty_state(self, n):
         return np.zeros(n, dtype=np.int64)
 
+    def blocked_supported(self, cols_avail):
+        return True
+
+    def blocked_init(self, num, cols):
+        import jax.numpy as jnp
+        return jnp.zeros(num, jnp.int32)
+
+    def blocked_step(self, carry, cols_block, valid, num):
+        import jax.numpy as jnp
+        return carry + valid.astype(jnp.int32).sum(axis=0)
+
 
 class SumKernel(AggKernel):
     reduce_kind = "sum"
     _DTYPES = {ValueType.LONG: "int64", ValueType.FLOAT: "float32",
                ValueType.DOUBLE: "float64"}
 
-    def __init__(self, spec, vtype: ValueType):
+    def __init__(self, spec, vtype: ValueType, segment: Optional[Segment] = None):
         super().__init__(spec)
         self.vtype = vtype
+        # exact narrow path: int32-staged long columns sum via CHUNKED int32
+        # scatters (64-bit scatter is limb-emulated, ~5x) with int64
+        # accumulation only at group granularity. chunk_rows bounds each
+        # per-(chunk, group) partial below 2^30 regardless of skew.
+        self.chunk_rows = 0
+        if vtype is ValueType.LONG and segment is not None \
+                and spec.field in segment.metrics \
+                and segment.staged_dtype(spec.field) == np.int32:
+            lo, hi = segment.column_minmax(spec.field)
+            max_abs = max(abs(lo), abs(hi), 1)
+            r = (2 ** 30) // max_abs
+            self.chunk_rows = max(1024, (r // 1024) * 1024)
 
     def signature(self):
-        return f"sum({self.spec.field},{self.vtype.value})"
+        return f"sum({self.spec.field},{self.vtype.value},{self.chunk_rows})"
 
     def update(self, cols, mask, keys, num, aux):
+        import jax
         import jax.numpy as jnp
         acc_dtype = jnp.dtype(self._DTYPES[self.vtype])
         if self.spec.field not in cols:
             # missing column aggregates as null/zero (reference semantics)
             return jnp.zeros((num,), dtype=acc_dtype)
         v = cols[self.spec.field]
+        if self.chunk_rows and v.dtype == jnp.int32:
+            n = v.shape[0]
+            v32 = jnp.where(mask, v, 0)
+            if n <= self.chunk_rows:
+                return _seg_sum(v32, keys, num).astype(jnp.int64)
+            c = -(-n // self.chunk_rows)
+            pad = c * self.chunk_rows - n
+            if pad:
+                v32 = jnp.concatenate([v32, jnp.zeros(pad, jnp.int32)])
+                keys_p = jnp.concatenate([keys, jnp.zeros(pad, keys.dtype)])
+            else:
+                keys_p = keys
+            vc = v32.reshape(c, self.chunk_rows)
+            kc = keys_p.reshape(c, self.chunk_rows)
+
+            def body(acc, xs):
+                vb, kb = xs
+                return acc + _seg_sum(vb, kb, num).astype(jnp.int64), None
+
+            # derive the zero carry from the data so it inherits the
+            # varying-axis type under shard_map (a plain zeros init is
+            # "unvarying" and the scan rejects the mismatch)
+            init = jnp.zeros(num, jnp.int64) + (v32[0] * 0).astype(jnp.int64)
+            acc, _ = jax.lax.scan(body, init, (vc, kc))
+            return acc
         v = jnp.where(mask, v, 0).astype(acc_dtype)
         return _seg_sum(v, keys, num)
 
@@ -147,16 +217,51 @@ class SumKernel(AggKernel):
     def empty_state(self, n):
         return np.zeros(n, dtype=np.dtype(self._DTYPES[self.vtype]))
 
+    # blocked: int32-narrowed longs (block sums bounded via chunk_rows
+    # analysis) and float32; float64 would emulate elementwise — scatter
+    # stays cheaper there
+    BLOCK_ROWS = 2048
+
+    def blocked_supported(self, cols_avail):
+        if self.spec.field not in cols_avail:
+            return True   # missing column: constant zero carry
+        if self.vtype is ValueType.FLOAT:
+            return True
+        return bool(self.chunk_rows) and self.chunk_rows >= self.BLOCK_ROWS
+
+    def blocked_init(self, num, cols):
+        import jax.numpy as jnp
+        dt = jnp.float32 if self.vtype is ValueType.FLOAT else jnp.int64
+        return jnp.zeros(num, dt)
+
+    def blocked_step(self, carry, cols_block, valid, num):
+        import jax.numpy as jnp
+        if self.spec.field not in cols_block:
+            return carry
+        v = cols_block[self.spec.field]
+        if self.vtype is ValueType.FLOAT:
+            part = jnp.where(valid, v[:, None], 0.0).sum(axis=0)
+            return carry + part
+        part = jnp.where(valid, v[:, None], 0).sum(axis=0)
+        return carry + part.astype(jnp.int64)
+
 
 class MinMaxKernel(AggKernel):
-    def __init__(self, spec, vtype: ValueType, is_max: bool):
+    def __init__(self, spec, vtype: ValueType, is_max: bool,
+                 segment: Optional[Segment] = None):
         super().__init__(spec)
         self.vtype = vtype
         self.is_max = is_max
         self.reduce_kind = "max" if is_max else "min"
+        # staged dtype participates in program structure (blocked-path
+        # eligibility + sentinel dtype), so it must key the jit cache
+        self.staged = str(segment.staged_dtype(spec.field)) \
+            if segment is not None and spec.field in segment.metrics \
+            else ""
 
     def signature(self):
-        return f"{'max' if self.is_max else 'min'}({self.spec.field},{self.vtype.value})"
+        return (f"{'max' if self.is_max else 'min'}"
+                f"({self.spec.field},{self.vtype.value},{self.staged})")
 
     @property
     def identity(self):
@@ -169,9 +274,63 @@ class MinMaxKernel(AggKernel):
         if self.spec.field not in cols:
             return jnp.asarray(np.broadcast_to(self.empty_state(1), (num,)))
         v = cols[self.spec.field]
-        ident = jnp.asarray(self.identity, dtype=v.dtype)
+        # identity in the STAGED dtype (int32-narrowed longs use int32
+        # sentinels; casting the int64 sentinel would wrap)
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            info = jnp.iinfo(v.dtype)
+            ident = jnp.asarray(info.min if self.is_max else info.max,
+                                dtype=v.dtype)
+        else:
+            ident = jnp.asarray(-jnp.inf if self.is_max else jnp.inf,
+                                dtype=v.dtype)
         v = jnp.where(mask, v, ident)
         return _seg_max(v, keys, num) if self.is_max else _seg_min(v, keys, num)
+
+    def host_post(self, state, segment):
+        st = np.asarray(state)
+        if self.vtype == ValueType.LONG and st.dtype != np.int64:
+            # restore canonical int64 state; narrow sentinels widen to the
+            # int64 identity so cross-segment merges stay correct
+            narrow_ident = np.iinfo(st.dtype).min if self.is_max \
+                else np.iinfo(st.dtype).max
+            st64 = st.astype(np.int64)
+            st64[st == narrow_ident] = self.identity
+            return st64
+        return st
+
+    def host_from_device(self, state):
+        return self.host_post(state, None)
+
+    def blocked_supported(self, cols_avail):
+        if self.spec.field not in cols_avail:
+            return True
+        dt = cols_avail[self.spec.field]
+        return dt in (np.int32, np.float32) or str(dt) in ("int32", "float32")
+
+    def _ident_for(self, dtype):
+        import jax.numpy as jnp
+        if jnp.issubdtype(dtype, jnp.integer):
+            info = jnp.iinfo(dtype)
+            return jnp.asarray(info.min if self.is_max else info.max, dtype)
+        return jnp.asarray(-jnp.inf if self.is_max else jnp.inf, dtype)
+
+    def blocked_init(self, num, cols):
+        import jax.numpy as jnp
+        if self.spec.field not in cols:
+            return jnp.asarray(np.broadcast_to(self.empty_state(1), (num,)))
+        ident = self._ident_for(cols[self.spec.field].dtype)
+        return jnp.full(num, ident)
+
+    def blocked_step(self, carry, cols_block, valid, num):
+        import jax.numpy as jnp
+        if self.spec.field not in cols_block:
+            return carry
+        v = cols_block[self.spec.field]
+        ident = self._ident_for(v.dtype)
+        vm = jnp.where(valid, v[:, None], ident)
+        part = vm.max(axis=0) if self.is_max else vm.min(axis=0)
+        return jnp.maximum(carry, part) if self.is_max \
+            else jnp.minimum(carry, part)
 
     def combine(self, a, b):
         return np.maximum(a, b) if self.is_max else np.minimum(a, b)
@@ -482,23 +641,23 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
     if isinstance(spec, A.CountAggregator):
         return CountKernel(spec)
     if isinstance(spec, A.LongSumAggregator):
-        return SumKernel(spec, ValueType.LONG)
+        return SumKernel(spec, ValueType.LONG, segment)
     if isinstance(spec, A.DoubleSumAggregator):
         return SumKernel(spec, ValueType.DOUBLE)
     if isinstance(spec, A.FloatSumAggregator):
         return SumKernel(spec, ValueType.FLOAT)
     if isinstance(spec, A.LongMinAggregator):
-        return MinMaxKernel(spec, ValueType.LONG, False)
+        return MinMaxKernel(spec, ValueType.LONG, False, segment)
     if isinstance(spec, A.LongMaxAggregator):
-        return MinMaxKernel(spec, ValueType.LONG, True)
+        return MinMaxKernel(spec, ValueType.LONG, True, segment)
     if isinstance(spec, A.DoubleMinAggregator):
-        return MinMaxKernel(spec, ValueType.DOUBLE, False)
+        return MinMaxKernel(spec, ValueType.DOUBLE, False, segment)
     if isinstance(spec, A.DoubleMaxAggregator):
-        return MinMaxKernel(spec, ValueType.DOUBLE, True)
+        return MinMaxKernel(spec, ValueType.DOUBLE, True, segment)
     if isinstance(spec, A.FloatMinAggregator):
-        return MinMaxKernel(spec, ValueType.FLOAT, False)
+        return MinMaxKernel(spec, ValueType.FLOAT, False, segment)
     if isinstance(spec, A.FloatMaxAggregator):
-        return MinMaxKernel(spec, ValueType.FLOAT, True)
+        return MinMaxKernel(spec, ValueType.FLOAT, True, segment)
     if isinstance(spec, (A.FirstAggregator, A.LastAggregator)):
         tf = f"__ft_{spec.field}"
         return FirstLastKernel(spec, ValueType(spec.kind),

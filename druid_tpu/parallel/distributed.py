@@ -151,9 +151,30 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             if (met is None) != (met0 is None):
                 return None
             if met is not None and (met.type is not met0.type
-                                    or met.values.dtype != met0.values.dtype):
+                                    or met.values.dtype != met0.values.dtype
+                                    or s.staged_dtype(c)
+                                    != segments[0].staged_dtype(c)):
                 return None
     stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
+
+    # per-segment RELATIVE interval bounds + bucket start offsets: the
+    # device program stays in int32 offset space (64-bit elementwise time
+    # math is limb-emulated on TPU)
+    clip_lo, clip_hi = -(2**31) + 1, 2**31 - 1
+    iv_rel = np.zeros((K, max(len(intervals), 1), 2), dtype=np.int32)
+    bucket_off = np.zeros((K,), dtype=np.int32)
+    for i, s in enumerate(segments):
+        t0 = s.interval.start
+        for j, ivl in enumerate(intervals):
+            iv_rel[i, j, 0] = min(max(ivl.start - t0, clip_lo), clip_hi)
+            iv_rel[i, j, 1] = min(max(ivl.end - t0, clip_lo), clip_hi)
+        if spec0.bucket_mode == "uniform":
+            bucket_off[i] = min(max(int(spec0.bucket_starts[0]) - t0,
+                                    clip_lo), clip_hi)
+    import jax as _jax
+    from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
+    iv_rel = _jax.device_put(iv_rel, _NS(mesh, _P(axis, None, None)))
+    bucket_off = _jax.device_put(bucket_off, _NS(mesh, _P(axis)))
 
     aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity)
 
@@ -168,7 +189,7 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             _FN_CACHE.popitem(last=False)
     else:
         _FN_CACHE.move_to_end(sig)
-    counts, states = fn(stacked, time0s, aux)
+    counts, states = fn(stacked, time0s, iv_rel, bucket_off, aux)
 
     host_states = {k.name: k.host_from_device(st)
                    for k, st in zip(kernels, states)}
@@ -233,7 +254,9 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
         if name in s.dims:
             return s.dims[name].ids, np.int32(0)
         m = s.metrics[name]
-        return m.values, m.values.dtype.type(0)
+        dt = s.staged_dtype(name)   # int32-narrowed longs stay narrow
+        vals = m.values if m.values.dtype == dt else m.values.astype(dt)
+        return vals, vals.dtype.type(0)
 
     arrays: Dict[str, np.ndarray] = {}
     names = ("__time_offset", "__valid") + columns
@@ -277,13 +300,12 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
 def _assemble_aux(spec: GroupSpec, intervals: Sequence[Interval],
                   kds: Sequence[KeyDim], f_aux: List[np.ndarray],
                   k_aux: List[np.ndarray], granularity: Granularity) -> Tuple:
-    # absolute-time interval bounds (per-segment relative handled on device)
-    aux: List[np.ndarray] = [np.asarray(
-        [[iv.start, iv.end] for iv in intervals], dtype=np.int64)]
+    # interval bounds + bucket origins arrive as per-segment int32 vmapped
+    # args (see try_sharded); only shared scalars live in aux
+    aux: List[np.ndarray] = []
     if spec.bucket_mode == "uniform":
-        aux.append(np.asarray(int(spec.bucket_starts[0]), dtype=np.int64))
-        aux.append(np.asarray(granularity.period_ms, dtype=np.int64))
-        aux.append(np.asarray(spec.num_buckets, dtype=np.int64))
+        aux.append(np.asarray(granularity.period_ms, dtype=np.int32))
+        aux.append(np.asarray(spec.num_buckets, dtype=np.int32))
     for d in kds:
         if d.column is None:
             continue
@@ -378,27 +400,28 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     vc_exprs = tuple((v.name, v.expression, v.output_type)
                      for v in virtual_columns)
 
-    def per_segment(arrays, time0, aux):
+    def per_segment(arrays, time0, iv_rel, bucket_off, aux):
         it = iter(aux)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
-        t_abs = t.astype(jnp.int64) + time0
 
         if vc_exprs:
-            arrays = eval_virtual_columns(arrays, t_abs, vc_exprs)
+            # expressions may reference absolute __time — the one consumer
+            # of 64-bit per-row time
+            arrays = eval_virtual_columns(
+                arrays, t.astype(jnp.int64) + time0, vc_exprs)
 
-        iv = next(it)  # int64 [k, 2] absolute bounds
-        within = (t_abs[:, None] >= iv[None, :, 0]) \
-            & (t_abs[:, None] < iv[None, :, 1])
+        # int32 relative bounds — no 64-bit elementwise time math
+        within = (t[:, None] >= iv_rel[None, :, 0]) \
+            & (t[:, None] < iv_rel[None, :, 1])
         mask = mask & jnp.any(within, axis=1)
 
         if bucket_mode == "all":
             key = jnp.zeros(t.shape, dtype=jnp.int32)
         else:
-            start0 = next(it)
             period = next(it)
             nb = next(it)
-            b = (t_abs - start0) // period
+            b = (t - bucket_off) // period
             mask = mask & (b >= 0) & (b < nb)
             key = b.astype(jnp.int32)
 
@@ -409,10 +432,11 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
                        for k, s in zip(kernels, states))
         return counts, states
 
-    def body(stacked, time0s, aux):
+    def body(stacked, time0s, iv_rel, bucket_off, aux):
         k_local = time0s.shape[0]
         counts, states = jax.vmap(
-            lambda a, t0: per_segment(a, t0, aux))(stacked, time0s)
+            lambda a, t0, ivr, boff: per_segment(a, t0, ivr, boff, aux))(
+                stacked, time0s, iv_rel, bucket_off)
         counts = jax.lax.psum(counts.astype(jnp.int64).sum(axis=0), axis)
         merged = tuple(
             _merge_states(k, st, axis, n_dev, k_local)
@@ -424,6 +448,7 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     # construction — turn the static replication check off for those.
     has_fold = any(k.reduce_kind == "fold" for k in kernels) and n_dev > 1
     f = shard_map(body, mesh=mesh,
-                  in_specs=(P(axis, None), P(axis), P()),
+                  in_specs=(P(axis, None), P(axis), P(axis, None, None),
+                            P(axis), P()),
                   out_specs=(P(), P()), check_vma=not has_fold)
     return jax.jit(f)

@@ -557,6 +557,13 @@ class Query:
     granularity: Granularity = Granularity.ALL
     virtual_columns: Tuple[ExpressionVirtualColumn, ...] = ()
     context: Tuple[Tuple[str, object], ...] = ()
+    # polymorphic data sources (reference: query/TableDataSource /
+    # UnionDataSource / QueryDataSource): a non-None inner_query makes this
+    # a subquery (executor materializes inner groupBy results as a segment,
+    # mirroring GroupByStrategyV2.processSubqueryResult); a non-empty
+    # union_datasources unions several tables' segments
+    inner_query: Optional["Query"] = None
+    union_datasources: Tuple[str, ...] = ()
 
     query_type: str = "base"
 
@@ -570,10 +577,18 @@ class Query:
             out |= self.filter.required_columns()
         return out
 
+    def _datasource_json(self):
+        if self.inner_query is not None:
+            return {"type": "query", "query": self.inner_query.to_json()}
+        if self.union_datasources:
+            return {"type": "union",
+                    "dataSources": list(self.union_datasources)}
+        return self.datasource
+
     def base_json(self) -> dict:
         return {
             "queryType": self.query_type,
-            "dataSource": self.datasource,
+            "dataSource": self._datasource_json(),
             "intervals": [str(iv) for iv in self.intervals],
             "filter": self.filter.to_json() if self.filter else None,
             "granularity": str(self.granularity),
@@ -853,10 +868,34 @@ class DataSourceMetadataQuery(Query):
 
 
 def query_from_json(j: dict) -> Query:
-    """Wire-format deserialization (reference: Jackson polymorphic Query)."""
+    """Wire-format deserialization (reference: Jackson polymorphic Query),
+    including polymorphic dataSources (table | union | query)."""
+    ds_j = j.get("dataSource", "")
+    inner_q = None
+    union: Tuple[str, ...] = ()
+    if isinstance(ds_j, dict):
+        dtype = ds_j.get("type", "table")
+        if dtype == "table":
+            ds = ds_j["name"]
+        elif dtype == "union":
+            union = tuple(ds_j["dataSources"])
+            ds = union[0] if union else ""
+        elif dtype == "query":
+            inner_q = query_from_json(ds_j["query"])
+            ds = inner_q.datasource
+        else:
+            raise ValueError(f"unknown dataSource type {dtype!r}")
+    else:
+        ds = ds_j
+    q = _query_body_from_json(j, ds)
+    if inner_q is not None or union:
+        from dataclasses import replace as _replace
+        q = _replace(q, inner_query=inner_q, union_datasources=union)
+    return q
+
+
+def _query_body_from_json(j: dict, ds: str) -> Query:
     t = j["queryType"]
-    ds = j["dataSource"]["name"] if isinstance(j.get("dataSource"), dict) \
-        else j.get("dataSource", "")
     ivs = j.get("intervals")
     if isinstance(ivs, dict):  # {"type": "intervals", "intervals": [...]}
         ivs = ivs.get("intervals")

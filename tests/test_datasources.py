@@ -1,0 +1,119 @@
+"""Polymorphic dataSources: union + query (subquery) — reference:
+query/UnionDataSource, QueryDataSource + GroupByStrategyV2
+.processSubqueryResult; UnionQueryRunner; CalciteQueryTest nested
+groupBys."""
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import Broker, DataNode, InventoryView, descriptor_for
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import (CountAggregator, DoubleSumAggregator,
+                                         LongSumAggregator)
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   TimeseriesQuery, query_from_json)
+from druid_tpu.utils.intervals import Interval
+from tests.conftest import WEEK, rows_as_frame
+
+
+def test_union_datasource(generator):
+    a = generator.segment(5_000, Interval.of("2026-01-01", "2026-01-02"),
+                          datasource="ds_a")
+    b = generator.segment(7_000, Interval.of("2026-01-01", "2026-01-02"),
+                          datasource="ds_b")
+    ex = QueryExecutor([a, b])
+    rows = ex.run_json({
+        "queryType": "timeseries",
+        "dataSource": {"type": "union", "dataSources": ["ds_a", "ds_b"]},
+        "intervals": [str(WEEK)], "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}]})
+    assert rows[0]["result"]["n"] == 12_000
+
+
+def test_subquery_groupby(segment):
+    """Outer groupBy over inner groupBy: count distinct dimB per dimA by
+    re-grouping inner (dimA, dimB) rows."""
+    ex = QueryExecutor([segment])
+    frame = rows_as_frame(segment)
+    inner = GroupByQuery.of(
+        "test", [WEEK],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("cnt")], granularity="all")
+    outer_json = {
+        "queryType": "groupBy",
+        "dataSource": {"type": "query", "query": inner.to_json()},
+        "intervals": [str(WEEK)], "granularity": "all",
+        "dimensions": ["dimA"],
+        "aggregations": [{"type": "count", "name": "pairs"},
+                         {"type": "longSum", "name": "rows",
+                          "fieldName": "cnt"}]}
+    rows = ex.run_json(outer_json)
+    got = {r["event"]["dimA"]: (r["event"]["pairs"], r["event"]["rows"])
+           for r in rows}
+    for v in sorted(set(frame["dimA"])):
+        sel = frame["dimA"] == v
+        want_pairs = len(set(frame["dimB"][sel]))
+        assert got[v] == (want_pairs, int(sel.sum()))
+
+
+def test_subquery_serde_round_trip(segment):
+    inner = GroupByQuery.of("test", [WEEK], [DefaultDimensionSpec("dimA")],
+                            [CountAggregator("c")])
+    j = {"queryType": "timeseries",
+         "dataSource": {"type": "query", "query": inner.to_json()},
+         "intervals": [str(WEEK)], "granularity": "all",
+         "aggregations": [{"type": "longSum", "name": "s",
+                           "fieldName": "c"}]}
+    q = query_from_json(j)
+    assert q.inner_query is not None
+    j2 = q.to_json()
+    assert j2["dataSource"]["type"] == "query"
+    assert query_from_json(j2).to_json() == j2
+
+
+def test_subquery_requires_groupby(segment):
+    ex = QueryExecutor([segment])
+    ts = TimeseriesQuery.of("test", [WEEK], [CountAggregator("c")])
+    with pytest.raises(ValueError):
+        ex.run_json({
+            "queryType": "timeseries",
+            "dataSource": {"type": "query", "query": ts.to_json()},
+            "intervals": [str(WEEK)], "granularity": "all",
+            "aggregations": [{"type": "count", "name": "n"}]})
+
+
+def test_subquery_and_union_over_broker(segments, generator):
+    view = InventoryView()
+    node = DataNode("n0")
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("n0", descriptor_for(s))
+    other = generator.segment(3_000, Interval.of("2026-01-01", "2026-01-02"),
+                              datasource="other")
+    node.load_segment(other)
+    view.announce("n0", descriptor_for(other))
+    broker = Broker(view)
+
+    rows = broker.run_json({
+        "queryType": "timeseries",
+        "dataSource": {"type": "union", "dataSources": ["test", "other"]},
+        "intervals": [str(WEEK)], "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}]})
+    total = sum(s.n_rows for s in segments) + other.n_rows
+    assert rows[0]["result"]["n"] == total
+
+    inner = GroupByQuery.of("test", [WEEK], [DefaultDimensionSpec("dimA")],
+                            [LongSumAggregator("s", "metLong")])
+    rows = broker.run_json({
+        "queryType": "timeseries",
+        "dataSource": {"type": "query", "query": inner.to_json()},
+        "intervals": [str(WEEK)], "granularity": "all",
+        "aggregations": [{"type": "count", "name": "groups"},
+                         {"type": "doubleSum", "name": "total",
+                          "fieldName": "s"}]})
+    local = QueryExecutor(segments)
+    want_groups = len(local.run(inner))
+    frames = [rows_as_frame(s) for s in segments]
+    want_total = float(sum(int(f["metLong"].sum()) for f in frames))
+    assert rows[0]["result"]["groups"] == want_groups
+    assert rows[0]["result"]["total"] == pytest.approx(want_total)
