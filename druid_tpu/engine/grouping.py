@@ -292,8 +292,10 @@ def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
     iota = jnp.arange(num_total, dtype=key.dtype)
 
     # data-derived zero so carries inherit the varying-axis type under
-    # shard_map (a plain zeros init trips the scan vma check)
-    vary0 = (key[0] * 0)
+    # shard_map (a plain zeros init trips the scan vma check); derive from
+    # both key and mask — the key can be shard-invariant (all-granularity)
+    # while the row mask is sharded
+    vary0 = (key[0] * 0) + (mask[0] * 0).astype(key.dtype)
     inits = [jax.tree.map(lambda x: x + vary0.astype(x.dtype),
                           k.blocked_init(num_total, arrays))
              for k in kernels]
@@ -304,7 +306,9 @@ def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
         kb, mb = xs[0], xs[1]
         cblk = dict(zip(fields, xs[2:]))
         valid = (kb[:, None] == iota[None, :]) & mb[:, None]
-        cnt = cnt + valid.astype(jnp.int32).sum(axis=0)
+        # pin the accumulation dtype: under x64 an int32 sum promotes to
+        # int64 and the scan carry dtype check fails
+        cnt = cnt + valid.astype(jnp.int32).sum(axis=0, dtype=jnp.int32)
         states = tuple(k.blocked_step(s, cblk, valid, num_total)
                        for k, s in zip(kernels, states))
         return (cnt, states), None

@@ -148,7 +148,8 @@ class CountKernel(AggKernel):
 
     def blocked_step(self, carry, cols_block, valid, num):
         import jax.numpy as jnp
-        return carry + valid.astype(jnp.int32).sum(axis=0)
+        # dtype pinned so the scan carry stays int32 under x64
+        return carry + valid.astype(jnp.int32).sum(axis=0, dtype=jnp.int32)
 
 
 class SumKernel(AggKernel):

@@ -247,10 +247,15 @@ class ThetaSketchSetOpPostAgg(PostAggregator):
                     est, base.intersect_estimate(s))
             return est if est is not None else base.estimate
         if self.func == "NOT":
-            est = sks[0].estimate
-            for s in sks[1:]:
-                est -= sks[0].intersect_estimate(s)
-            return max(est, 0.0)
+            # union the subtrahends first so overlapping Bi inside A aren't
+            # double-subtracted (reference chains ((A\B1)\B2))
+            base = sks[0]
+            if len(sks) == 1:
+                return base.estimate
+            sub = sks[1]
+            for s in sks[2:]:
+                sub = sub.union(s)
+            return max(base.estimate - base.intersect_estimate(sub), 0.0)
         raise ValueError(f"unknown set op {self.func!r}")
 
     def to_json(self):

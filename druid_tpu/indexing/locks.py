@@ -74,6 +74,17 @@ class TaskLockbox:
             self._locks.append(lock)
             return lock
 
+    def critical_section(self, task_id: str, fn):
+        """Run fn() under the lockbox lock iff none of the task's locks are
+        revoked (TaskLockbox.doInCriticalSection): revocation by a
+        higher-priority task cannot interleave between the check and the
+        action (e.g. a metadata publish)."""
+        with self._lock:
+            if any(l.task_id == task_id and l.revoked
+                   for l in self._locks):
+                return False
+            return fn()
+
     def is_revoked(self, task_id: str) -> bool:
         with self._lock:
             return any(l.task_id == task_id and l.revoked

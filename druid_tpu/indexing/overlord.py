@@ -56,11 +56,11 @@ class TaskToolbox:
 
     def publish(self, task: Task,
                 descriptors: Sequence[SegmentDescriptor]) -> bool:
-        """SegmentTransactionalInsertAction: refuse if the task's lock was
-        revoked, else publish atomically."""
-        if self.lockbox.is_revoked(task.id):
-            return False
-        return self.metadata.publish_segments(descriptors)
+        """SegmentTransactionalInsertAction: the revocation check and the
+        metadata commit run in one lockbox critical section so a revoke
+        cannot interleave between them (TaskLockbox.doInCriticalSection)."""
+        return self.lockbox.critical_section(
+            task.id, lambda: self.metadata.publish_segments(descriptors))
 
 
 class Overlord:
