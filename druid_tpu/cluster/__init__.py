@@ -14,6 +14,7 @@ from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
 from druid_tpu.cluster.timeline import (PartitionChunk, PartitionHolder,
                                         TimelineObjectHolder,
                                         VersionedIntervalTimeline)
+from druid_tpu.cluster.dataserver import DataNodeServer, RemoteDataNodeClient
 from druid_tpu.cluster.view import DataNode, InventoryView, descriptor_for
 
 __all__ = [
@@ -25,5 +26,6 @@ __all__ = [
     "descriptor_for", "Broker", "MissingSegmentsError", "LruCache",
     "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",
     "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
-    "IntervalDropRule", "rule_from_json",
+    "IntervalDropRule", "rule_from_json", "DataNodeServer",
+    "RemoteDataNodeClient",
 ]

@@ -34,6 +34,7 @@ from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery,
                                    SegmentMetadataQuery, SelectQuery,
                                    TimeBoundaryQuery, TimeseriesQuery,
                                    TopNQuery, query_from_json)
+from druid_tpu.server.querymanager import Deadline, QueryManager
 from druid_tpu.utils.intervals import Interval, condense
 
 
@@ -72,13 +73,15 @@ class Broker:
                  cache: Optional[LruCache] = None,
                  cache_config: Optional[CacheConfig] = None,
                  max_retries: int = 2, seed: int = 0,
-                 max_threads: int = 8):
+                 max_threads: int = 8,
+                 query_manager: Optional[QueryManager] = None):
         self.view = view
         self.cache = cache
         self.cache_config = cache_config or CacheConfig()
         self.max_retries = max_retries
         self.rng = random.Random(seed)
         self.max_threads = max_threads
+        self.query_manager = query_manager or QueryManager()
         self._lock = threading.Lock()
 
     # ---- QueryExecutor-compatible surface ------------------------------
@@ -205,12 +208,26 @@ class Broker:
     # ---- scatter + retry (RetryQueryRunner) ----------------------------
     def _scatter(self, query: Query, segments: List[SegmentDescriptor],
                  rows_mode: bool):
+        # cancel token + deadline ride the whole scatter (QueryContexts
+        # timeout; DELETE /druid/v2/{id} trips the token)
+        qid = query.context_map.get("queryId")
+        token = self.query_manager.token(qid)
+        deadline = Deadline.for_query(query)
         pending: Dict[str, SegmentDescriptor] = {d.id: d for d in segments}
         tried: Dict[str, Set[str]] = {d.id: set() for d in segments}
         gathered = []
         for _ in range(self.max_retries + 1):
             if not pending:
                 break
+            if token is not None:
+                token.check()
+            deadline.check()
+            # each round carries only the REMAINING time budget, so retries
+            # cannot stretch the query past its context timeout
+            remaining = deadline.remaining_ms()
+            q_round = query if remaining is None else replace(
+                query, context=tuple(sorted(
+                    {**query.context_map, "timeout": remaining}.items())))
             # group by chosen server
             by_server: Dict[str, List[str]] = {}
             unassigned = []
@@ -229,11 +246,16 @@ class Broker:
                 node = self.view.node(server)
                 if node is None:
                     return server, sids, None, set()
+                # propagate a cancel to remote nodes with work in flight
+                # (deduped per server across retry rounds)
+                if token is not None and qid and hasattr(node, "cancel"):
+                    token.add_remote_cancel(
+                        lambda n=node: n.cancel(qid), key=server)
                 try:
                     if rows_mode:
-                        rows, served = node.run_rows(query, sids)
+                        rows, served = node.run_rows(q_round, sids)
                         return server, sids, rows, served
-                    ap, served = node.run_partials(query, sids)
+                    ap, served = node.run_partials(q_round, sids)
                     return server, sids, ap, served
                 except ConnectionError:
                     return server, sids, None, set()

@@ -1,0 +1,280 @@
+"""The network data plane: data-node HTTP server + the broker's per-server
+HTTP query client.
+
+Reference analogs:
+  server/QueryResource.java:153 — the historical/realtime query endpoint the
+    broker hits per server (here split into /partials for aggregate queries,
+    which return binary partial-state bundles, and /rows for row queries)
+  server/QueryResource.java:126 — DELETE /druid/v2/{id} cancel
+  client/DirectDruidClient.java:98 — the broker-side per-server client
+    (async Netty there; blocking-in-threadpool here — the broker already
+    fans out across servers on a ThreadPoolExecutor)
+  java-util/.../http/client/NettyHttpClient.java — transport
+
+Wire formats: queries travel as Druid-native JSON; aggregate partials come
+back as the tensor-bundle binary (cluster/wire.py); row results as JSON.
+Server-side the node enforces the query's context timeout and honors
+cancellation between per-segment computations (when segments fuse into one
+sharded device program, that program is uninterruptible once launched — the
+check runs before and after it).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence, Set, Tuple
+
+from druid_tpu.cluster import wire
+from druid_tpu.cluster.view import DataNode
+from druid_tpu.query.model import Query, query_from_json
+from druid_tpu.server.http import _json_value
+from druid_tpu.server.querymanager import (DEFAULT_TIMEOUT_MS, Deadline,
+                                           QueryInterruptedError,
+                                           QueryManager, QueryTimeoutError,
+                                           cancel_path_id)
+
+
+class RemoteQueryError(RuntimeError):
+    """A data node answered with a query error (HTTP 4xx/5xx). Distinct from
+    ConnectionError on purpose: the broker retries unreachable servers on
+    other replicas, but a deterministic query error must propagate with the
+    node's actual message, not degrade into MissingSegmentsError."""
+
+    def __init__(self, server: str, code: int, detail: str):
+        super().__init__(f"server [{server}] HTTP {code}: {detail}")
+        self.server = server
+        self.code = code
+        self.detail = detail
+
+
+class DataNodeServer:
+    """Serves one DataNode's query surface over HTTP."""
+
+    def __init__(self, node: DataNode, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.node = node
+        self.query_manager = QueryManager()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, ctype: str, data: bytes):
+                # the client may have hung up already (its own timeout
+                # fired) — a late reply to a dead socket is not an error
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
+
+            def _reply_json(self, code: int, body):
+                self._send(code, "application/json",
+                           json.dumps(body, default=_json_value).encode())
+
+            def _reply_bytes(self, data: bytes):
+                self._send(200, wire.CONTENT_TYPE, data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._reply_json(200, {
+                        "version": "druid-tpu-0.2",
+                        "server": outer.node.name,
+                        "tier": outer.node.tier,
+                        "segments": sorted(outer.node.served_segment_ids())})
+                else:
+                    self._reply_json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                path = self.path.rstrip("/")
+                try:
+                    payload = self._body()
+                    if path == "/druid/v2/partials":
+                        self._partials(payload)
+                    elif path == "/druid/v2/rows":
+                        self._rows(payload)
+                    else:
+                        self._reply_json(404, {"error": "unknown path"})
+                except QueryInterruptedError as e:
+                    self._reply_json(500, {"error": "Query cancelled",
+                                           "errorMessage": str(e)})
+                except QueryTimeoutError as e:
+                    self._reply_json(504, {"error": "Query timed out",
+                                           "errorMessage": str(e)})
+                except (ValueError, KeyError) as e:
+                    self._reply_json(400,
+                                     {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:
+                    self._reply_json(500,
+                                     {"error": f"{type(e).__name__}: {e}"})
+
+            def _run(self, payload, rows_mode: bool):
+                query = query_from_json(payload["query"])
+                sids = payload.get("segments") or []
+                qid = query.context_map.get("queryId")
+                token = outer.query_manager.register(qid) if qid else None
+                deadline = Deadline.for_query(query)
+
+                def check():
+                    if token is not None:
+                        token.check()
+                    deadline.check()
+
+                try:
+                    check()
+                    if rows_mode:
+                        out = outer.node.run_rows(query, sids)
+                    else:
+                        out = outer.node.run_partials(query, sids,
+                                                      check=check)
+                    check()
+                    return out
+                finally:
+                    if qid:
+                        outer.query_manager.unregister(qid)
+
+            def _partials(self, payload):
+                ap, served = self._run(payload, rows_mode=False)
+                self._reply_bytes(wire.dumps_partials(ap, served))
+
+            def _rows(self, payload):
+                rows, served = self._run(payload, rows_mode=True)
+                self._reply_json(200, {"rows": rows,
+                                       "served": sorted(served)})
+
+            def do_DELETE(self):
+                qid = cancel_path_id(self.path)
+                if qid is not None:
+                    outer.query_manager.cancel(qid)
+                    self._reply_json(202, {"queryId": qid})
+                else:
+                    self._reply_json(404, {"error": "unknown path"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "DataNodeServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class RemoteDataNodeClient:
+    """The broker's per-server query client (DirectDruidClient analog).
+
+    Exposes the same (run_partials / run_rows) surface as an in-process
+    DataNode so the broker's scatter path is transport-agnostic; registered
+    into the InventoryView exactly like a local node. Socket timeouts follow
+    the query's context timeout; cancel() propagates the DELETE."""
+
+    def __init__(self, name: str, base_url: str,
+                 connect_timeout: float = 5.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout = connect_timeout
+        self.tier = "_default_tier"
+        self.alive = True
+
+    # ---- InventoryView/DataNode surface the broker touches -------------
+    def segments(self) -> List:
+        return []            # schema discovery uses segmentMetadata queries
+
+    def served_segment_ids(self) -> Set[str]:
+        try:
+            st = self._status()
+            return set(st.get("segments", []))
+        except ConnectionError:
+            return set()
+
+    def _status(self) -> dict:
+        try:
+            with urllib.request.urlopen(self.base_url + "/status",
+                                        timeout=self.connect_timeout) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError) as e:
+            raise ConnectionError(f"server [{self.name}] unreachable: {e}")
+
+    def _timeout_for(self, query: Query) -> float:
+        t = query.context_map.get("timeout")
+        try:
+            t = float(t) if t is not None else 0.0
+        except (TypeError, ValueError):
+            t = 0.0
+        # socket timeout covers connect + full response read; the broker
+        # rewrites the context timeout to the REMAINING deadline each
+        # scatter round, so this never exceeds the original budget
+        return (t / 1000.0) if t > 0 else DEFAULT_TIMEOUT_MS / 1000.0
+
+    def _post(self, path: str, query: Query, segment_ids: Sequence[str]):
+        body = json.dumps({"query": query.to_json(),
+                           "segments": [str(s) for s in segment_ids]},
+                          default=_json_value).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self._timeout_for(query)) as r:
+                return r.headers.get_content_type(), r.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            if e.code == 504:
+                raise QueryTimeoutError(detail)
+            if e.code == 500 and "cancelled" in detail.lower():
+                raise QueryInterruptedError(detail)
+            # a served HTTP error is a QUERY error — propagate the node's
+            # message instead of retrying into MissingSegmentsError
+            raise RemoteQueryError(self.name, e.code, detail)
+        except socket.timeout:
+            raise QueryTimeoutError(
+                f"server [{self.name}] did not respond in time")
+        except (urllib.error.URLError, OSError) as e:
+            if isinstance(getattr(e, "reason", None), socket.timeout):
+                raise QueryTimeoutError(
+                    f"server [{self.name}] did not respond in time")
+            raise ConnectionError(f"server [{self.name}] unreachable: {e}")
+
+    def run_partials(self, query: Query, segment_ids: Sequence[str]
+                     ) -> Tuple[object, Set[str]]:
+        ctype, data = self._post("/druid/v2/partials", query, segment_ids)
+        if ctype != wire.CONTENT_TYPE:
+            raise ConnectionError(
+                f"server [{self.name}] returned {ctype}, expected partials")
+        return wire.loads_partials(data)
+
+    def run_rows(self, query: Query, segment_ids: Sequence[str]
+                 ) -> Tuple[List[dict], Set[str]]:
+        _, data = self._post("/druid/v2/rows", query, segment_ids)
+        out = json.loads(data)
+        return out["rows"], set(out["served"])
+
+    def cancel(self, query_id: str) -> None:
+        req = urllib.request.Request(
+            f"{self.base_url}/druid/v2/{query_id}", method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=self.connect_timeout).read()
+        except (urllib.error.URLError, OSError):
+            pass   # best-effort, server may already be gone

@@ -108,12 +108,17 @@ class DataNode:
                     served.add(str(sid))
             return found, served
 
-    def run_partials(self, query: Query, segment_ids: Sequence[str]
+    def run_partials(self, query: Query, segment_ids: Sequence[str],
+                     check: Optional[Callable[[], None]] = None
                      ) -> Tuple[AggregatePartials, Set[str]]:
         """Aggregate path: produce partial states for the requested segments
         (clamp=False — the broker pre-bounds intervals so bucket index
         spaces align across nodes). Per-segment partials are cached when the
-        segment cache is enabled (CachingQueryRunner analog)."""
+        segment cache is enabled (CachingQueryRunner analog).
+
+        `check` (cancel/timeout probe) runs between per-segment device
+        calls; with a mesh active the segments fuse into one sharded program
+        which is uninterruptible once launched."""
         if not self.alive:
             raise ConnectionError(f"server [{self.name}] is down")
         segs, served = self._select(segment_ids)
@@ -121,7 +126,15 @@ class DataNode:
                      and self.cache_config.cacheable(query)
                      and self.cache_config.use_segment_cache)
         if not use_cache:
-            ap = make_aggregate_partials(query, segs, clamp=False)
+            if check is None or self.mesh is not None or len(segs) <= 1:
+                ap = make_aggregate_partials(query, segs, clamp=False)
+            else:
+                parts = []
+                for s in segs:
+                    check()
+                    parts.append(
+                        make_aggregate_partials(query, [s], clamp=False))
+                ap = AggregatePartials.concat(parts)
             return ap, served
         qkey = query_cache_key(query)
         parts: List[AggregatePartials] = []
@@ -133,6 +146,8 @@ class DataNode:
             else:
                 to_compute.append(s)
         for s in to_compute:
+            if check is not None:
+                check()
             ap = make_aggregate_partials(query, [s], clamp=False)
             if self.cache_config.populate_segment_cache:
                 self.cache.put("segment", f"{s.id}|{qkey}", ap)

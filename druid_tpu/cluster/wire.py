@@ -1,0 +1,232 @@
+"""Binary wire format for the broker ↔ data-node data plane.
+
+Reference analog: the serialized result stream a historical returns to
+DirectDruidClient (client/DirectDruidClient.java:98 — JSON/smile rows over
+Netty). TPU-first difference: what crosses the wire on the aggregate path is
+*partial aggregation state* (AggregatePartials — dense per-key numpy arrays),
+not finalized rows, so the broker's merge stays exact for HLL/sketch states.
+
+Format ("tensor bundle", no pickle, nothing executable):
+
+    MAGIC "DTPW" | u8 version | u32 header_len | header JSON | tensor bytes
+
+The header describes the object tree; every numpy array is referenced by
+index into a tensor table of (dtype, shape, offset) entries whose raw
+little-endian bytes follow the header. Aggregator kernels travel as their
+aggregator-spec JSON and are rebuilt against a null segment on the receiving
+side — only their segment-independent merge behavior (combine / empty_state /
+finalize) is exercised there.
+
+Per-row device-staging arrays in GroupSpec (host_bucket_ids, host_keys) are
+deliberately dropped from the wire: the broker merge needs only the compact
+key space (host_unique), cardinalities, and bucket starts.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"DTPW"
+VERSION = 1
+
+# HTTP content type for partials payloads (the data plane's "smile")
+CONTENT_TYPE = "application/x-druid-tpu-partials"
+
+
+class WireError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tensor table
+# ---------------------------------------------------------------------------
+
+class _TensorTable:
+    def __init__(self):
+        self.arrays: List[np.ndarray] = []
+
+    def add(self, a: np.ndarray) -> int:
+        self.arrays.append(np.ascontiguousarray(a))
+        return len(self.arrays) - 1
+
+    def add_opt(self, a: Optional[np.ndarray]) -> Optional[int]:
+        return None if a is None else self.add(np.asarray(a))
+
+    def manifest_and_payload(self) -> Tuple[List[dict], bytes]:
+        manifest, chunks, off = [], [], 0
+        for a in self.arrays:
+            if a.dtype == object:
+                raise WireError("object arrays are not wire-serializable")
+            data = a.tobytes()
+            manifest.append({"dtype": a.dtype.str, "shape": list(a.shape),
+                             "off": off, "len": len(data)})
+            off += len(data)
+            chunks.append(data)
+        return manifest, b"".join(chunks)
+
+
+def _read_tensors(manifest: Sequence[dict], payload: memoryview
+                  ) -> List[np.ndarray]:
+    out = []
+    for m in manifest:
+        dt = np.dtype(m["dtype"])
+        if dt == object or dt.hasobject:
+            raise WireError("object dtype in wire payload")
+        buf = payload[m["off"]: m["off"] + m["len"]]
+        out.append(np.frombuffer(buf, dtype=dt).reshape(m["shape"]).copy())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# State pytrees (numpy arrays or string-keyed dicts of arrays)
+# ---------------------------------------------------------------------------
+
+def _enc_state(x, tt: _TensorTable):
+    if isinstance(x, np.ndarray):
+        return {"a": tt.add(x)}
+    if isinstance(x, dict):
+        return {"d": {k: _enc_state(v, tt) for k, v in x.items()}}
+    if isinstance(x, np.generic):
+        return {"a": tt.add(np.asarray(x))}
+    raise WireError(f"state leaf not serializable: {type(x).__name__}")
+
+
+def _dec_state(x, tensors: List[np.ndarray]):
+    if "a" in x:
+        return tensors[x["a"]]
+    return {k: _dec_state(v, tensors) for k, v in x["d"].items()}
+
+
+# ---------------------------------------------------------------------------
+# GroupSpec / kernels
+# ---------------------------------------------------------------------------
+
+def _enc_spec(spec, tt: _TensorTable) -> dict:
+    return {
+        "bucket_starts": tt.add(np.asarray(spec.bucket_starts)),
+        "bucket_mode": spec.bucket_mode,
+        "uniform_period": int(spec.uniform_period),
+        "uniform_first_offset": int(spec.uniform_first_offset),
+        "key_mode": spec.key_mode,
+        "dims": [{"column": d.column, "cardinality": int(d.cardinality),
+                  "remap": tt.add_opt(d.remap)} for d in spec.dims],
+        "host_unique": tt.add_opt(spec.host_unique),
+        "num_total": int(spec.num_total),
+    }
+
+
+def _dec_spec(j: dict, tensors: List[np.ndarray]):
+    from druid_tpu.engine.grouping import GroupSpec, KeyDim
+    t = lambda i: None if i is None else tensors[i]
+    return GroupSpec(
+        bucket_starts=t(j["bucket_starts"]),
+        bucket_mode=j["bucket_mode"],
+        uniform_period=j["uniform_period"],
+        uniform_first_offset=j["uniform_first_offset"],
+        host_bucket_ids=None,
+        key_mode=j["key_mode"],
+        dims=tuple(KeyDim(d["column"], d["cardinality"], t(d["remap"]))
+                   for d in j["dims"]),
+        host_keys=None,
+        host_unique=t(j["host_unique"]),
+        num_total=j["num_total"],
+    )
+
+
+class _NullSegment:
+    """Segment stand-in for rebuilding kernels whose merge-side behavior
+    (combine / empty_state / finalize_array) is segment-independent."""
+    dims: Dict = {}
+    metrics: Dict = {}
+
+    def staged_dtype(self, name):
+        return np.int64
+
+    def aux_cached(self, key, fn):
+        return fn()
+
+
+_NULL_SEGMENT = _NullSegment()
+
+
+def rebuild_kernels(agg_jsons: Sequence[dict]):
+    """Kernels for the merge/finish side, from aggregator-spec JSON."""
+    from druid_tpu.query import aggregators as A
+    from druid_tpu.engine.filters import ConstNode
+    from druid_tpu.engine.kernels import FilteredKernel, make_kernel
+
+    def one(spec):
+        if isinstance(spec, A.FilteredAggregator):
+            # the filter only gates update(); merge-side it is inert
+            return FilteredKernel(spec, one(spec.delegate), ConstNode(True))
+        return make_kernel(spec, _NULL_SEGMENT)
+
+    return [one(A.agg_from_json(j)) for j in agg_jsons]
+
+
+# ---------------------------------------------------------------------------
+# AggregatePartials
+# ---------------------------------------------------------------------------
+
+def dumps_partials(ap, served: Sequence[str] = ()) -> bytes:
+    """Serialize AggregatePartials (+ the served-segment-id set the node is
+    acknowledging, which rides in the same payload)."""
+    tt = _TensorTable()
+    partials = []
+    for p in ap.partials:
+        partials.append({
+            "spec": _enc_spec(p.spec, tt),
+            "counts": tt.add(np.asarray(p.counts)),
+            "states": {k: _enc_state(v, tt) for k, v in p.states.items()},
+            "aggs": [k.spec.to_json() for k in p.kernels],
+        })
+    header = {
+        "partials": partials,
+        "dim_values": ap.dim_values,
+        "spans": [[int(a), int(b)] for a, b in ap.spans],
+        "intervals": None if ap.intervals is None
+        else [[iv.start, iv.end] for iv in ap.intervals],
+        "served": sorted(served),
+    }
+    manifest, payload = tt.manifest_and_payload()
+    header["tensors"] = manifest
+    hj = json.dumps(header).encode()
+    return MAGIC + struct.pack("<BI", VERSION, len(hj)) + hj + payload
+
+
+def loads_partials(data: bytes):
+    """Returns (AggregatePartials, served_segment_ids)."""
+    from druid_tpu.engine.engines import AggregatePartials
+    from druid_tpu.engine.grouping import SegmentPartial
+    from druid_tpu.utils.intervals import Interval
+
+    mv = memoryview(data)
+    if bytes(mv[:4]) != MAGIC:
+        raise WireError("bad magic")
+    version, hlen = struct.unpack("<BI", mv[4:9])
+    if version != VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    header = json.loads(bytes(mv[9: 9 + hlen]))
+    tensors = _read_tensors(header["tensors"], mv[9 + hlen:])
+
+    partials = []
+    for pj in header["partials"]:
+        kernels = rebuild_kernels(pj["aggs"])
+        partials.append(SegmentPartial(
+            segment=None,
+            spec=_dec_spec(pj["spec"], tensors),
+            counts=tensors[pj["counts"]],
+            states={k: _dec_state(v, tensors)
+                    for k, v in pj["states"].items()},
+            kernels=kernels))
+    intervals = header["intervals"]
+    ap = AggregatePartials(
+        partials=partials,
+        dim_values=header["dim_values"],
+        spans=[tuple(s) for s in header["spans"]],
+        intervals=None if intervals is None
+        else tuple(Interval(a, b) for a, b in intervals))
+    return ap, set(header["served"])

@@ -1,4 +1,8 @@
 from druid_tpu.server.lifecycle import QueryLifecycle, RequestLogger
 from druid_tpu.server.http import QueryHttpServer
+from druid_tpu.server.querymanager import (Deadline, QueryInterruptedError,
+                                           QueryManager, QueryTimeoutError)
 
-__all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer"]
+__all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer",
+           "QueryManager", "Deadline", "QueryInterruptedError",
+           "QueryTimeoutError"]

@@ -19,6 +19,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from druid_tpu.server.lifecycle import QueryLifecycle, Unauthorized
+from druid_tpu.server.querymanager import (QueryInterruptedError,
+                                           QueryTimeoutError)
 
 
 def _json_value(obj):
@@ -100,12 +102,30 @@ class QueryHttpServer:
                         self._reply(404, {"error": "unknown path"})
                 except Unauthorized as e:
                     self._reply(403, {"error": str(e)})
+                except QueryTimeoutError as e:
+                    self._reply(504, {"error": "Query timed out",
+                                      "errorMessage": str(e)})
+                except QueryInterruptedError as e:
+                    self._reply(500, {"error": "Query cancelled",
+                                      "errorMessage": str(e)})
                 except (ValueError, KeyError) as e:
                     # bad query = client error (QueryResource's
                     # BadJsonQueryException handling)
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 except Exception as e:
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_DELETE(self):
+                # DELETE /druid/v2/{id} — QueryResource.cancelQuery:
+                # 202 accepted whether or not the id was in flight
+                from druid_tpu.server.querymanager import cancel_path_id
+                qid = cancel_path_id(self.path)
+                if qid is not None:
+                    found = outer.lifecycle.cancel(qid)
+                    self._reply(202, {"queryId": qid,
+                                      "inFlight": bool(found)})
+                else:
+                    self._reply(404, {"error": "unknown path"})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]

@@ -51,12 +51,24 @@ class QueryLifecycle:
                  emitter: Optional[ServiceEmitter] = None,
                  request_logger: Optional[RequestLogger] = None,
                  authorizer: Optional[Callable[[Optional[str], Query], bool]] = None,
-                 on_result: Optional[Callable[[bool], None]] = None):
+                 on_result: Optional[Callable[[bool], None]] = None,
+                 query_manager=None):
         self.runner = runner
         self.emitter = emitter
         self.request_logger = request_logger
         self.authorizer = authorizer          # (identity, query) → allowed
         self.on_result = on_result            # QueryCountStatsMonitor hook
+        # share the runner's manager so a DELETE at this resource trips the
+        # same token the broker's scatter is checking
+        self.query_manager = query_manager \
+            if query_manager is not None \
+            else getattr(runner, "query_manager", None)
+
+    def cancel(self, query_id: str) -> bool:
+        """DELETE /druid/v2/{id} (QueryResource.cancelQuery)."""
+        if self.query_manager is None:
+            return False
+        return self.query_manager.cancel(query_id)
 
     def run_json(self, payload: dict, identity: Optional[str] = None):
         try:
@@ -74,6 +86,13 @@ class QueryLifecycle:
             self._log(query, qid, 0.0, False, error="unauthorized")
             raise Unauthorized(f"identity {identity!r} denied on "
                                f"[{query.datasource}]")
+        if qid != query.context_map.get("queryId"):
+            # stamp the generated id so cancel/timeout plumbing sees it
+            from dataclasses import replace
+            query = replace(query, context=tuple(sorted(
+                {**query.context_map, "queryId": qid}.items())))
+        if self.query_manager is not None:
+            self.query_manager.register(qid)
         t0 = time.monotonic()
         try:
             rows = self.runner.run(query)
@@ -83,6 +102,9 @@ class QueryLifecycle:
             if self.on_result:
                 self.on_result(False)
             raise
+        finally:
+            if self.query_manager is not None:
+                self.query_manager.unregister(qid)
         ms = (time.monotonic() - t0) * 1000
         self._log(query, qid, ms, True, n_rows=_count_rows(rows))
         if self.on_result:
@@ -92,8 +114,10 @@ class QueryLifecycle:
     def _log(self, query: Query, qid: str, ms: float, ok: bool,
              error: Optional[str] = None, n_rows: int = 0) -> None:
         if self.emitter is not None:
+            from druid_tpu.server.querymanager import context_priority
             self.emitter.metric("query/time", ms, dataSource=query.datasource,
                                 type=query.query_type, id=qid,
+                                priority=context_priority(query),
                                 success=str(ok).lower())
         if self.request_logger is not None:
             self.request_logger.log({

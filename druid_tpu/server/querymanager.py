@@ -1,0 +1,187 @@
+"""Query cancellation + timeout bookkeeping.
+
+Reference analogs:
+  server/QueryResource.java:126 — DELETE /druid/v2/{id} → QueryManager.cancel
+  query/QueryContexts.java — timeout / priority context keys and defaults
+  query/QueryInterruptedException.java — the wire-visible cancel/timeout error
+
+A QueryToken is registered per running query id; cancel() trips the token and
+fans out to any registered remote-cancel hooks (the broker propagates the
+DELETE to data nodes it has in-flight requests on, like DirectDruidClient
+does). Execution layers call token.check() at their natural yield points
+(between scatter rounds, between segment batches) — device programs
+themselves are uninterruptible once launched, exactly like a Java hot loop
+between two Yielder steps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class QueryInterruptedError(RuntimeError):
+    """Query was cancelled (reference: QueryInterruptedException CANCELLED)."""
+
+
+class QueryTimeoutError(RuntimeError):
+    """Query exceeded its context timeout (QueryInterruptedException
+    TIMED_OUT; HTTP 504 at the resource layer)."""
+
+
+DEFAULT_TIMEOUT_MS = 300_000
+
+
+def cancel_path_id(path: str) -> Optional[str]:
+    """The query id from an exact DELETE /druid/v2/{id} path, else None.
+    Reserved sub-resources (datasources, sql, partials, rows) and bare
+    /druid/v2 are not query ids."""
+    parts = path.rstrip("/").split("/")
+    if len(parts) != 4 or parts[:3] != ["", "druid", "v2"]:
+        return None
+    qid = parts[3]
+    return qid if qid and qid not in ("datasources", "sql", "partials",
+                                      "rows") else None
+
+
+def context_timeout_ms(query) -> Optional[float]:
+    """The query's timeout in ms (context key "timeout"; 0 = unlimited)."""
+    t = query.context_map.get("timeout")
+    if t is None:
+        return None
+    t = float(t)
+    return None if t <= 0 else t
+
+
+def context_priority(query) -> int:
+    """Context "priority" (QueryContexts.getPriority) — tagged on query
+    metrics/request logs; lane scheduling can build on it."""
+    try:
+        return int(query.context_map.get("priority", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+class Deadline:
+    """Monotonic deadline; None = unlimited."""
+
+    def __init__(self, timeout_ms: Optional[float]):
+        self._end = None if timeout_ms is None \
+            else time.monotonic() + timeout_ms / 1000.0
+
+    @staticmethod
+    def for_query(query) -> "Deadline":
+        return Deadline(context_timeout_ms(query))
+
+    def remaining_ms(self) -> Optional[float]:
+        if self._end is None:
+            return None
+        return max(0.0, (self._end - time.monotonic()) * 1000.0)
+
+    def expired(self) -> bool:
+        return self._end is not None and time.monotonic() >= self._end
+
+    def check(self) -> None:
+        if self.expired():
+            raise QueryTimeoutError("query timed out")
+
+
+class QueryToken:
+    def __init__(self, query_id: str):
+        self.query_id = query_id
+        self.refcount = 1
+        self._cancelled = threading.Event()
+        self._remote_cancels: Dict[object, Callable[[], None]] = {}
+        self._lock = threading.Lock()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def check(self) -> None:
+        if self.cancelled():
+            raise QueryInterruptedError(
+                f"query [{self.query_id}] was cancelled")
+
+    def add_remote_cancel(self, fn: Callable[[], None],
+                          key: object = None) -> None:
+        """Register a propagation hook (e.g. DELETE to a data node), one per
+        key — re-registering the same server across retry rounds is a no-op.
+        Runs immediately (in the background) if the token already tripped."""
+        run_now = False
+        with self._lock:
+            if self._cancelled.is_set():
+                run_now = True
+            else:
+                self._remote_cancels.setdefault(
+                    key if key is not None else object(), fn)
+        if run_now:
+            self._fire([fn])
+
+    @staticmethod
+    def _fire(hooks: List[Callable[[], None]]) -> None:
+        """Best-effort propagation off the caller's thread: a DELETE at the
+        resource layer must answer 202 immediately, not block on slow or
+        dead data nodes (each hook has its own connect timeout)."""
+        def run():
+            for fn in hooks:
+                try:
+                    fn()
+                except Exception:
+                    pass
+        threading.Thread(target=run, daemon=True).start()
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled.set()
+            hooks = list(self._remote_cancels.values())
+            self._remote_cancels = {}
+        if hooks:
+            self._fire(hooks)
+
+
+class QueryManager:
+    """Registry of in-flight queries (server/QueryManager analog)."""
+
+    def __init__(self):
+        self._tokens: Dict[str, QueryToken] = {}
+        self._lock = threading.Lock()
+
+    def register(self, query_id: str) -> QueryToken:
+        """Refcounted: two in-flight queries reusing one id share a token
+        that survives until the LAST unregister (a retry reusing its
+        queryId stays cancellable after the first attempt finishes)."""
+        with self._lock:
+            tok = self._tokens.get(query_id)
+            if tok is None:
+                tok = self._tokens[query_id] = QueryToken(query_id)
+            else:
+                tok.refcount += 1
+            return tok
+
+    def unregister(self, query_id: str) -> None:
+        with self._lock:
+            tok = self._tokens.get(query_id)
+            if tok is None:
+                return
+            tok.refcount -= 1
+            if tok.refcount <= 0:
+                del self._tokens[query_id]
+
+    def token(self, query_id: Optional[str]) -> Optional[QueryToken]:
+        if query_id is None:
+            return None
+        with self._lock:
+            return self._tokens.get(query_id)
+
+    def cancel(self, query_id: str) -> bool:
+        """True if the query was in flight. Cancelling an unknown id is a
+        no-op success=false (the reference returns 202 either way)."""
+        tok = self.token(query_id)
+        if tok is None:
+            return False
+        tok.cancel()
+        return True
+
+    def active_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tokens)
