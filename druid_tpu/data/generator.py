@@ -105,8 +105,14 @@ class DataGenerator:
 
     def segment(self, n_rows: int, interval: Interval,
                 datasource: str = "bench", version: str = "v1",
-                partition: int = 0) -> Segment:
-        """Generate one segment with rows spread uniformly over `interval`."""
+                partition: int = 0, sort_by_dims: bool = False) -> Segment:
+        """Generate one segment with rows spread uniformly over `interval`.
+
+        sort_by_dims=True writes rows in the reference's rollup sort order
+        (IndexMergerV9 orders rows by dimension values within a time bucket,
+        segment/IndexMergerV9.java:729; with a coarse queryGranularity that
+        is dimension-first order) — the layout our ingestion path produces
+        and the one the windowed grouped-reduction strategy exploits."""
         span = max(interval.width, 1)
         time_ms = interval.start + (
             np.sort(self.rng.integers(0, span, size=n_rows)).astype(np.int64))
@@ -120,11 +126,22 @@ class DataGenerator:
             else:
                 vtype = ValueType(spec.kind)
                 metrics[spec.name] = NumericColumn(self._gen_numeric(spec, n_rows), vtype)
+        if sort_by_dims and dims:
+            order = np.lexsort(tuple(
+                d.ids for d in reversed(list(dims.values()))))
+            time_ms = time_ms[order]
+            for d in dims.values():
+                d.ids = d.ids[order]
+            for m in metrics.values():
+                m.values = m.values[order]
         sid = SegmentId(datasource, interval, version, partition)
+        # sorted_by_time=True skips Segment's time re-sort: either rows are
+        # genuinely time-sorted, or the dim-sorted layout must be preserved
         return Segment(sid, time_ms, dims, metrics, sorted_by_time=True)
 
     def segments(self, n_segments: int, rows_per_segment: int,
-                 start: Interval, datasource: str = "bench") -> List[Segment]:
+                 start: Interval, datasource: str = "bench",
+                 sort_by_dims: bool = False) -> List[Segment]:
         """Generate n segments over consecutive sub-intervals sharing dictionaries
         (shared dictionaries enable the on-device collective merge path)."""
         width = start.width // n_segments
@@ -132,5 +149,6 @@ class DataGenerator:
         for i in range(n_segments):
             iv = Interval(start.start + i * width, start.start + (i + 1) * width)
             out.append(self.segment(rows_per_segment, iv, datasource=datasource,
-                                    partition=0, version="v1"))
+                                    partition=0, version="v1",
+                                    sort_by_dims=sort_by_dims))
         return out

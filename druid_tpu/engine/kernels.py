@@ -14,6 +14,7 @@ the unification the reference approximates with three separate engines.
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,6 +42,30 @@ def _seg_min(values, keys, num):
 def _seg_max(values, keys, num):
     import jax
     return jax.ops.segment_max(values, keys, num_segments=num)
+
+
+@dataclass
+class MMPlan:
+    """A kernel's one-hot-matmul decomposition (see engine/mmagg.py).
+
+    The engine builds the [block, G] one-hot of (key ∧ mask) once per block
+    and contracts it against every registered kernel's value rows in two
+    batched matmuls: int8 rows accumulate in int32 (exact ≤7-bit limbs),
+    bfloat16 rows accumulate in float32 (hi/lo/lo2 triple splits).
+
+    fields:    columns make_rows reads (staged/padded by the engine)
+    n_i8:      number of int8 rows this kernel contributes
+    n_bf16:    number of bf16 rows
+    make_rows: (cols_block, mask_block) -> (list of int8 [B] rows,
+               list of bf16 [B] rows)
+    finish:    (i32_parts [n_i8, G], f32_parts [n_bf16, G], num) -> state,
+               shaped like the kernel's scatter `update` state
+    """
+    fields: Tuple[str, ...]
+    n_i8: int
+    n_bf16: int
+    make_rows: object
+    finish: object
 
 
 class AggKernel:
@@ -119,6 +144,15 @@ class AggKernel:
         """Carry → the same state `update` would produce."""
         return carry
 
+    # ---- one-hot matmul path (MXU, small group spaces) ------------------
+    # For num_groups ≲ 4k, contracting an int8/bf16 one-hot against value
+    # rows on the MXU beats both scatter and the VPU broadcast path (~2-10x
+    # measured on v5e). Kernels whose update is a per-group SUM of per-row
+    # values opt in by returning an MMPlan.
+
+    def mm_plan(self, cols_avail: Dict, padded_rows: int) -> Optional[MMPlan]:
+        return None
+
 
 class CountKernel(AggKernel):
     reduce_kind = "sum"
@@ -151,6 +185,18 @@ class CountKernel(AggKernel):
         # dtype pinned so the scan carry stays int32 under x64
         return carry + valid.astype(jnp.int32).sum(axis=0, dtype=jnp.int32)
 
+    def mm_plan(self, cols_avail, padded_rows):
+        import jax.numpy as jnp
+        if padded_rows >= 2**31:
+            return None
+
+        def make(cols, mask):
+            return [jnp.ones(mask.shape, jnp.int8)], []
+
+        def fin(i8, bf, num):
+            return i8[0]
+        return MMPlan((), 1, 0, make, fin)
+
 
 class SumKernel(AggKernel):
     reduce_kind = "sum"
@@ -165,6 +211,10 @@ class SumKernel(AggKernel):
         # accumulation only at group granularity. chunk_rows bounds each
         # per-(chunk, group) partial below 2^30 regardless of skew.
         self.chunk_rows = 0
+        # one-hot matmul decomposition: ≤7-bit limb rows of (v - base), base
+        # the column min when negative. Eligible when ≤4 limbs cover the range.
+        self.mm_limbs = 0
+        self.mm_base = 0
         if vtype is ValueType.LONG and segment is not None \
                 and spec.field in segment.metrics \
                 and segment.staged_dtype(spec.field) == np.int32:
@@ -172,9 +222,65 @@ class SumKernel(AggKernel):
             max_abs = max(abs(lo), abs(hi), 1)
             r = (2 ** 30) // max_abs
             self.chunk_rows = max(1024, (r // 1024) * 1024)
+            base = min(int(lo), 0)
+            span = int(hi) - base
+            nl = max(1, (span.bit_length() + 6) // 7)
+            if nl <= 4:
+                self.mm_limbs = nl
+                self.mm_base = base
 
     def signature(self):
-        return f"sum({self.spec.field},{self.vtype.value},{self.chunk_rows})"
+        return (f"sum({self.spec.field},{self.vtype.value},{self.chunk_rows},"
+                f"mm{self.mm_limbs}:{self.mm_base})")
+
+    def mm_plan(self, cols_avail, padded_rows):
+        import jax.numpy as jnp
+        f = self.spec.field
+        if f not in cols_avail:
+            def make(cols, mask):
+                return [], []
+
+            def fin(i8, bf, num):
+                dt = jnp.float32 if self.vtype is ValueType.FLOAT else jnp.int64
+                return jnp.zeros(num, dt)
+            return MMPlan((), 0, 0, make, fin)
+        if self.vtype is ValueType.FLOAT:
+            # bf16 triple split: hi/lo/lo2 capture all 24 f32 mantissa bits;
+            # products against the 0/1 one-hot are exact, only the f32
+            # accumulation rounds (better than sequential f32 summation)
+            def make(cols, mask):
+                v = jnp.where(mask, cols[f], 0.0)  # NaN/inf guard off-mask
+                hi = v.astype(jnp.bfloat16)
+                r1 = v - hi.astype(jnp.float32)
+                m1 = r1.astype(jnp.bfloat16)
+                r2 = (r1 - m1.astype(jnp.float32)).astype(jnp.bfloat16)
+                return [], [hi, m1, r2]
+
+            def fin(i8, bf, num):
+                return bf[0] + bf[1] + bf[2]
+            return MMPlan((f,), 0, 3, make, fin)
+        if self.vtype is ValueType.LONG and self.mm_limbs \
+                and padded_rows * 127 < 2**31:
+            nl, base = self.mm_limbs, self.mm_base
+            n_rows = nl + (1 if base else 0)
+
+            def make(cols, mask):
+                v = cols[f] - jnp.int32(base)
+                rows = [((v >> (7 * i)) & 127).astype(jnp.int8)
+                        for i in range(nl)]
+                if base:
+                    rows.append(jnp.ones(mask.shape, jnp.int8))
+                return rows, []
+
+            def fin(i8, bf, num):
+                s = jnp.zeros(num, jnp.int64)
+                for i in range(nl):
+                    s = s + (i8[i].astype(jnp.int64) << (7 * i))
+                if base:
+                    s = s + i8[nl].astype(jnp.int64) * base
+                return s
+            return MMPlan((f,), n_rows, 0, make, fin)
+        return None
 
     def update(self, cols, mask, keys, num, aux):
         import jax

@@ -27,7 +27,8 @@ from druid_tpu.data.segment import Segment
 from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
                                        eval_virtual_columns,
-                                       fuse_filter_update, make_group_spec)
+                                       fuse_filter_update, make_group_spec,
+                                       select_strategy, windowed_window)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
@@ -156,6 +157,28 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                                     != segments[0].staged_dtype(c)):
                 return None
     stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
+
+    # reduction strategy must agree across the whole stacked program; the
+    # windowed path needs every segment's host span check to pass
+    col_dtypes = {"__time_offset": np.dtype(np.int32),
+                  "__valid": np.dtype(bool)}
+    for c in columns:
+        if c in segments[0].dims:
+            col_dtypes[c] = np.dtype(np.int32)
+        else:
+            col_dtypes[c] = np.dtype(segments[0].staged_dtype(c))
+
+    def _windowed_all():
+        w_all = 0
+        for s in segments:
+            w = windowed_window(s, intervals, granularity, spec0)
+            if not w:
+                return 0
+            w_all = max(w_all, w)
+        return w_all
+
+    spec0.strategy, spec0.window = select_strategy(
+        spec0, kernels, col_dtypes, R, _windowed_all)
 
     # per-segment RELATIVE interval bounds + bucket start offsets: the
     # device program stays in int32 offset space (64-bit elementwise time
@@ -326,7 +349,8 @@ def _sharded_sig(mesh, axis, spec: GroupSpec, kds, filter_node, kernels,
     mesh_key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
     return (mesh_key, axis, spec.bucket_mode, dims_sig, n_intervals, vc_sig,
             filter_node.signature() if filter_node else "none",
-            ";".join(k.signature() for k in kernels), spec.num_total, K, R)
+            ";".join(k.signature() for k in kernels), spec.num_total, K, R,
+            spec.strategy, spec.window)
 
 
 def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
@@ -427,7 +451,8 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
 
         counts, states = fuse_filter_update(arrays, mask, key, it, dim_cols,
                                             has_remap, filter_node, kernels,
-                                            num_total)
+                                            num_total, strategy=spec.strategy,
+                                            window=spec.window)
         states = tuple(k.device_post(s, time0)
                        for k, s in zip(kernels, states))
         return counts, states

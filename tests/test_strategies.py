@@ -1,0 +1,148 @@
+"""Reduction-strategy equivalence: mm / windowed / blocked / mixed must all
+produce identical results (reference semantics are strategy-independent —
+GroupByQueryEngineV2 vs vectorized engines return the same rows)."""
+import numpy as np
+import pytest
+
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.engine import grouping
+from druid_tpu.query.aggregators import (CountAggregator, DoubleSumAggregator,
+                                         FloatMaxAggregator,
+                                         FloatSumAggregator,
+                                         LongMinAggregator, LongSumAggregator)
+from druid_tpu.query.filters import BoundFilter
+from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+from druid_tpu.utils.intervals import Interval
+
+INTERVAL = Interval.of("2026-01-01", "2026-01-02")
+
+
+def _gen(sort_by_dims, card_a=30, card_b=200, n=40_000, lo=-500, hi=9_000):
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=card_a),
+        ColumnSpec("dimB", "string", cardinality=card_b, distribution="zipf"),
+        ColumnSpec("metLong", "long", low=lo, high=hi),
+        ColumnSpec("metFloat", "float", distribution="normal", mean=10.0,
+                   std=400.0),
+    )
+    gen = DataGenerator(schema, seed=77)
+    return gen.segments(2, n // 2, INTERVAL, sort_by_dims=sort_by_dims)
+
+
+AGGS = [CountAggregator("rows"),
+        LongSumAggregator("lsum", "metLong"),
+        FloatSumAggregator("fsum", "metFloat"),
+        FloatMaxAggregator("fmax", "metFloat"),
+        LongMinAggregator("lmin", "metLong")]
+
+MM_AGGS = AGGS[:3]   # sum-decomposable only
+
+
+def _run(segments, aggs, dims, flt=None, force=None, monkeypatch=None):
+    if force is not None:
+        orig = grouping.select_strategy
+
+        def fake(spec, kernels, col_dtypes, padded_rows, windowed_w):
+            s, w = orig(spec, kernels, col_dtypes, padded_rows, windowed_w)
+            if force == "mixed":
+                return "mixed", 0
+            assert s == force, f"expected strategy {force}, selected {s}"
+            return s, w
+        monkeypatch.setattr(grouping, "select_strategy", fake)
+    try:
+        q = GroupByQuery.of(
+            "bench", [INTERVAL], [DefaultDimensionSpec(d) for d in dims],
+            aggs, granularity="all", filter=flt)
+        ex = QueryExecutor(segments)
+        rows = ex.run(q)
+    finally:
+        if force is not None:
+            monkeypatch.setattr(grouping, "select_strategy", orig)
+    out = {}
+    for r in rows:
+        e = r["event"]
+        out[tuple(e[d] for d in dims)] = {
+            k: e[k] for k in e if k not in dims}
+    return out
+
+
+def _compare(a, b, float_keys=("fsum", "fmax")):
+    assert set(a) == set(b)
+    for k in a:
+        for m in a[k]:
+            va, vb = a[k][m], b[k][m]
+            if m in float_keys:
+                assert va == pytest.approx(vb, rel=1e-4, abs=1e-2), (k, m)
+            else:
+                assert va == vb, (k, m)
+
+
+def test_mm_matches_mixed_small_group(monkeypatch):
+    segments = _gen(sort_by_dims=False, card_b=40)
+    flt = BoundFilter("metLong", lower=-100, upper=8_000, ordering="numeric")
+    got = _run(segments, MM_AGGS, ["dimB"], flt)          # auto → mm
+    want = _run(segments, MM_AGGS, ["dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_mm_negative_longs_exact(monkeypatch):
+    segments = _gen(sort_by_dims=False, card_b=40, lo=-4_000, hi=-1)
+    got = _run(segments, MM_AGGS, ["dimB"])
+    want = _run(segments, MM_AGGS, ["dimB"], force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_windowed_matches_mixed_big_group(monkeypatch):
+    segments = _gen(sort_by_dims=True)
+    # 30 x 200 = 6000 groups > 2048 → windowed on the sorted layout
+    flt = BoundFilter("metLong", lower=0, upper=8_500, ordering="numeric")
+    got = _run(segments, AGGS, ["dimA", "dimB"], flt, force="windowed",
+               monkeypatch=monkeypatch)
+    want = _run(segments, AGGS, ["dimA", "dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_windowed_ineligible_on_unsorted():
+    segments = _gen(sort_by_dims=False)
+    spec = grouping.make_group_spec(
+        segments[0], [INTERVAL],
+        __import__("druid_tpu.utils.granularity",
+                   fromlist=["Granularity"]).Granularity.of("all"),
+        [grouping.KeyDim("dimA", 30, None),
+         grouping.KeyDim("dimB", 200, None)])
+    from druid_tpu.utils.granularity import Granularity
+    w = grouping.windowed_window(segments[0], [INTERVAL],
+                                 Granularity.of("all"), spec)
+    assert w == 0
+
+
+def test_windowed_eligible_on_sorted():
+    segments = _gen(sort_by_dims=True)
+    from druid_tpu.utils.granularity import Granularity
+    spec = grouping.make_group_spec(
+        segments[0], [INTERVAL], Granularity.of("all"),
+        [grouping.KeyDim("dimA", 30, None),
+         grouping.KeyDim("dimB", 200, None)])
+    w = grouping.windowed_window(segments[0], [INTERVAL],
+                                 Granularity.of("all"), spec)
+    assert w in grouping.WINDOW_CHOICES
+
+
+def test_mm_double_sum_falls_back(monkeypatch):
+    # doubleSum has no mm decomposition → strategy must not be "mm"
+    segments = _gen(sort_by_dims=False, card_b=40)
+    aggs = [CountAggregator("rows"), DoubleSumAggregator("dsum", "metFloat")]
+    seen = []
+    orig = grouping.select_strategy
+
+    def spy(spec, kernels, col_dtypes, padded_rows, windowed_w):
+        s, w = orig(spec, kernels, col_dtypes, padded_rows, windowed_w)
+        seen.append(s)
+        return s, w
+    monkeypatch.setattr(grouping, "select_strategy", spy)
+    _run(segments, aggs, ["dimB"])
+    assert seen and all(s != "mm" for s in seen)

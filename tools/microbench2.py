@@ -1,0 +1,168 @@
+"""Round 2 of primitive probing: BLK sweep, int8 vs bf16 matmul rate,
+pallas availability, fused pallas one-hot matmul prototype."""
+import time
+import sys
+import functools
+
+import numpy as np
+
+
+def _sync(r):
+    import jax
+    for leaf in jax.tree.leaves(r):
+        np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def t(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        _sync(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    N = 12_500_000
+    A, B = 128, 1024
+    rng = np.random.default_rng(0)
+    a_ids = jnp.asarray(rng.integers(0, 100, N, dtype=np.int32))
+    b_ids = jnp.asarray(rng.integers(0, 1000, N, dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, 10_000, N, dtype=np.int32))
+
+    results = {}
+
+    # raw matmul rate probe: [M, K] @ [K, 1024] int8 and bf16
+    for dt, acc_dt, name in [(jnp.int8, jnp.int32, "int8"),
+                             (jnp.bfloat16, jnp.float32, "bf16")]:
+        M, K = 384, 8192
+        lhs = jnp.ones((K, M), dt)
+        rhs = jnp.ones((K, B), dt)
+
+        @jax.jit
+        def mm(l, r):
+            def body(acc, _):
+                out = jax.lax.dot_general(
+                    l, r, (((0,), (0,)), ((), ())),
+                    preferred_element_type=acc_dt)
+                return acc + out, None
+            acc, _ = jax.lax.scan(body, jnp.zeros((M, B), acc_dt), None,
+                                  length=256)
+            return acc
+        sec = t(mm, lhs, rhs)
+        flops = 2 * M * K * B * 256
+        results[f"raw_matmul_{name}_384x8192x1024"] = (
+            sec, f"{flops/sec/1e12:8.1f} Tops")
+
+    # XLA 2-level one-hot with BLK sweep, 3 int8 cols (RHS-value packing)
+    for BLK in (1024, 2048, 4096):
+        nblk = N // BLK
+
+        @jax.jit
+        def onehot2(ka, kb_, v):
+            kaa = ka[: nblk * BLK].reshape(nblk, BLK)
+            kbb = kb_[: nblk * BLK].reshape(nblk, BLK)
+            v0 = (v[: nblk * BLK] & 127).astype(jnp.int8).reshape(nblk, BLK)
+            v1 = ((v[: nblk * BLK] >> 7) & 127).astype(jnp.int8).reshape(
+                nblk, BLK)
+            iota_a = jnp.arange(A, dtype=jnp.int32)
+            iota_b = jnp.arange(B, dtype=jnp.int32)
+
+            def body(acc, xs):
+                kk_a, kk_b, l0, l1 = xs
+                oh_a = (kk_a[:, None] == iota_a[None, :]).astype(jnp.int8)
+                oh_b = (kk_b[:, None] == iota_b[None, :])
+                rhs = jnp.concatenate([
+                    oh_b.astype(jnp.int8),
+                    jnp.where(oh_b, l0[:, None], 0).astype(jnp.int8),
+                    jnp.where(oh_b, l1[:, None], 0).astype(jnp.int8),
+                ], axis=1)  # [BLK, 3B]
+                out = jax.lax.dot_general(
+                    oh_a, rhs, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)  # [A, 3B]
+                return acc + out, None
+
+            acc0 = jnp.zeros((A, 3 * B), jnp.int32)
+            acc, _ = jax.lax.scan(body, acc0, (kaa, kbb, v0, v1))
+            return acc
+        sec = t(onehot2, a_ids, b_ids, vals)
+        results[f"xla_2level_rhs_blk{BLK}"] = (
+            sec, f"{N/sec/1e6:8.0f} M rows/s")
+
+    # pallas fused: one-hot built in VMEM scratch, matmul, accumulate
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        BLK = 2048
+
+        def kernel(ka_ref, kb_ref, v0_ref, v1_ref, out_ref, acc_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i == 0)
+            def _():
+                acc_ref[:] = jnp.zeros_like(acc_ref)
+
+            ka = ka_ref[:]  # [BLK]
+            kb = kb_ref[:]
+            iota_a = jax.lax.broadcasted_iota(jnp.int32, (BLK, A), 1)
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (BLK, B), 1)
+            oh_a = (ka[:, None] == iota_a).astype(jnp.int8)
+            oh_b = (kb[:, None] == iota_b)
+            rhs = jnp.concatenate([
+                oh_b.astype(jnp.int8),
+                jnp.where(oh_b, v0_ref[:][:, None], 0).astype(jnp.int8),
+                jnp.where(oh_b, v1_ref[:][:, None], 0).astype(jnp.int8),
+            ], axis=1)
+            acc_ref[:] += jax.lax.dot_general(
+                oh_a, rhs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+
+            @pl.when(i == pl.num_programs(0) - 1)
+            def _():
+                out_ref[:] = acc_ref[:]
+
+        nblk = N // BLK
+
+        @jax.jit
+        def pallas_fused(ka, kb_, v):
+            n = nblk * BLK
+            v0 = (v[:n] & 127).astype(jnp.int8)
+            v1 = ((v[:n] >> 7) & 127).astype(jnp.int8)
+            grid = (nblk,)
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((A, 3 * B), jnp.int32),
+                grid=grid,
+                in_specs=[
+                    pl.BlockSpec((BLK,), lambda i: (i,),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((BLK,), lambda i: (i,),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((BLK,), lambda i: (i,),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((BLK,), lambda i: (i,),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((A, 3 * B), lambda i: (0, 0),
+                                       memory_space=pltpu.VMEM),
+                scratch_shapes=[pltpu.VMEM((A, 3 * B), jnp.int32)],
+            )(ka[:n], kb_[:n], v0, v1)
+
+        sec = t(pallas_fused, a_ids, b_ids, vals)
+        results["pallas_fused_2level_blk2048"] = (
+            sec, f"{N/sec/1e6:8.0f} M rows/s")
+    except Exception as e:
+        results["pallas_fused_2level_blk2048"] = (0.0, f"FAILED: {e!r:.200}")
+
+    for k, (sec, extra) in results.items():
+        print(f"{k:38s} {sec*1e3:9.2f} ms   {extra}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Probe peak matmul rate + pallas error detail."""
+import time
+import sys
+import numpy as np
+
+
+def _sync(r):
+    import jax
+    for leaf in jax.tree.leaves(r):
+        np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def t(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        _sync(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # peak probe: chained square matmuls (data-dependent, can't be hoisted)
+    for dt, acc, name in [(jnp.bfloat16, jnp.bfloat16, "bf16"),
+                          (jnp.int8, jnp.int32, "int8")]:
+        M = 4096
+
+        @jax.jit
+        def chain(x, w):
+            def body(c, _):
+                c = jax.lax.dot_general(
+                    c, w, (((1,), (0,)), ((), ())),
+                    preferred_element_type=acc)
+                if acc != dt:
+                    c = (c & 1).astype(dt) if name == "int8" else c.astype(dt)
+                return c, None
+            c, _ = jax.lax.scan(body, x, None, length=64)
+            return c
+
+        x = jnp.ones((M, M), dt)
+        w = jnp.ones((M, M), dt) if name == "bf16" else jnp.ones(
+            (M, M), dt)
+        sec = t(chain, x, w)
+        flops = 2 * M * M * M * 64
+        print(f"peak_chain_{name}_4096^3 x64   {sec*1e3:9.2f} ms  "
+              f"{flops/sec/1e12:8.1f} Tops")
+
+    # pallas minimal test with full traceback
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        @jax.jit
+        def double(x):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(x)
+
+        r = double(jnp.ones((256, 256), jnp.float32))
+        _sync(r)
+        print("pallas_minimal OK", float(np.asarray(jax.device_get(r))[0, 0]))
+    except Exception as e:
+        import traceback
+        traceback.print_exc()
+        print("pallas_minimal FAILED")
+
+
+if __name__ == "__main__":
+    main()

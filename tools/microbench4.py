@@ -1,0 +1,196 @@
+"""Validate the sorted-segment windowed local-dense reduction + remaining
+primitives: timeseries G=1 rate, one-hot col scaling, staging rates."""
+import time
+import sys
+import numpy as np
+
+
+def _sync(r):
+    import jax
+    for leaf in jax.tree.leaves(r):
+        np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def t(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        _sync(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    N = 12_500_000
+    rng = np.random.default_rng(0)
+    a_np = rng.integers(0, 100, N, dtype=np.int32)
+    b_np = rng.integers(0, 1000, N, dtype=np.int32)
+    v_np = rng.integers(0, 10_000, N, dtype=np.int32)
+    f_np = rng.normal(100, 25, N).astype(np.float32)
+
+    # sorted layout: rows sorted by (a, b) — ingestion order
+    order = np.lexsort((b_np, a_np))
+    key_sorted = jnp.asarray((a_np * 1000 + b_np)[order])
+    v_sorted = jnp.asarray(v_np[order])
+    f_sorted = jnp.asarray(f_np[order])
+    vals = jnp.asarray(v_np)
+    b_ids = jnp.asarray(b_np)
+    fvals = jnp.asarray(f_np)
+
+    G = 100 * 1000
+    results = {}
+
+    # 1. timeseries-style: masked sum+count+max, G=1
+    @jax.jit
+    def ts(v, f):
+        m = (v >= 100) & (v <= 9900)
+        return (m.sum(dtype=jnp.int32),
+                jnp.where(m, v, 0).sum(dtype=jnp.int64),
+                jnp.where(m, f, -jnp.inf).max())
+    results["timeseries_G1_3agg"] = t(ts, vals, fvals)
+
+    # 2. windowed local-dense on sorted keys, W=128, 3 aggs + recursion L2
+    BLK = 2048
+    W = 128
+
+    def windowed_pass(key, cols, nblk, blk, w):
+        """key [nblk*blk] sorted-ish; returns (bases [nblk], grids)."""
+        kb = key.reshape(nblk, blk)
+        base = kb[:, 0][:, None]                    # block window base
+        local = kb - base                           # [nblk, blk]
+        ok = (local >= 0) & (local < w)             # overflow rows -> L3
+        iota = jnp.arange(w, dtype=jnp.int32)
+        oh = (local[:, :, None] == iota[None, None, :]) & ok[:, :, None]
+        outs = []
+        for c, kind in cols:
+            cb = c.reshape(nblk, blk)
+            if kind == "sum":
+                outs.append(jnp.where(oh, cb[:, :, None], 0).sum(
+                    1, dtype=jnp.int64 if cb.dtype == jnp.int32 else None))
+            elif kind == "count":
+                outs.append(oh.sum(1, dtype=jnp.int32))
+            else:
+                outs.append(jnp.where(oh, cb[:, :, None],
+                                      -jnp.inf).max(1))
+        return base[:, 0], outs, ok
+
+    @jax.jit
+    def windowed(key, v, f):
+        nblk = N // BLK
+        n = nblk * BLK
+        key, v, f = key[:n], v[:n], f[:n]
+        base, (cnt, sm, mx), ok = windowed_pass(
+            key, [(v, "count"), (v, "sum"), (f, "max")], nblk, BLK, W)
+        # L2: flatten [nblk, W] grids keyed by base+iota, scatter (small)
+        keys2 = (base[:, None] + jnp.arange(W, dtype=jnp.int32)).ravel()
+        keys2 = jnp.clip(keys2, 0, G - 1)
+        c2 = jax.ops.segment_sum(cnt.ravel(), keys2, num_segments=G)
+        s2 = jax.ops.segment_sum(sm.ravel(), keys2, num_segments=G)
+        m2 = jax.ops.segment_max(mx.ravel(), keys2, num_segments=G)
+        return c2, s2, m2
+    results[f"windowed_sorted_W{W}_3agg+L2scatter"] = t(
+        windowed, key_sorted, v_sorted, f_sorted)
+
+    # 2b. windowed L1 only (no L2 combine) to see the split
+    @jax.jit
+    def windowed_l1(key, v, f):
+        nblk = N // BLK
+        n = nblk * BLK
+        key, v, f = key[:n], v[:n], f[:n]
+        base, outs, ok = windowed_pass(
+            key, [(v, "count"), (v, "sum"), (f, "max")], nblk, BLK, W)
+        return base, outs
+    results[f"windowed_sorted_W{W}_L1only"] = t(
+        windowed_l1, key_sorted, v_sorted, f_sorted)
+
+    # 3. one-hot int8 G=1024 with 7 value cols (col scaling)
+    BLK2 = 8192
+
+    @jax.jit
+    def onehot7(bk, v):
+        nblk = N // BLK2
+        kb = (bk[: nblk * BLK2] % 1024).reshape(nblk, BLK2)
+        l = [(v[: nblk * BLK2] >> (7 * i) & 127).astype(jnp.int8).reshape(
+            nblk, BLK2) for i in range(2)]
+        iota = jnp.arange(1024, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk = xs[0]
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int8)
+            lhs = jnp.stack([jnp.ones((BLK2,), jnp.int8)] + [
+                xs[1 + (i % 2)] for i in range(6)], 0)  # [7, BLK2]
+            out = jax.lax.dot_general(
+                lhs, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc + out, None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((7, 1024), jnp.int32),
+                              (kb, *l))
+        return acc
+    results["onehot_int8_G1024_7col"] = t(onehot7, b_ids, vals)
+
+    # 4. one-hot int8 single-level G=4096, 3 cols
+    @jax.jit
+    def onehot4096(k, v):
+        nblk = N // BLK2
+        kb = (k[: nblk * BLK2] % 4096).reshape(nblk, BLK2)
+        v0 = (v[: nblk * BLK2] & 127).astype(jnp.int8).reshape(nblk, BLK2)
+        v1 = ((v[: nblk * BLK2] >> 7) & 127).astype(jnp.int8).reshape(
+            nblk, BLK2)
+        iota = jnp.arange(4096, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, l0, l1 = xs
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int8)
+            lhs = jnp.stack([jnp.ones((BLK2,), jnp.int8), l0, l1], 0)
+            out = jax.lax.dot_general(
+                lhs, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc + out, None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((3, 4096), jnp.int32),
+                              (kb, v0, v1))
+        return acc
+    results["onehot_int8_G4096_3col"] = t(
+        onehot4096, jnp.asarray(a_np * 1000 + b_np), vals)
+
+    # 5. scatter with [N, 4] payload vs single
+    key_dev = jnp.asarray(a_np * 1000 + b_np)
+
+    @jax.jit
+    def seg4(k, v):
+        vv = jnp.stack([v, v + 1, v + 2, v + 3], 1)
+        return jax.ops.segment_sum(vv, k, num_segments=131072)
+    results["segment_sum_4col_payload"] = t(seg4, key_dev, vals)
+
+    # 6. cumsum over N
+    @jax.jit
+    def cs(v):
+        return jnp.cumsum(v, dtype=jnp.int64)
+    results["cumsum_12.5M"] = t(cs, vals)
+
+    # 7. H2D staging rate: 50MB column
+    col = np.random.randint(0, 1000, 12_500_000).astype(np.int32)
+
+    def h2d():
+        return jax.device_put(col)
+    results["H2D_50MB_col"] = t(h2d)
+
+    # 8. D2H partial grids [128, 3072] int32
+    grid = jnp.ones((128, 3072), jnp.int32)
+
+    def d2h(g):
+        return np.asarray(jax.device_get(g))
+    results["D2H_1.5MB_grid"] = t(d2h, grid)
+
+    for k, sec in results.items():
+        print(f"{k:42s} {sec*1e3:9.2f} ms   {N/sec/1e6:9.0f} M rows/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Accurate timings: repeat each op R times inside ONE jit (data-dependent so
+XLA can't hoist), fetch one scalar. Separately probe RPC latency + H2D rates."""
+import time
+import numpy as np
+
+REPS = 10
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    N = 12_500_000
+    rng = np.random.default_rng(0)
+    a_np = rng.integers(0, 100, N, dtype=np.int32)
+    b_np = rng.integers(0, 1000, N, dtype=np.int32)
+    v_np = rng.integers(0, 10_000, N, dtype=np.int32)
+    f_np = rng.normal(100, 25, N).astype(np.float32)
+    order = np.lexsort((b_np, a_np))
+
+    print("staging inputs...", flush=True)
+    t0 = time.perf_counter()
+    a_ids = jax.device_put(a_np)
+    b_ids = jax.device_put(b_np)
+    vals = jax.device_put(v_np)
+    fvals = jax.device_put(f_np)
+    key_sorted = jax.device_put((a_np * 1000 + b_np)[order])
+    v_sorted = jax.device_put(v_np[order])
+    f_sorted = jax.device_put(f_np[order])
+    key_fused = jax.device_put(a_np * 1000 + b_np)
+    for x in (a_ids, b_ids, vals, fvals, key_sorted, v_sorted, f_sorted,
+              key_fused):
+        x.block_until_ready()
+    print(f"staged 8 x 50MB in {time.perf_counter()-t0:.1f}s", flush=True)
+
+    # RPC latency: device_get of a scalar
+    s = jnp.float32(1.0) + 0
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(s))
+        lat.append(time.perf_counter() - t0)
+    rpc = min(lat)
+    print(f"RPC scalar fetch latency: {rpc*1e3:.1f} ms", flush=True)
+
+    def timed(name, fn, *args):
+        """fn(i, *args) -> array; summed over REPS in-jit, one fetch."""
+        @jax.jit
+        def run(*a):
+            def body(acc, i):
+                out = fn(i, *a)
+                leaves = jax.tree.leaves(out)
+                r = sum(jnp.sum(l, dtype=jnp.float32) if l.dtype != jnp.bool_
+                        else jnp.sum(l.astype(jnp.int32), dtype=jnp.float32)
+                        for l in leaves)
+                return acc + r, None
+            acc, _ = jax.lax.scan(body, jnp.float32(0),
+                                  jnp.arange(REPS, dtype=jnp.int32))
+            return acc
+        r = run(*args)
+        np.asarray(jax.device_get(r))  # compile+warm
+        t0 = time.perf_counter()
+        r = run(*args)
+        np.asarray(jax.device_get(r))
+        per = (time.perf_counter() - t0 - rpc) / REPS
+        print(f"{name:44s} {per*1e3:9.2f} ms  {N/per/1e6:9.0f} M rows/s",
+              flush=True)
+        return per
+
+    # 1. timeseries 3agg
+    def ts(i, v, f):
+        v = v + i  # data dependence; cheap
+        m = (v >= 100) & (v <= 9900)
+        return (m.sum(dtype=jnp.int32), jnp.where(m, v, 0).sum(),
+                jnp.where(m, f, -3.4e38).max())
+    timed("timeseries_G1_3agg", ts, vals, fvals)
+
+    # 2. one-hot int8 matmul G=1024, 3col (scan over 8192-blocks)
+    BLK = 8192
+    nblk = N // BLK
+    n = nblk * BLK
+
+    def onehot1024(i, bk, v):
+        kb = (bk[:n] % 1024).reshape(nblk, BLK)
+        v = v + i
+        v0 = (v[:n] & 127).astype(jnp.int8).reshape(nblk, BLK)
+        v1 = ((v[:n] >> 7) & 127).astype(jnp.int8).reshape(nblk, BLK)
+        iota = jnp.arange(1024, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, l0, l1 = xs
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int8)
+            lhs = jnp.stack([jnp.ones((BLK,), jnp.int8), l0, l1], 0)
+            return acc + jax.lax.dot_general(
+                lhs, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32), None
+        acc, _ = jax.lax.scan(body, jnp.zeros((3, 1024), jnp.int32),
+                              (kb, v0, v1))
+        return acc
+    timed("onehot_int8_G1024_3col", onehot1024, b_ids, vals)
+
+    # 3. one-hot int8 G=4096 3col
+    def onehot4096(i, k, v):
+        kb = (k[:n] % 4096).reshape(nblk, BLK)
+        v = v + i
+        v0 = (v[:n] & 127).astype(jnp.int8).reshape(nblk, BLK)
+        v1 = ((v[:n] >> 7) & 127).astype(jnp.int8).reshape(nblk, BLK)
+        iota = jnp.arange(4096, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, l0, l1 = xs
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int8)
+            lhs = jnp.stack([jnp.ones((BLK,), jnp.int8), l0, l1], 0)
+            return acc + jax.lax.dot_general(
+                lhs, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32), None
+        acc, _ = jax.lax.scan(body, jnp.zeros((3, 4096), jnp.int32),
+                              (kb, v0, v1))
+        return acc
+    timed("onehot_int8_G4096_3col", onehot4096, key_fused, vals)
+
+    # 4. windowed local-dense W=128 on sorted keys, scan form, 3 aggs
+    W = 128
+    SUB = 16384  # rows per scan step
+    nstep = n // SUB
+
+    def windowed(i, key, v, f):
+        ks = key[: nstep * SUB].reshape(nstep, SUB)
+        vs = (v + i)[: nstep * SUB].reshape(nstep, SUB)
+        fs = f[: nstep * SUB].reshape(nstep, SUB)
+        iota = jnp.arange(W, dtype=jnp.int32)
+
+        def body(carry, xs):
+            kk, vv, ff = xs      # [SUB]
+            kb = kk.reshape(-1, 2048)           # [8, 2048]
+            base = kb[:, :1]
+            local = kb - base
+            ok = (local >= 0) & (local < W)
+            oh = (local[:, :, None] == iota[None, None, :]) & ok[:, :, None]
+            cnt = oh.sum(1, dtype=jnp.int32)                    # [8, W]
+            sm = jnp.where(oh, vv.reshape(-1, 2048)[:, :, None], 0).sum(1)
+            mx = jnp.where(oh, ff.reshape(-1, 2048)[:, :, None],
+                           -3.4e38).max(1)
+            return carry, (base[:, 0], cnt, sm, mx, ok.any())
+        _, outs = jax.lax.scan(body, 0, (ks, vs, fs))
+        return outs[1:]  # grids (keep on device; L2 combine separate)
+    timed("windowed_sorted_W128_L1_scan", windowed, key_sorted, v_sorted,
+          f_sorted)
+
+    # 5. blocked VPU G=1024 3agg (current engine) for comparison
+    def blocked(i, bk, v, f):
+        kb = (bk[:n] % 1024).reshape(nblk, BLK)
+        vs = (v + i)[:n].reshape(nblk, BLK)
+        fs = f[:n].reshape(nblk, BLK)
+        iota = jnp.arange(1024, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, vv, ff = xs
+            valid = kk[:, None] == iota[None, :]
+            c = acc[0] + valid.astype(jnp.int32).sum(0, dtype=jnp.int32)
+            s = acc[1] + jnp.where(valid, vv[:, None], 0).sum(
+                0, dtype=jnp.int32)
+            m = jnp.maximum(acc[2], jnp.where(valid, ff[:, None],
+                                              -3.4e38).max(0))
+            return (c, s, m), None
+        acc, _ = jax.lax.scan(body, (jnp.zeros(1024, jnp.int32),
+                                     jnp.zeros(1024, jnp.int32),
+                                     jnp.full(1024, -3.4e38, jnp.float32)),
+                              (kb, vs, fs))
+        return acc
+    timed("blocked_vpu_G1024_3agg", blocked, b_ids, vals, fvals)
+
+    # 6. segment_sum 1 col G=131072
+    def seg(i, k, v):
+        return jax.ops.segment_sum(v + i, k, num_segments=131072)
+    timed("segment_sum_G131072", seg, key_fused, vals)
+
+    # 7. windowed L2 combine cost: scatter of [nblk8=763x8, W] grids
+    grids = jnp.ones((6103, W), jnp.int32)
+    bases = jnp.asarray((np.arange(6103) * 17).astype(np.int32))
+
+    def l2(i, g, b):
+        keys2 = jnp.clip(b[:, None] + jnp.arange(W, dtype=jnp.int32) + i * 0,
+                         0, 131071).ravel()
+        return jax.ops.segment_sum(g.ravel(), keys2, num_segments=131072)
+    timed("windowed_L2_scatter_781k", l2, grids, bases)
+
+    # H2D size sweep
+    for mb in (1, 8, 50):
+        arr = np.ones(mb * 262144, np.float32)
+        jax.device_put(arr[:16]).block_until_ready()
+        t0 = time.perf_counter()
+        jax.device_put(arr).block_until_ready()
+        dt = time.perf_counter() - t0
+        print(f"H2D {mb:3d}MB: {dt*1e3:8.1f} ms   {mb/dt:7.1f} MB/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,245 @@
+"""Microbenchmark of candidate TPU primitives for grouped aggregation.
+
+Decides the round-3 engine strategy: one-hot MXU matmul vs sort vs gather
+partition vs scatter. Run on the real chip:  python tools/microbench_groupagg.py
+"""
+import time
+import sys
+
+import numpy as np
+
+
+def _sync(r):
+    # block_until_ready is a no-op through the axon tunnel; force a host
+    # read of one element of every output to really synchronize
+    import jax
+    import numpy as _np
+    for leaf in jax.tree.leaves(r):
+        _np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def t(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        _sync(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    N = 12_500_000          # rows per segment in the headline bench
+    A, B = 128, 1024        # padded major/minor cardinality (100 x 1000)
+    G = A * B               # 131072 dense group space
+    BLK = 8192
+
+    rng = np.random.default_rng(0)
+    a_ids = jnp.asarray(rng.integers(0, 100, N, dtype=np.int32))
+    b_ids = jnp.asarray(rng.integers(0, 1000, N, dtype=np.int32))
+    key = a_ids * 1000 + b_ids
+    vals = jnp.asarray(rng.integers(0, 10_000, N, dtype=np.int32))
+    fvals = jnp.asarray(rng.normal(100, 25, N).astype(np.float32))
+
+    results = {}
+
+    # 1. segment_sum scatter at G=131072
+    @jax.jit
+    def seg_sum(k, v):
+        return jax.ops.segment_sum(v, k, num_segments=G)
+    results["segment_sum_scatter_G131072"] = t(seg_sum, key, vals)
+
+    # 2. segment_max scatter
+    @jax.jit
+    def seg_max(k, v):
+        return jax.ops.segment_max(v, k, num_segments=G)
+    results["segment_max_scatter_G131072"] = t(seg_max, key, fvals)
+
+    # 3. blocked VPU broadcast (current engine path) at G=1024
+    @jax.jit
+    def blocked_vpu(k, v):
+        nblk = N // BLK
+        kb = k[: nblk * BLK].reshape(nblk, BLK)
+        vb = v[: nblk * BLK].reshape(nblk, BLK)
+        iota = jnp.arange(1024, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, vv = xs
+            valid = (kk[:, None] % 1024) == iota[None, :]
+            acc = (acc[0] + valid.astype(jnp.int32).sum(0, dtype=jnp.int32),
+                   acc[1] + jnp.where(valid, vv[:, None], 0).sum(
+                       0, dtype=jnp.int32))
+            return acc, None
+
+        init = (jnp.zeros(1024, jnp.int32), jnp.zeros(1024, jnp.int32))
+        (c, s), _ = jax.lax.scan(body, init, (kb, vb))
+        return c, s
+    results["blocked_vpu_count+sum_G1024"] = t(blocked_vpu, key, vals)
+
+    # 4. one-hot int8 matmul, G=1024 (minor only): count+2 limb cols
+    @jax.jit
+    def onehot_matmul_small(bk, v):
+        nblk = N // BLK
+        kb = (bk[: nblk * BLK] % 1024).reshape(nblk, BLK)
+        v0 = (v[: nblk * BLK] & 127).astype(jnp.int8).reshape(nblk, BLK)
+        v1 = ((v[: nblk * BLK] >> 7) & 127).astype(jnp.int8).reshape(nblk, BLK)
+        iota = jnp.arange(1024, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk, l0, l1 = xs
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int8)
+            lhs = jnp.stack([jnp.ones((BLK,), jnp.int8), l0, l1], 0)  # [3,BLK]
+            out = jax.lax.dot_general(
+                lhs, oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)  # [3, 1024]
+            return acc + out, None
+
+        acc0 = jnp.zeros((3, 1024), jnp.int32)
+        acc, _ = jax.lax.scan(body, acc0, (kb, v0, v1))
+        return acc
+    results["onehot_int8_matmul_G1024_3col"] = t(onehot_matmul_small, b_ids, vals)
+
+    # 5. two-level one-hot int8 matmul, G=131072: lhs=[3*A, BLK] @ [BLK, B]
+    @jax.jit
+    def onehot_matmul_2level(ka, kb_, v):
+        nblk = N // BLK
+        kaa = ka[: nblk * BLK].reshape(nblk, BLK)
+        kbb = kb_[: nblk * BLK].reshape(nblk, BLK)
+        v0 = (v[: nblk * BLK] & 127).astype(jnp.int8).reshape(nblk, BLK)
+        v1 = ((v[: nblk * BLK] >> 7) & 127).astype(jnp.int8).reshape(nblk, BLK)
+        iota_a = jnp.arange(A, dtype=jnp.int32)
+        iota_b = jnp.arange(B, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk_a, kk_b, l0, l1 = xs
+            oh_a = (kk_a[:, None] == iota_a[None, :])  # [BLK, A] bool
+            oh_b = (kk_b[:, None] == iota_b[None, :]).astype(jnp.int8)
+            lhs = jnp.concatenate([
+                oh_a.astype(jnp.int8),
+                jnp.where(oh_a, l0[:, None], 0).astype(jnp.int8),
+                jnp.where(oh_a, l1[:, None], 0).astype(jnp.int8),
+            ], axis=1)  # [BLK, 3A]
+            out = jax.lax.dot_general(
+                lhs, oh_b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)  # [3A, B]
+            return acc + out, None
+
+        acc0 = jnp.zeros((3 * A, B), jnp.int32)
+        acc, _ = jax.lax.scan(body, acc0, (kaa, kbb, v0, v1))
+        return acc
+    results["onehot_int8_2level_G131072_3col"] = t(
+        onehot_matmul_2level, a_ids, b_ids, vals)
+
+    # 5b. bf16 variant of two-level (f32 accum)
+    @jax.jit
+    def onehot_matmul_2level_bf16(ka, kb_, v):
+        nblk = N // BLK
+        kaa = ka[: nblk * BLK].reshape(nblk, BLK)
+        kbb = kb_[: nblk * BLK].reshape(nblk, BLK)
+        vv = v[: nblk * BLK].astype(jnp.bfloat16).reshape(nblk, BLK)
+        iota_a = jnp.arange(A, dtype=jnp.int32)
+        iota_b = jnp.arange(B, dtype=jnp.int32)
+
+        def body(acc, xs):
+            kk_a, kk_b, x = xs
+            oh_a = (kk_a[:, None] == iota_a[None, :])
+            oh_b = (kk_b[:, None] == iota_b[None, :]).astype(jnp.bfloat16)
+            lhs = jnp.concatenate([
+                oh_a.astype(jnp.bfloat16),
+                jnp.where(oh_a, x[:, None], 0).astype(jnp.bfloat16),
+            ], axis=1)
+            out = jax.lax.dot_general(
+                lhs, oh_b, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc + out, None
+
+        acc0 = jnp.zeros((2 * A, B), jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, (kaa, kbb, vv))
+        return acc
+    results["onehot_bf16_2level_G131072_2col"] = t(
+        onehot_matmul_2level_bf16, a_ids, b_ids, fvals)
+
+    # 6. sort: key+1 payload / key+3 payloads
+    @jax.jit
+    def sort1(k, v):
+        return jax.lax.sort_key_val(k, v)
+    results["sort_key_1payload_12.5M"] = t(sort1, key, vals)
+
+    @jax.jit
+    def sort3(k, v1, v2, v3):
+        return jax.lax.sort((k, v1, v2, v3), num_keys=1)
+    results["sort_key_3payload_12.5M"] = t(sort3, key, vals, fvals, b_ids)
+
+    # 7. gather: permutation apply (N from N) and remap (N from 131072)
+    perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+    @jax.jit
+    def gatherN(v, p):
+        return v[p]
+    results["gather_N_from_N"] = t(gatherN, vals, perm)
+
+    small_tab = jnp.asarray(rng.integers(0, 99, G, dtype=np.int32))
+
+    @jax.jit
+    def gather_small(k, tab):
+        return tab[k]
+    results["gather_N_from_131072"] = t(gather_small, key, small_tab)
+
+    tab1k = jnp.asarray(rng.integers(0, 99, 1024, dtype=np.int32))
+
+    @jax.jit
+    def gather_1k(k, tab):
+        return tab[k % 1024]
+    results["gather_N_from_1024"] = t(gather_1k, key, tab1k)
+
+    # 8. blocked minor-onehot masked max (G=1024), the partitioned-max path
+    @jax.jit
+    def blocked_max_minor(bk, v):
+        nblk = N // BLK
+        kb = (bk[: nblk * BLK] % 1024).reshape(nblk, BLK)
+        vb = v[: nblk * BLK].reshape(nblk, BLK)
+        iota = jnp.arange(1024, dtype=jnp.int32)
+        neg = jnp.float32(-3.4e38)
+
+        def body(acc, xs):
+            kk, vv = xs
+            m = jnp.where(kk[:, None] == iota[None, :], vv[:, None], neg)
+            return jnp.maximum(acc, m.max(0)), None
+
+        acc0 = jnp.full((1024,), neg, jnp.float32)
+        acc, _ = jax.lax.scan(body, acc0, (kb, vb))
+        return acc
+    results["blocked_max_minor_G1024"] = t(blocked_max_minor, b_ids, fvals)
+
+    # 9. cumsum ranks for counting sort: [BLK, 128] within-block cumsum scan
+    @jax.jit
+    def count_ranks(ka):
+        nblk = N // BLK
+        kaa = ka[: nblk * BLK].reshape(nblk, BLK)
+        iota = jnp.arange(A, dtype=jnp.int32)
+
+        def body(offs, kk):
+            oh = (kk[:, None] == iota[None, :]).astype(jnp.int32)
+            within = jnp.cumsum(oh, axis=0) - oh
+            rank = offs[None, :] + within
+            pos = (rank * oh).sum(1)
+            return offs + oh.sum(0), pos
+
+        offs0 = jnp.zeros((A,), jnp.int32)
+        _, pos = jax.lax.scan(body, offs0, kaa)
+        return pos
+    results["counting_ranks_A128"] = t(count_ranks, a_ids)
+
+    # 10. full pipeline estimate: ranks + 4x gather
+    for k, v in results.items():
+        rate = N / v / 1e6
+        print(f"{k:42s} {v*1e3:9.2f} ms   {rate:9.0f} M rows/s")
+
+
+if __name__ == "__main__":
+    main()
