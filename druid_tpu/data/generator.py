@@ -135,9 +135,11 @@ class DataGenerator:
             for m in metrics.values():
                 m.values = m.values[order]
         sid = SegmentId(datasource, interval, version, partition)
-        # sorted_by_time=True skips Segment's time re-sort: either rows are
-        # genuinely time-sorted, or the dim-sorted layout must be preserved
-        return Segment(sid, time_ms, dims, metrics, sorted_by_time=True)
+        # sorted_by_time=True skips Segment's time re-sort; dim-sorted
+        # layouts are flagged time_ordered=False so nothing mistakes them
+        # for time-monotonic data
+        return Segment(sid, time_ms, dims, metrics, sorted_by_time=True,
+                       time_ordered=not sort_by_dims)
 
     def segments(self, n_segments: int, rows_per_segment: int,
                  start: Interval, datasource: str = "bench",

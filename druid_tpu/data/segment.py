@@ -171,7 +171,12 @@ class Segment:
     def __init__(self, segment_id: SegmentId, time_ms: np.ndarray,
                  dims: Dict[str, StringDimColumn],
                  metrics: Dict[str, NumericColumn],
-                 sorted_by_time: bool = True):
+                 sorted_by_time: bool = True,
+                 time_ordered: Optional[bool] = None):
+        """sorted_by_time=False re-sorts rows by timestamp. sorted_by_time=True
+        means "do not re-sort"; pass time_ordered=False alongside it when the
+        preserved layout is NOT time-monotonic (e.g. dimension-sorted rollup
+        order) so time-pruning optimizations cannot assume monotonicity."""
         self.id = segment_id
         self.time_ms = np.asarray(time_ms, dtype=np.int64)
         self.dims = dims
@@ -184,6 +189,9 @@ class Segment:
                 d.ids = d.ids[order]
             for m in metrics.values():
                 m.values = m.values[order]
+            time_ordered = True
+        #: rows are time-monotonic (safe for searchsorted-style pruning)
+        self.time_ordered = True if time_ordered is None else bool(time_ordered)
         self.min_time = int(self.time_ms.min()) if self.n_rows else 0
         self.max_time = int(self.time_ms.max()) if self.n_rows else 0
         self._device_cache: Dict[Tuple, DeviceBlock] = {}
@@ -220,21 +228,28 @@ class Segment:
     # ---- device staging ------------------------------------------------
     def device_block(self, columns: Optional[Sequence[str]] = None,
                      row_align: int = DEFAULT_ROW_ALIGN,
-                     device=None) -> DeviceBlock:
+                     device=None, perm: Optional[np.ndarray] = None,
+                     perm_key=None) -> DeviceBlock:
         """Stage (a subset of) columns to device, padded to static shape.
 
-        Staging is cached per (columns, row_align, device); repeated queries
-        over the same segment hit HBM-resident arrays — the analog of the
-        reference keeping segments mmapped and page-cached
+        Staging is cached per (columns, row_align, device, perm_key); repeated
+        queries over the same segment hit HBM-resident arrays — the analog of
+        the reference keeping segments mmapped and page-cached
         (server/.../SegmentLoaderLocalCacheManager.java).
+
+        `perm` applies a row permutation host-side before staging (the sorted
+        projection path); callers must pass a stable hashable `perm_key`
+        identifying it so the cache can distinguish layouts.
         """
         import jax
         import jax.numpy as jnp
 
+        if perm is not None and perm_key is None:
+            raise ValueError("device_block(perm=...) requires perm_key")
         if columns is None:
             columns = list(self.dims.keys()) + list(self.metrics.keys())
         key = (tuple(sorted(set(columns))), row_align,
-               getattr(device, "id", None))
+               getattr(device, "id", None), perm_key)
         with self._lock:
             cached = self._device_cache.get(key)
         if cached is not None:
@@ -249,6 +264,8 @@ class Segment:
         arrays: Dict[str, object] = {}
 
         def _pad(a: np.ndarray, fill=0):
+            if perm is not None:
+                a = a[perm]
             out = np.full((pad_n,) + a.shape[1:], fill, dtype=a.dtype)
             out[: a.shape[0]] = a
             return out
@@ -293,6 +310,17 @@ class Segment:
                 return (0, 0)
             return (v.min().item(), v.max().item())
         return self.aux_cached(("minmax", name), _compute)
+
+    def column_finite(self, name: str) -> bool:
+        """Cached: True when a float column contains no NaN/Inf. Gates the
+        one-hot-matmul float path, where a single non-finite value would
+        poison every group (NaN·0 = NaN in the one-hot contraction)."""
+        def _compute():
+            m = self.metrics.get(name)
+            if m is None or not np.issubdtype(m.values.dtype, np.floating):
+                return True
+            return bool(np.isfinite(m.values).all())
+        return self.aux_cached(("finite", name), _compute)
 
     def staged_dtype(self, name: str):
         """Device dtype a column stages as. LONG columns whose values fit

@@ -107,6 +107,92 @@ class SegmentPartial:
 # Plan construction helpers
 # ---------------------------------------------------------------------------
 
+def _fused_raw_keys(segment: Segment, bucket_mode: str, bucket_starts,
+                    period: int, B: int, host_bucket,
+                    dims: Tuple[KeyDim, ...]) -> np.ndarray:
+    """Host: int64 fused (bucket, dim ids) key per row; -1 = invalid row
+    (out of bucket range or remapped-away dim value)."""
+    if bucket_mode == "all":
+        b = np.zeros(segment.n_rows, dtype=np.int64)
+    elif bucket_mode == "uniform":
+        b = (segment.time_ms - int(bucket_starts[0])) // period
+        b = np.where((b < 0) | (b >= B), -1, b)
+    else:
+        b = host_bucket.astype(np.int64)
+    key = b
+    valid = b >= 0
+    for d in dims:
+        if d.column is None:
+            continue
+        ids = segment.dims[d.column].ids
+        if d.remap is not None:
+            ids = d.remap[ids]
+            valid &= ids >= 0
+        key = key * d.cardinality + ids
+    return np.where(valid, key, -1)
+
+
+@dataclass
+class Projection:
+    """A sorted, key-compacted view of one segment for one key structure —
+    the query-time analog of the reference's rollup sort order + dictionary
+    (IndexMergerV9 row ordering; Druid 31 'projections'). Built once per
+    (segment, granularity, intervals, dims) and cached on the segment; the
+    row permutation clusters equal group keys so big-G aggregations reduce
+    over a small local window instead of scattering across the full grid."""
+    order: np.ndarray       # int32 [n] row permutation (invalid rows first)
+    keys: np.ndarray        # int32 [n] sorted compact ids (-1 = invalid)
+    unique: np.ndarray      # int64 [G] raw fused key per compact id
+    max_span: int           # max key span over WINDOW_BLOCK-row blocks
+
+
+def build_projection(segment: Segment, intervals: Sequence[Interval],
+                     granularity: Granularity,
+                     spec: "GroupSpec") -> Projection:
+    cache_key = ("projection", str(granularity),
+                 tuple((iv.start, iv.end) for iv in intervals),
+                 tuple((d.column, d.cardinality,
+                        None if d.remap is None else d.remap.tobytes())
+                       for d in spec.dims))
+
+    def _compute():
+        raw = _fused_raw_keys(segment, spec.bucket_mode, spec.bucket_starts,
+                              spec.uniform_period, spec.num_buckets,
+                              spec.host_bucket_ids, spec.dims)
+        n = raw.shape[0]
+        order = np.argsort(raw, kind="stable")
+        sr = raw[order]
+        n_invalid = int(np.searchsorted(sr, 0))  # -1 rows sort first
+        valid_sorted = sr[n_invalid:]
+        keys = np.full(n, -1, dtype=np.int32)
+        if valid_sorted.size:
+            newgrp = np.empty(valid_sorted.shape, dtype=bool)
+            newgrp[0] = True
+            np.not_equal(valid_sorted[1:], valid_sorted[:-1], out=newgrp[1:])
+            unique = valid_sorted[newgrp]
+            keys[n_invalid:] = np.cumsum(newgrp) - 1
+        else:
+            unique = np.zeros(0, dtype=np.int64)
+        # max masked key span over WINDOW_BLOCK-row blocks (the sorted layout
+        # keeps this near the per-block distinct-group count)
+        blk = WINDOW_BLOCK
+        npad = ((n + blk - 1) // blk) * blk if n else blk
+        kp = np.full(npad, np.iinfo(np.int32).max, dtype=np.int64)
+        kp[:n] = np.where(keys >= 0, keys.astype(np.int64),
+                          np.iinfo(np.int32).max)
+        kb = kp.reshape(-1, blk)
+        lo = kb.min(axis=1)
+        kneg = np.where(kp == np.iinfo(np.int32).max,
+                        np.iinfo(np.int64).min, kp).reshape(-1, blk)
+        hi = kneg.max(axis=1)
+        span = np.maximum(hi - lo + 1, 1)
+        span = int(span[hi >= 0].max()) if (hi >= 0).any() else 1
+        return Projection(order=order.astype(np.int32), keys=keys,
+                          unique=unique, max_span=span)
+
+    return segment.aux_cached(cache_key, _compute)
+
+
 def make_group_spec(segment: Segment, intervals: Sequence[Interval],
                     granularity: Granularity,
                     dims: Sequence[KeyDim]) -> GroupSpec:
@@ -166,24 +252,8 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
                        for d in dims))
 
     def _compute_keys():
-        if bucket_mode == "all":
-            b = np.zeros(segment.n_rows, dtype=np.int64)
-        elif bucket_mode == "uniform":
-            b = (segment.time_ms - int(bucket_starts[0])) // period
-            b = np.where((b < 0) | (b >= B), -1, b)
-        else:
-            b = host_bucket.astype(np.int64)
-        key = b
-        valid = b >= 0
-        for d in dims:
-            if d.column is None:
-                continue
-            ids = segment.dims[d.column].ids
-            if d.remap is not None:
-                ids = d.remap[ids]
-            valid &= ids >= 0
-            key = key * d.cardinality + ids
-        key = np.where(valid, key, -1)
+        key = _fused_raw_keys(segment, bucket_mode, bucket_starts, period, B,
+                              host_bucket, dims)
         uniq, compact = np.unique(key, return_inverse=True)
         # drop the -1 group if present by remapping it to an unused slot
         if len(uniq) and uniq[0] == -1:
@@ -259,7 +329,20 @@ def fuse_filter_update(arrays: Dict, mask, key, it,
         from druid_tpu.engine.mmagg import mm_reduce
         col_dtypes = {c: a.dtype for c, a in arrays.items()}
         plans = [k.mm_plan(col_dtypes, mask.shape[0]) for k in kernels]
+        # select_strategy validated eligibility against plan-time dtypes; a
+        # divergence here (row padding, virtual-column dtype) must fail
+        # loudly at plan time, not as an opaque trace error
+        missing = [k.signature() for k, p in zip(kernels, plans) if p is None]
+        if missing:
+            raise AssertionError(
+                f"mm strategy selected but kernels have no mm plan at trace "
+                f"time: {missing}")
         return mm_reduce(arrays, mask, key, kernels, plans, num_total)
+
+    if strategy == "pallas":
+        from druid_tpu.engine import pallas_agg
+        return pallas_agg.pallas_reduce(arrays, mask, key, kernels,
+                                        num_total, window)
 
     if strategy == "windowed":
         return _windowed_reduce(arrays, mask, key, kernels, num_total, window)
@@ -451,6 +534,29 @@ def select_strategy(spec: GroupSpec, kernels: Sequence[AggKernel],
         return "blocked", 0
     if mm_ok and num <= MM_GROUP_LIMIT:
         return "mm", 0
+    if blocked_ok and num > MM_GROUP_LIMIT \
+            and padded_rows >= PROJECTION_MIN_ROWS:
+        # big group space over a big segment: build/reuse the sorted
+        # key-compacted projection and reduce over a local window (pallas on
+        # TPU, the XLA windowed path elsewhere) instead of scattering
+        return "projection", 0
+    return "mixed", 0
+
+
+PROJECTION_MIN_ROWS = 1 << 20   # below this the one-time sort outweighs wins
+
+
+def _projection_strategy(proj: Projection, kernels: Sequence[AggKernel],
+                         col_dtypes: Dict, num_total: int) -> Tuple[str, int]:
+    """Inner reduction over the sorted compacted layout: the fused pallas
+    kernel on TPU, the XLA windowed path elsewhere, scatter as last resort."""
+    from druid_tpu.engine import pallas_agg
+    span = proj.max_span
+    if pallas_agg.usable(kernels, col_dtypes, span):
+        return "pallas", span
+    for w in WINDOW_CHOICES:
+        if span <= w:
+            return "windowed", w
     return "mixed", 0
 
 
@@ -650,30 +756,66 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
             kernels=kernels)
 
     vc_names = {v.name for v in virtual_columns}
-    needed = set(extra_columns)
+    base_needed = set(extra_columns)
+    if flt is not None:
+        base_needed |= flt.required_columns()
+    for a in aggs:
+        base_needed |= a.required_columns()
+    for v in virtual_columns:
+        base_needed |= parse_expression(v.expression).required_columns()
+    base_needed -= vc_names
+    base_needed = {c for c in base_needed
+                   if c in segment.dims or c in segment.metrics}
+    needed = set(base_needed)
     for d in spec.dims:
         if spec.key_mode == "dense" and d.column is not None:
             needed.add(d.column)
-    if flt is not None:
-        needed |= flt.required_columns()
-    for a in aggs:
-        needed |= a.required_columns()
-    for v in virtual_columns:
-        needed |= parse_expression(v.expression).required_columns()
-    needed -= vc_names
-    needed = {c for c in needed if c in segment.dims or c in segment.metrics}
-    block = segment.device_block(sorted(needed))
+
+    # strategy BEFORE staging: the projection path stages a permuted layout,
+    # so dtypes come from staged_dtype, not from a staged block
+    from druid_tpu.data.segment import DEFAULT_ROW_ALIGN
+    padded_rows = max(DEFAULT_ROW_ALIGN,
+                      -(-segment.n_rows // DEFAULT_ROW_ALIGN)
+                      * DEFAULT_ROW_ALIGN)
+    col_dtypes = {"__time_offset": np.dtype(np.int32),
+                  "__valid": np.dtype(bool)}
+    for c in needed:
+        col_dtypes[c] = np.dtype(np.int32) if c in segment.dims \
+            else np.dtype(segment.staged_dtype(c))
+    if spec.key_mode == "host":
+        col_dtypes["__key"] = np.dtype(np.int32)
+    elif spec.bucket_mode == "host":
+        col_dtypes["__bucket"] = np.dtype(np.int32)
+    spec.strategy, spec.window = select_strategy(
+        spec, kernels, col_dtypes, padded_rows,
+        lambda: windowed_window(segment, intervals, granularity, spec))
+
+    perm, perm_key = None, None
+    if spec.strategy == "projection":
+        proj = build_projection(segment, intervals, granularity, spec)
+        spec.key_mode = "host"
+        spec.host_keys = proj.keys
+        spec.host_unique = proj.unique
+        spec.num_total = pad_pow2(max(len(proj.unique), 1))
+        col_dtypes.pop("__bucket", None)
+        col_dtypes["__key"] = np.dtype(np.int32)
+        spec.strategy, spec.window = _projection_strategy(
+            proj, kernels, col_dtypes, spec.num_total)
+        perm = proj.order
+        perm_key = ("projection", str(granularity),
+                    tuple((iv.start, iv.end) for iv in intervals),
+                    tuple((d.column, d.cardinality,
+                           None if d.remap is None else d.remap.tobytes())
+                          for d in spec.dims))
+        needed = base_needed  # key prefused: dim columns stay host-side
+
+    block = segment.device_block(sorted(needed), perm=perm, perm_key=perm_key)
 
     arrays = dict(block.arrays)
     if spec.key_mode == "host":
         arrays["__key"] = _pad_device(spec.host_keys, block.padded_rows, -1)
     elif spec.bucket_mode == "host":
         arrays["__bucket"] = _pad_device(spec.host_bucket_ids, block.padded_rows, -1)
-
-    col_dtypes = {c: np.dtype(str(a.dtype)) for c, a in arrays.items()}
-    spec.strategy, spec.window = select_strategy(
-        spec, kernels, col_dtypes, block.padded_rows,
-        lambda: windowed_window(segment, intervals, granularity, spec))
 
     sig = _structure_sig(spec, len(intervals), filter_node, kernels, virtual_columns)
     fn = _JIT_CACHE.get(sig)

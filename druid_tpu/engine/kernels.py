@@ -153,12 +153,23 @@ class AggKernel:
     def mm_plan(self, cols_avail: Dict, padded_rows: int) -> Optional[MMPlan]:
         return None
 
+    # ---- pallas path (sorted projections, any group-space size) ---------
+    # Descriptor consumed by engine/pallas_agg.pallas_reduce: ("count",),
+    # ("sum_i32"|"sum_f32"|"min_i32"|"max_i32"|"min_f32"|"max_f32", field,
+    # ...), ("zero",)/("empty",) for missing columns, or None (ineligible).
+
+    def pallas_op(self, cols_avail: Dict) -> Optional[tuple]:
+        return None
+
 
 class CountKernel(AggKernel):
     reduce_kind = "sum"
 
     def signature(self):
         return "count"
+
+    def pallas_op(self, cols_avail):
+        return ("count",)
 
     def update(self, cols, mask, keys, num, aux):
         import jax.numpy as jnp
@@ -215,6 +226,14 @@ class SumKernel(AggKernel):
         # the column min when negative. Eligible when ≤4 limbs cover the range.
         self.mm_limbs = 0
         self.mm_base = 0
+        # FLOAT mm eligibility: a non-finite row would poison ALL groups
+        # through the one-hot contraction (NaN·0 = NaN), so the matmul path
+        # requires a host-verified all-finite staged column. Virtual columns
+        # (not segment metrics) can produce NaN on device — ineligible.
+        self.mm_float_ok = bool(
+            vtype is ValueType.FLOAT and segment is not None
+            and spec.field in segment.metrics
+            and segment.column_finite(spec.field))
         if vtype is ValueType.LONG and segment is not None \
                 and spec.field in segment.metrics \
                 and segment.staged_dtype(spec.field) == np.int32:
@@ -231,11 +250,17 @@ class SumKernel(AggKernel):
 
     def signature(self):
         return (f"sum({self.spec.field},{self.vtype.value},{self.chunk_rows},"
-                f"mm{self.mm_limbs}:{self.mm_base})")
+                f"mm{self.mm_limbs}:{self.mm_base}:"
+                f"{int(self.mm_float_ok)})")
 
     def mm_plan(self, cols_avail, padded_rows):
         import jax.numpy as jnp
         f = self.spec.field
+        # checked before the missing-column branch so plan-time
+        # (select_strategy, staged columns only) and trace-time
+        # (fuse_filter_update, includes virtual columns) decisions agree
+        if self.vtype is ValueType.FLOAT and not self.mm_float_ok:
+            return None
         if f not in cols_avail:
             def make(cols, mask):
                 return [], []
@@ -280,6 +305,20 @@ class SumKernel(AggKernel):
                     s = s + i8[nl].astype(jnp.int64) * base
                 return s
             return MMPlan((f,), n_rows, 0, make, fin)
+        return None
+
+    def pallas_op(self, cols_avail):
+        f = self.spec.field
+        if f not in cols_avail:
+            return ("zero",)
+        dt = str(cols_avail[f])
+        if self.vtype is ValueType.FLOAT and dt == "float32":
+            return ("sum_f32", f)
+        # exact int64 via in-kernel lo/hi limbs; chunk_rows ≥ 2048 bounds the
+        # per-block partial exactly like the blocked path
+        if self.vtype is ValueType.LONG and dt == "int32" \
+                and self.chunk_rows >= 2048:
+            return ("sum_i32", f, self.chunk_rows)
         return None
 
     def update(self, cols, mask, keys, num, aux):
@@ -375,6 +414,17 @@ class MinMaxKernel(AggKernel):
         if self.vtype == ValueType.LONG:
             return INT64_MIN if self.is_max else INT64_MAX
         return np.float64(-np.inf) if self.is_max else np.float64(np.inf)
+
+    def pallas_op(self, cols_avail):
+        f = self.spec.field
+        if f not in cols_avail:
+            return ("empty",)
+        dt = str(cols_avail[f])
+        if dt == "int32":
+            return ("max_i32" if self.is_max else "min_i32", f)
+        if dt == "float32":
+            return ("max_f32" if self.is_max else "min_f32", f)
+        return None
 
     def update(self, cols, mask, keys, num, aux):
         import jax.numpy as jnp
@@ -750,9 +800,9 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
     if isinstance(spec, A.LongSumAggregator):
         return SumKernel(spec, ValueType.LONG, segment)
     if isinstance(spec, A.DoubleSumAggregator):
-        return SumKernel(spec, ValueType.DOUBLE)
+        return SumKernel(spec, ValueType.DOUBLE, segment)
     if isinstance(spec, A.FloatSumAggregator):
-        return SumKernel(spec, ValueType.FLOAT)
+        return SumKernel(spec, ValueType.FLOAT, segment)
     if isinstance(spec, A.LongMinAggregator):
         return MinMaxKernel(spec, ValueType.LONG, False, segment)
     if isinstance(spec, A.LongMaxAggregator):

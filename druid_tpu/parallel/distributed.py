@@ -25,10 +25,11 @@ import numpy as np
 
 from druid_tpu.data.segment import Segment
 from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
+from druid_tpu.engine import grouping
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
                                        eval_virtual_columns,
                                        fuse_filter_update, make_group_spec,
-                                       select_strategy, windowed_window)
+                                       windowed_window)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
@@ -177,8 +178,14 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             w_all = max(w_all, w)
         return w_all
 
-    spec0.strategy, spec0.window = select_strategy(
+    # via the module so tests forcing a strategy (monkeypatching
+    # grouping.select_strategy) also steer the sharded path
+    spec0.strategy, spec0.window = grouping.select_strategy(
         spec0, kernels, col_dtypes, R, _windowed_all)
+    if spec0.strategy == "projection":
+        # sorted projections are per-segment layouts; the stacked sharded
+        # program cannot share one — run the per-segment path instead
+        return None
 
     # per-segment RELATIVE interval bounds + bucket start offsets: the
     # device program stays in int32 offset space (64-bit elementwise time

@@ -39,7 +39,8 @@ AGGS = [CountAggregator("rows"),
 MM_AGGS = AGGS[:3]   # sum-decomposable only
 
 
-def _run(segments, aggs, dims, flt=None, force=None, monkeypatch=None):
+def _run(segments, aggs, dims, flt=None, force=None, monkeypatch=None,
+         mesh=None):
     if force is not None:
         orig = grouping.select_strategy
 
@@ -54,7 +55,7 @@ def _run(segments, aggs, dims, flt=None, force=None, monkeypatch=None):
         q = GroupByQuery.of(
             "bench", [INTERVAL], [DefaultDimensionSpec(d) for d in dims],
             aggs, granularity="all", filter=flt)
-        ex = QueryExecutor(segments)
+        ex = QueryExecutor(segments, mesh=mesh)
         rows = ex.run(q)
     finally:
         if force is not None:
@@ -130,6 +131,66 @@ def test_windowed_eligible_on_sorted():
     w = grouping.windowed_window(segments[0], [INTERVAL],
                                  Granularity.of("all"), spec)
     assert w in grouping.WINDOW_CHOICES
+
+
+def test_mm_float_nan_confined_to_its_group():
+    """A single NaN float row must only NaN its OWN group (reference
+    FloatSumAggregator semantics) — the mm one-hot contraction would spread
+    it to every group, so non-finite columns must be mm-ineligible."""
+    segments = _gen(sort_by_dims=False, card_b=40)
+    s0 = segments[0]
+    vals = s0.metrics["metFloat"].values
+    poison_row = 7
+    vals[poison_row] = np.nan
+    poison_group = None
+    col = s0.dims["dimB"]
+    poison_group = col.dictionary.values[col.ids[poison_row]]
+
+    got = _run(segments, MM_AGGS, ["dimB"])
+    assert np.isnan(got[(poison_group,)]["fsum"])
+    for k, v in got.items():
+        if k != (poison_group,):
+            assert np.isfinite(v["fsum"]), k
+
+
+def test_mm_float_nan_column_not_mm(monkeypatch):
+    segments = _gen(sort_by_dims=False, card_b=40)
+    segments[0].metrics["metFloat"].values[3] = np.inf
+    seen = []
+    orig = grouping.select_strategy
+
+    def spy(spec, kernels, col_dtypes, padded_rows, windowed_w):
+        s, w = orig(spec, kernels, col_dtypes, padded_rows, windowed_w)
+        seen.append(s)
+        return s, w
+    monkeypatch.setattr(grouping, "select_strategy", spy)
+    _run(segments, MM_AGGS, ["dimB"])
+    assert seen and all(s != "mm" for s in seen)
+
+
+def test_mesh_forced_mm_matches_mixed(monkeypatch):
+    from druid_tpu.parallel import make_mesh
+    # card 200 pads to 256: above the ≤64 blocked cut, inside mm range
+    segments = _gen(sort_by_dims=False, card_b=200)
+    flt = BoundFilter("metLong", lower=-100, upper=8_000, ordering="numeric")
+    mesh = make_mesh(2)
+    got = _run(segments, MM_AGGS, ["dimB"], flt, force="mm",
+               monkeypatch=monkeypatch, mesh=mesh)
+    want = _run(segments, MM_AGGS, ["dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch, mesh=mesh)
+    _compare(got, want)
+
+
+def test_mesh_forced_windowed_matches_mixed(monkeypatch):
+    from druid_tpu.parallel import make_mesh
+    segments = _gen(sort_by_dims=True)
+    flt = BoundFilter("metLong", lower=0, upper=8_500, ordering="numeric")
+    mesh = make_mesh(2)
+    got = _run(segments, AGGS, ["dimA", "dimB"], flt, force="windowed",
+               monkeypatch=monkeypatch, mesh=mesh)
+    want = _run(segments, AGGS, ["dimA", "dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch, mesh=mesh)
+    _compare(got, want)
 
 
 def test_mm_double_sum_falls_back(monkeypatch):

@@ -1,0 +1,104 @@
+"""On-chip check: projection + pallas strategy compiles and matches, + rate."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("PROF_ROWS", 12_500_000))
+
+
+def main():
+    import jax
+    print(f"devices: {jax.devices()}", flush=True)
+
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.engine import QueryExecutor, grouping
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             FloatMaxAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import BoundFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+    from druid_tpu.utils.intervals import Interval
+
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=100, distribution="uniform"),
+        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
+        ColumnSpec("metLong", "long", low=0, high=10_000),
+        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
+                   std=25.0),
+    )
+    interval = Interval.of("2026-01-01", "2026-01-02")
+    gen = DataGenerator(schema, seed=1234)
+    t0 = time.time()
+    segments = gen.segments(1, ROWS, interval, datasource="bench")
+    print(f"gen {time.time()-t0:.1f}s", flush=True)
+
+    q = GroupByQuery.of(
+        "bench", [interval],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
+         FloatMaxAggregator("fmax", "metFloat")],
+        granularity="all",
+        filter=BoundFilter("metLong", lower=100, upper=9_900,
+                           ordering="numeric"))
+
+    picks = []
+    orig = grouping.select_strategy
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        picks.append(r)
+        return r
+    grouping.select_strategy = spy
+
+    ex = QueryExecutor(segments)
+
+    # baseline: mixed (projection off)
+    grouping.PROJECTION_MIN_ROWS = 1 << 62
+    t0 = time.time()
+    base = ex.run(q)
+    print(f"mixed warm+run {time.time()-t0:.1f}s picks={picks}", flush=True)
+    picks.clear()
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        ex.run(q)
+        times.append(time.time() - t0)
+    t_mixed = min(times)
+    print(f"mixed best {t_mixed*1e3:.0f}ms -> {ROWS/t_mixed/1e6:.0f}M rows/s",
+          flush=True)
+
+    # projection + pallas
+    grouping.PROJECTION_MIN_ROWS = 1 << 20
+    t0 = time.time()
+    got = ex.run(q)
+    print(f"projection warm (sort+compile) {time.time()-t0:.1f}s "
+          f"picks={picks}", flush=True)
+    inner = grouping._projection_strategy
+    times = []
+    for _ in range(5):
+        t0 = time.time()
+        ex.run(q)
+        times.append(time.time() - t0)
+    t_proj = min(times)
+    print(f"projection best {t_proj*1e3:.0f}ms -> "
+          f"{ROWS/t_proj/1e6:.0f}M rows/s", flush=True)
+
+    def norm(rows):
+        return {(r["event"]["dimA"], r["event"]["dimB"]):
+                (r["event"]["rows"], r["event"]["lsum"],
+                 round(r["event"]["fmax"], 2)) for r in rows}
+    a, b = norm(base), norm(got)
+    diffs = [(k, a[k], b[k]) for k in a if a[k] != b.get(k)]
+    print(f"nkeys {len(a)} vs {len(b)}; ndiffs {len(diffs)}", flush=True)
+    for d in diffs[:5]:
+        print(" ", d)
+    assert not diffs and len(a) == len(b), "MISMATCH"
+    print("MATCH", flush=True)
+
+
+if __name__ == "__main__":
+    main()

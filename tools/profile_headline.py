@@ -1,0 +1,143 @@
+"""Profile the headline bench queries: where do the milliseconds go?
+
+Breaks the groupBy/topN execution into phases (device program, host merge,
+finish) at bench-identical per-segment scale. Run on the real chip.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROWS = int(os.environ.get("PROF_ROWS", 25_000_000))
+NSEG = int(os.environ.get("PROF_SEGMENTS", 2))
+
+
+def log(msg):
+    print(msg, flush=True)
+
+
+def timeit(label, fn, iters=3):
+    fn()  # warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    n = ROWS
+    log(f"{label:48s} {best*1e3:9.1f} ms   {n/best/1e6:8.0f} M rows/s")
+    return best
+
+
+def main():
+    import jax
+    log(f"devices: {jax.devices()}")
+
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.engine import engines
+    from druid_tpu.engine.grouping import run_grouped_aggregate
+    from druid_tpu.parallel import make_mesh
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             FloatMaxAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import BoundFilter, InFilter
+    from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                       TopNQuery)
+    from druid_tpu.utils.intervals import Interval
+
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=100, distribution="uniform"),
+        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
+        ColumnSpec("metLong", "long", low=0, high=10_000),
+        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
+                   std=25.0),
+    )
+    interval = Interval.of("2026-01-01", "2026-01-02")
+    t0 = time.time()
+    gen = DataGenerator(schema, seed=1234)
+    segments = gen.segments(NSEG, ROWS // NSEG, interval, datasource="bench")
+    log(f"generated {sum(s.n_rows for s in segments):,} rows "
+        f"({time.time()-t0:.1f}s)")
+
+    groupby = GroupByQuery.of(
+        "bench", [interval],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
+         FloatMaxAggregator("fmax", "metFloat")],
+        granularity="all",
+        filter=BoundFilter("metLong", lower=100, upper=9_900,
+                           ordering="numeric"))
+    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
+    topn = TopNQuery.of(
+        "bench", [interval], "dimB", "lsum", 100,
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
+        granularity="all",
+        filter=InFilter("dimA", dimA_vals[0:100:2]))
+
+    ex_mesh = QueryExecutor(segments, mesh=make_mesh(1))
+    ex_nomesh = QueryExecutor(segments, mesh=None)
+
+    # strategy report
+    from druid_tpu.engine import grouping
+    orig = grouping.select_strategy
+    picks = []
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        picks.append(r)
+        return r
+    grouping.select_strategy = spy
+    import druid_tpu.parallel.distributed as dist
+    dist.select_strategy = spy
+    ex_mesh.run(groupby)
+    log(f"groupBy strategy picks (mesh): {picks}")
+    picks.clear()
+    ex_nomesh.run(groupby)
+    log(f"groupBy strategy picks (no mesh): {picks}")
+    picks.clear()
+    ex_mesh.run(topn)
+    log(f"topN strategy picks (mesh): {picks}")
+    picks.clear()
+    grouping.select_strategy = orig
+    dist.select_strategy = orig
+
+    timeit("groupBy full (mesh)", lambda: ex_mesh.run(groupby))
+    timeit("groupBy full (no mesh)", lambda: ex_nomesh.run(groupby))
+    timeit("topN full (mesh)", lambda: ex_mesh.run(topn))
+    timeit("topN full (no mesh)", lambda: ex_nomesh.run(topn))
+
+    # phase split: partials vs finish (no-mesh path)
+    ap_holder = {}
+
+    def partials_only(q):
+        ap_holder["ap"] = engines.make_aggregate_partials(q, segments)
+
+    timeit("groupBy partials only (no mesh)",
+           lambda: partials_only(groupby))
+    ap = ap_holder["ap"]
+    timeit("groupBy finish only",
+           lambda: engines.finish_groupby(groupby, ap))
+    timeit("topN partials only (no mesh)", lambda: partials_only(topn))
+    ap = ap_holder["ap"]
+    timeit("topN finish only", lambda: engines.finish_topn(topn, ap))
+
+    # single-segment device program, full pipeline vs raw
+    s0 = segments[0]
+    ivs = [interval]
+
+    def one_seg_gb():
+        run_grouped_aggregate(
+            s0, ivs, groupby.granularity,
+            [grouping.KeyDim("dimA", 100, None),
+             grouping.KeyDim("dimB", 1000, None)],
+            groupby.aggregations, groupby.filter)
+
+    t = timeit("groupBy 1seg run_grouped_aggregate", one_seg_gb, iters=3)
+    log(f"  (per-row at 1 seg: {ROWS/NSEG/t/1e6:.0f} M rows/s)")
+
+
+if __name__ == "__main__":
+    main()
