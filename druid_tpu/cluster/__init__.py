@@ -15,6 +15,7 @@ from druid_tpu.cluster.timeline import (PartitionChunk, PartitionHolder,
                                         TimelineObjectHolder,
                                         VersionedIntervalTimeline)
 from druid_tpu.cluster.dataserver import DataNodeServer, RemoteDataNodeClient
+from druid_tpu.cluster.realtime import RealtimeServer
 from druid_tpu.cluster.view import DataNode, InventoryView, descriptor_for
 
 __all__ = [
@@ -27,5 +28,5 @@ __all__ = [
     "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",
     "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
     "IntervalDropRule", "rule_from_json", "DataNodeServer",
-    "RemoteDataNodeClient",
+    "RemoteDataNodeClient", "RealtimeServer",
 ]

@@ -152,7 +152,8 @@ class Broker:
                        segments: List[SegmentDescriptor]):
         use_rcache = (self.cache is not None
                       and self.cache_config.cacheable(query)
-                      and self.cache_config.use_result_cache)
+                      and self.cache_config.use_result_cache
+                      and self._all_replicatable(segments))
         rkey = None
         if use_rcache:
             rkey = result_level_key(
@@ -192,6 +193,23 @@ class Broker:
         if use_rcache and self.cache_config.populate_result_cache:
             self.cache.put("result", rkey, rows)
         return rows
+
+    def _all_replicatable(self, segments: List[SegmentDescriptor]) -> bool:
+        """True when no queried segment is served by a realtime server.
+        A sink's rows grow between queries under a STABLE segment id, so a
+        result cached while any replica is realtime would be served stale
+        forever (the reference's CachingClusteredClient caches only
+        segment-replicatable servers)."""
+        for d in segments:
+            rs = self.view.replica_set(d.id)
+            if rs is None:
+                continue
+            for server in rs.servers:
+                node = self.view.node(server)
+                if node is not None and \
+                        not getattr(node, "segment_replicatable", True):
+                    return False
+        return True
 
     # ---- row path -------------------------------------------------------
     def _run_rows(self, query: Query, segments: List[SegmentDescriptor]):

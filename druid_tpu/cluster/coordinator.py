@@ -237,9 +237,14 @@ class Coordinator:
         return [rule_from_json(j) for j in payload]
 
     def _nodes_by_tier(self) -> Dict[str, List[DataNode]]:
+        # only segment-replicatable (historical) servers participate in
+        # rule-driven load/drop/balancing — realtime servers announce their
+        # own in-flight sinks and manage their own lifecycle (the reference's
+        # DruidCluster keeps realtime servers out of coordinator duties)
         tiers: Dict[str, List[DataNode]] = {}
         for n in self.view.nodes():
-            tiers.setdefault(n.tier, []).append(n)
+            if getattr(n, "segment_replicatable", True):
+                tiers.setdefault(n.tier, []).append(n)
         return tiers
 
     def _run_rules(self, used: List[SegmentDescriptor], now_ms: int,
@@ -257,11 +262,15 @@ class Coordinator:
                     self._rules_for(d.datasource)
             rule = next((r for r in rules if r.applies(d, now_ms)), None)
             if rule is None or not rule.is_load():
-                # drop from every server holding it
+                # drop from every HISTORICAL server holding it; a realtime
+                # server's sink announcement is its own to retract (handoff)
                 rs = self.view.replica_set(d.id)
                 if rs is not None:
                     for server in sorted(rs.servers):
                         node = self.view.node(server)
+                        if node is not None and \
+                                not getattr(node, "segment_replicatable", True):
+                            continue
                         if node is not None:
                             node.drop_segment(d.id)
                         self.view.unannounce(server, d.id)

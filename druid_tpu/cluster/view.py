@@ -56,6 +56,10 @@ def _is_aggregate(query: Query) -> bool:
 class DataNode:
     """One data server: loaded segments + the per-node query engine."""
 
+    #: results from this server may be cached and the coordinator may manage
+    #: its segments (False on realtime servers whose sinks mutate in place)
+    segment_replicatable = True
+
     def __init__(self, name: str, tier: str = "_default_tier",
                  max_segments: Optional[int] = None,
                  cache: Optional[LruCache] = None,

@@ -20,6 +20,7 @@ TPU-first design, replacing the per-row Cursor pull model:
 """
 from __future__ import annotations
 
+import collections
 import enum
 import threading
 from dataclasses import dataclass, field
@@ -35,6 +36,8 @@ from druid_tpu.utils.intervals import Interval
 # f32 min tile is (8, 128); pad row counts to a multiple of 8*128 so 1-D
 # columns reshape cleanly into (sublane, lane) tiles on device.
 DEFAULT_ROW_ALIGN = 1024
+#: max HBM-resident cache entries per segment (staged blocks + device aux)
+DEVICE_CACHE_CAP = 8
 
 
 class ValueType(enum.Enum):
@@ -194,7 +197,11 @@ class Segment:
         self.time_ordered = True if time_ordered is None else bool(time_ordered)
         self.min_time = int(self.time_ms.min()) if self.n_rows else 0
         self.max_time = int(self.time_ms.max()) if self.n_rows else 0
-        self._device_cache: Dict[Tuple, DeviceBlock] = {}
+        # LRU-bounded: entries pin HBM (staged blocks, padded device keys);
+        # query-dependent cache keys (interval tuples, projections) would
+        # otherwise grow without bound under e.g. sliding-window dashboards
+        self._device_cache: "collections.OrderedDict[Tuple, DeviceBlock]" = \
+            collections.OrderedDict()
         self._aux_cache: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
 
@@ -252,6 +259,8 @@ class Segment:
                getattr(device, "id", None), perm_key)
         with self._lock:
             cached = self._device_cache.get(key)
+            if cached is not None:
+                self._device_cache.move_to_end(key)
         if cached is not None:
             return cached
 
@@ -300,7 +309,26 @@ class Segment:
         )
         with self._lock:
             self._device_cache[key] = block
+            self._device_cache.move_to_end(key)
+            while len(self._device_cache) > DEVICE_CACHE_CAP:
+                self._device_cache.popitem(last=False)
         return block
+
+    def device_cached(self, key: Tuple, fn):
+        """Memoize a derived DEVICE array through the same bounded LRU as
+        staged blocks (HBM entries must not accumulate per query shape)."""
+        key = ("aux",) + key
+        with self._lock:
+            if key in self._device_cache:
+                self._device_cache.move_to_end(key)
+                return self._device_cache[key]
+        value = fn()
+        with self._lock:
+            self._device_cache[key] = value
+            self._device_cache.move_to_end(key)
+            while len(self._device_cache) > DEVICE_CACHE_CAP:
+                self._device_cache.popitem(last=False)
+        return value
 
     def column_minmax(self, name: str) -> Tuple[int, int]:
         """Cached (min, max) of a numeric column (0, 0 when empty)."""

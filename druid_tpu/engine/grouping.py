@@ -38,6 +38,7 @@ measured rates on a v5e chip at 12.5M rows):
 """
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -87,6 +88,11 @@ class GroupSpec:
     num_total: int = 1                 # padded dense key-space size
     strategy: str = "mixed"            # reduction strategy (select_strategy)
     window: int = 0                    # local window W for "windowed"
+    # stable cache identities for host_keys / host_bucket_ids so their padded
+    # device copies persist in the segment cache across query executions
+    # (re-device_put of a 100M-row key column costs ~400MB of H2D per query)
+    host_keys_cache: Optional[Tuple] = None
+    host_bucket_cache: Optional[Tuple] = None
 
     @property
     def num_buckets(self) -> int:
@@ -208,6 +214,7 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
                          if bucket_starts_list else np.zeros(0, dtype=np.int64))
     B = max(int(len(bucket_starts)), 1)
 
+    host_bucket_cache = None
     if granularity.is_all:
         bucket_mode, period, first_off, host_bucket = "all", 0, 0, None
     elif (granularity.is_uniform and len(intervals) == 1):
@@ -231,6 +238,7 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
                 offset += len(starts)
             return out
         host_bucket = segment.aux_cached(key, _compute)
+        host_bucket_cache = key
 
     dims = tuple(dims)
     group_card = 1
@@ -242,7 +250,8 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
         return GroupSpec(bucket_starts=bucket_starts, bucket_mode=bucket_mode,
                          uniform_period=period, uniform_first_offset=first_off,
                          host_bucket_ids=host_bucket, key_mode="dense",
-                         dims=dims, num_total=pad_pow2(dense_total))
+                         dims=dims, num_total=pad_pow2(dense_total),
+                         host_bucket_cache=host_bucket_cache)
 
     # host-compacted key path: fuse (bucket, dim ids) host-side and np.unique
     cache_key = ("fused_keys", str(granularity),
@@ -266,7 +275,9 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
                      uniform_period=period, uniform_first_offset=first_off,
                      host_bucket_ids=host_bucket, key_mode="host", dims=dims,
                      host_keys=compact, host_unique=uniq,
-                     num_total=pad_pow2(max(len(uniq), 1)))
+                     num_total=pad_pow2(max(len(uniq), 1)),
+                     host_keys_cache=cache_key,
+                     host_bucket_cache=host_bucket_cache)
 
 
 # ---------------------------------------------------------------------------
@@ -807,25 +818,50 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                     tuple((d.column, d.cardinality,
                            None if d.remap is None else d.remap.tobytes())
                           for d in spec.dims))
+        spec.host_keys_cache = perm_key
         needed = base_needed  # key prefused: dim columns stay host-side
 
     block = segment.device_block(sorted(needed), perm=perm, perm_key=perm_key)
 
     arrays = dict(block.arrays)
     if spec.key_mode == "host":
-        arrays["__key"] = _pad_device(spec.host_keys, block.padded_rows, -1)
+        arrays["__key"] = _pad_device_cached(
+            segment, spec.host_keys_cache, spec.host_keys,
+            block.padded_rows, -1)
     elif spec.bucket_mode == "host":
-        arrays["__bucket"] = _pad_device(spec.host_bucket_ids, block.padded_rows, -1)
+        arrays["__bucket"] = _pad_device_cached(
+            segment, spec.host_bucket_cache, spec.host_bucket_ids,
+            block.padded_rows, -1)
 
-    sig = _structure_sig(spec, len(intervals), filter_node, kernels, virtual_columns)
-    fn = _JIT_CACHE.get(sig)
-    if fn is None:
-        fn = _build_device_fn(spec, len(intervals), filter_node, kernels,
-                              virtual_columns)
-        _JIT_CACHE[sig] = fn
     aux = _assemble_aux(spec, segment, intervals, filter_node, kernels,
                         virtual_columns)
-    counts, states = fn(arrays, aux)
+    while True:
+        sig = _structure_sig(spec, len(intervals), filter_node, kernels,
+                             virtual_columns)
+        fn = _JIT_CACHE.get(sig)
+        if fn is None:
+            fn = _build_device_fn(spec, len(intervals), filter_node, kernels,
+                                  virtual_columns)
+            _JIT_CACHE[sig] = fn
+        try:
+            counts, states = fn(arrays, aux)
+            break
+        except Exception as e:
+            if spec.strategy != "pallas":
+                raise
+            # Mosaic compile failure: latch pallas off for the process and
+            # retry on the XLA windowed/mixed path — a kernel bug must not
+            # fail user queries (reference queries never depend on which
+            # engine strategy runs)
+            from druid_tpu.engine import pallas_agg
+            pallas_agg.mark_broken(e)
+            logging.getLogger(__name__).warning(
+                "pallas groupBy kernel failed to compile; falling back to "
+                "XLA path: %s", e)
+            spec.strategy, spec.window = next(
+                (("windowed", w) for w in WINDOW_CHOICES
+                 if spec.window and spec.window <= w),
+                ("mixed", 0))
 
     host_states = {k.name: k.host_post(st, segment)
                    for k, st in zip(kernels, states)}
@@ -839,6 +875,17 @@ def _pad_device(arr: np.ndarray, padded: int, fill) -> object:
     out = np.full((padded,), fill, dtype=arr.dtype)
     out[: arr.shape[0]] = arr
     return jax.device_put(out)
+
+
+def _pad_device_cached(segment: Segment, cache_key: Optional[Tuple],
+                       arr: np.ndarray, padded: int, fill) -> object:
+    """Padded device copy of a derived host column, cached on the segment so
+    repeated queries reuse the HBM-resident array exactly like staged data
+    columns (data/segment.py device cache, LRU-bounded)."""
+    if cache_key is None:
+        return _pad_device(arr, padded, fill)
+    return segment.device_cached(("devpad", cache_key, padded, fill),
+                                 lambda: _pad_device(arr, padded, fill))
 
 
 def combine_states(kernels: List[AggKernel], a: Dict[str, object],

@@ -38,12 +38,21 @@ BLK_WIDE_W = 1024
 SPAN_BLOCK = 1024     # block size Projection.max_span is measured over
 MAX_W = 1024          # widest supported aligned window
 _FORCE_INTERPRET = False
+_BROKEN: Optional[str] = None
 
 
 def force_interpret(on: bool = True):
     """Testing hook: run the kernel through the pallas interpreter on CPU."""
     global _FORCE_INTERPRET
     _FORCE_INTERPRET = on
+
+
+def mark_broken(exc: BaseException) -> None:
+    """Latch the pallas path off for this process after a Mosaic compile
+    failure — the caller already fell back to an XLA strategy; retrying a
+    known-broken compile on every query would cost seconds each time."""
+    global _BROKEN
+    _BROKEN = repr(exc)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -53,7 +62,7 @@ def _round_up(x: int, m: int) -> int:
 def backend_ok() -> bool:
     if _FORCE_INTERPRET or os.environ.get("DRUID_TPU_PALLAS") == "interpret":
         return True
-    if os.environ.get("DRUID_TPU_PALLAS") == "0":
+    if os.environ.get("DRUID_TPU_PALLAS") == "0" or _BROKEN is not None:
         return False
     try:
         import jax
@@ -256,11 +265,16 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
 
     out_shapes = [jax.ShapeDtypeStruct((G2 // 128, 128), dt)
                   for _, dt in out_defs]
+    # index-map constants must be typed AND built inside the lambda: under
+    # the repo-global x64 flag a Python-int 0 promotes to i64 and Mosaic
+    # fails to legalize the (i32, i64) func.return of the index map, while a
+    # closure-captured jnp scalar is rejected as a captured tracer
     grid_spec = pl.GridSpec(
         grid=(nblk,),
-        in_specs=[pl.BlockSpec((R, 128), lambda i: (i, 0),
+        in_specs=[pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)),
                                memory_space=pltpu.VMEM)] * (1 + len(uniq_fields)),
-        out_specs=[pl.BlockSpec((G2 // 128, 128), lambda i: (0, 0),
+        out_specs=[pl.BlockSpec((G2 // 128, 128),
+                                lambda i: (jnp.int32(0), jnp.int32(0)),
                                 memory_space=pltpu.VMEM)] * len(out_defs),
     )
     outs = pl.pallas_call(

@@ -161,17 +161,34 @@ class Appenderator:
         self.max_rows_per_hydrant = max_rows_per_hydrant
         self._sinks: Dict[str, Sink] = {}
         self._lock = threading.RLock()
+        # sink lifecycle listeners (cluster.realtime.RealtimeServer announces
+        # created sinks into the broker's InventoryView — the
+        # SinkQuerySegmentWalker announcement step)
+        self._listeners: List[object] = []
+
+    def add_listener(self, listener) -> None:
+        """listener gets sink_created(ident) / sink_dropped(ident)."""
+        with self._lock:
+            self._listeners.append(listener)
+            existing = [s.ident for s in self._sinks.values()]
+        for ident in existing:
+            listener.sink_created(ident)
 
     def add(self, ident: SegmentIdWithShard, batch: RowBatch) -> None:
+        created = False
         with self._lock:
             sink = self._sinks.get(ident.id)
             if sink is None:
                 sink = self._sinks[ident.id] = Sink(
                     ident, self.metric_specs, self.dimensions,
                     self.query_granularity, self.max_rows_per_hydrant)
+                created = True
             sink.add_batch(batch)
             if sink.needs_persist():
                 sink.persist_hydrant()
+            listeners = list(self._listeners) if created else ()
+        for ln in listeners:
+            ln.sink_created(ident)
 
     def persist_all(self) -> None:
         with self._lock:
@@ -194,6 +211,13 @@ class Appenderator:
             for sink in self._sinks.values():
                 out += sink.query_segments()
             return out
+
+    def sink_segments(self, segment_id: str) -> Optional[List[Segment]]:
+        """Queryable snapshots of ONE in-flight sink (hydrants + a snapshot
+        of the live index), or None if no such sink."""
+        with self._lock:
+            sink = self._sinks.get(str(segment_id))
+            return None if sink is None else sink.query_segments()
 
     # ---- push -----------------------------------------------------------
     def push(self, idents: Sequence[SegmentIdWithShard]
@@ -218,9 +242,15 @@ class Appenderator:
 
     def drop(self, idents: Sequence[SegmentIdWithShard]) -> None:
         """Handoff complete: historicals serve these now."""
+        dropped = []
         with self._lock:
             for ident in idents:
-                self._sinks.pop(ident.id, None)
+                if self._sinks.pop(ident.id, None) is not None:
+                    dropped.append(ident)
+            listeners = list(self._listeners)
+        for ident in dropped:
+            for ln in listeners:
+                ln.sink_dropped(ident)
 
 
 class StreamAppenderatorDriver:

@@ -101,7 +101,7 @@ class StreamIngestTask:
                  dimensions: Optional[Sequence[str]] = None,
                  tuning: Optional[StreamTuningConfig] = None,
                  handoff: Optional[Callable] = None,
-                 deep_storage=None):
+                 deep_storage=None, realtime=None):
         self.task_id = task_id
         self.datasource = datasource
         self.source = source
@@ -116,6 +116,10 @@ class StreamIngestTask:
             datasource, metric_specs, dimensions=dimensions,
             query_granularity=self.tuning.query_granularity,
             max_rows_per_hydrant=self.tuning.max_rows_per_hydrant)
+        if realtime is not None:
+            # announce in-flight sinks into the broker view
+            # (cluster.realtime.RealtimeServer — SinkQuerySegmentWalker)
+            realtime.attach(appender)
         allocator = SegmentAllocator(metadata,
                                      self.tuning.segment_granularity)
         self.driver = StreamAppenderatorDriver(appender, allocator, metadata,
@@ -225,7 +229,7 @@ class StreamSupervisor:
                  parser: Optional[InputRowParser] = None,
                  transform: Optional[TransformSpec] = None,
                  handoff: Optional[Callable] = None,
-                 deep_storage=None):
+                 deep_storage=None, realtime=None):
         self.spec = spec
         self.source = source
         self.metadata = metadata
@@ -233,6 +237,7 @@ class StreamSupervisor:
         self.transform = transform
         self.handoff = handoff
         self.deep_storage = deep_storage
+        self.realtime = realtime
         self.tasks: Dict[int, StreamIngestTask] = {}   # group → task
         self._task_seq = 0
         self.metadata.set_supervisor(
@@ -274,7 +279,8 @@ class StreamSupervisor:
                     list(self.spec.metric_specs), self.metadata,
                     parser=self.parser, transform=self.transform,
                     dimensions=self.spec.dimensions, tuning=self.spec.tuning,
-                    handoff=self.handoff, deep_storage=self.deep_storage)
+                    handoff=self.handoff, deep_storage=self.deep_storage,
+                    realtime=self.realtime)
                 self.tasks[group] = task
                 self.metadata.insert_task(task.task_id, self.spec.datasource,
                                           "RUNNING", {"group": group})

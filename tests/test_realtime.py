@@ -230,6 +230,87 @@ def test_stream_duplicate_publish_rejected():
     assert sum(d.num_rows for d in md.used_segments("stream_ds")) == 100
 
 
+def test_realtime_queryable_through_broker_before_publish():
+    """Druid's signature capability: rows are queryable through the NORMAL
+    broker path seconds after ingest, before any checkpoint/handoff
+    (SinkQuerySegmentWalker)."""
+    from druid_tpu.cluster import RealtimeServer
+    md = MetadataStore()
+    view = InventoryView()
+    rt = RealtimeServer("peon0", view)
+    stream = SimulatedStream(n_partitions=1)
+    recs = _records(300, seed=7)
+    stream.append(0, recs)
+    spec = StreamSupervisorSpec(
+        "stream_ds", SPECS, dimensions=["page"], task_count=1,
+        max_rows_per_task=10**9,
+        tuning=StreamTuningConfig(segment_granularity="day"))
+    sup = StreamSupervisor(spec, stream, md, realtime=rt)
+    sup.run_once()
+
+    # NO publish yet — the broker must still see the rows via the announced
+    # in-flight sink
+    assert md.datasource_metadata("stream_ds") is None
+    broker = Broker(view)
+    assert "stream_ds" in broker.datasources
+    rows = broker.run(TimeseriesQuery.of("stream_ds", [DAY], QSPECS))
+    assert rows[0]["result"]["rows"] == 300
+    assert rows[0]["result"]["v"] == sum(r["value"] for r in recs)
+
+    # more rows arrive: the SAME sink serves the larger count (no caching)
+    more = _records(100, t_start=T0 + 50_000_000, seed=8)
+    stream.append(0, more)
+    sup.run_once()
+    rows = broker.run(TimeseriesQuery.of("stream_ds", [DAY], QSPECS))
+    assert rows[0]["result"]["rows"] == 400
+
+    # row-path queries work against the sink too
+    from druid_tpu.query.model import TimeBoundaryQuery
+    tb = broker.run(TimeBoundaryQuery.of("stream_ds", [DAY]))
+    assert tb[0]["result"]["minTime"] == T0
+
+
+def test_realtime_handoff_is_seamless(monkeypatch):
+    """Publish + handoff: the historical replica joins the sink's replica
+    set under the same segment id, the sink unannounces, and the broker
+    keeps returning identical results throughout."""
+    from druid_tpu.cluster import RealtimeServer
+    md = MetadataStore()
+    view = InventoryView()
+    rt = RealtimeServer("peon0", view)
+    node = DataNode("historical0")
+    view.register(node)
+
+    def handoff(pushed):
+        for desc, seg in pushed:
+            node.load_segment(seg)
+            view.announce(node.name, desc)
+
+    stream = SimulatedStream(n_partitions=1)
+    recs = _records(250, seed=9)
+    stream.append(0, recs)
+    spec = StreamSupervisorSpec(
+        "stream_ds", SPECS, dimensions=["page"], task_count=1,
+        max_rows_per_task=10**9,
+        tuning=StreamTuningConfig(segment_granularity="day"))
+    sup = StreamSupervisor(spec, stream, md, handoff=handoff, realtime=rt)
+    sup.run_once()
+    broker = Broker(view)
+    q = TimeseriesQuery.of("stream_ds", [DAY], QSPECS)
+    before = broker.run(q)
+    assert before[0]["result"]["rows"] == 250
+
+    assert sup.checkpoint_all()
+    # sink dropped: realtime serves nothing, historical serves everything
+    assert rt.served_segment_ids() == set()
+    assert node.segment_count() == 1
+    after = broker.run(q)
+    assert after == before
+    sids = [rs for rs in [view.replica_set(str(s.id))
+                          for s in node.segments()] if rs]
+    assert all(rs.servers == {"historical0"} for rs in sids)
+
+
 def test_stream_handoff_to_cluster():
     """Published segments hand off to a data node and serve via broker."""
     md = MetadataStore()

@@ -193,6 +193,126 @@ def test_mesh_forced_windowed_matches_mixed(monkeypatch):
     _compare(got, want)
 
 
+def _spy_strategies(monkeypatch):
+    seen = []
+    orig = grouping.select_strategy
+
+    def spy(spec, kernels, col_dtypes, padded_rows, windowed_w):
+        s, w = orig(spec, kernels, col_dtypes, padded_rows, windowed_w)
+        seen.append(s)
+        return s, w
+    monkeypatch.setattr(grouping, "select_strategy", spy)
+    return seen
+
+
+def test_projection_pallas_interpret_matches_mixed(monkeypatch):
+    """The fused pallas kernel (via the interpreter on CPU) must agree with
+    the mixed path exactly — count, exact int64 sums through the lo/hi limb
+    pair, float sums, and min/max."""
+    from druid_tpu.engine import pallas_agg
+    segments = _gen(sort_by_dims=False)   # 30 x 200 = 6000 > MM_GROUP_LIMIT
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    inner = []
+    orig_inner = grouping._projection_strategy
+
+    def spy(proj, kernels, col_dtypes, num_total):
+        s, w = orig_inner(proj, kernels, col_dtypes, num_total)
+        inner.append(s)
+        return s, w
+    monkeypatch.setattr(grouping, "_projection_strategy", spy)
+    got = _run(segments, AGGS, ["dimA", "dimB"])
+    assert inner and all(s == "pallas" for s in inner)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", False)
+    want = _run(segments, AGGS, ["dimA", "dimB"], force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_projection_windowed_matches_mixed(monkeypatch):
+    """With pallas gated off, the projection strategy reduces through the XLA
+    windowed path over the sorted layout; results must match mixed."""
+    monkeypatch.setenv("DRUID_TPU_PALLAS", "0")
+    segments = _gen(sort_by_dims=False)
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
+    inner = []
+    orig_inner = grouping._projection_strategy
+
+    def spy(proj, kernels, col_dtypes, num_total):
+        s, w = orig_inner(proj, kernels, col_dtypes, num_total)
+        inner.append(s)
+        return s, w
+    monkeypatch.setattr(grouping, "_projection_strategy", spy)
+    flt = BoundFilter("metLong", lower=0, upper=8_500, ordering="numeric")
+    got = _run(segments, AGGS, ["dimA", "dimB"], flt)
+    assert inner and all(s == "windowed" for s in inner)
+    want = _run(segments, AGGS, ["dimA", "dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_pallas_limb_sum_exact_across_flushes(monkeypatch):
+    """int32 long sums ride a lo/hi limb pair flushed every K blocks; with
+    values near the chunk_rows bound and >> chunk_rows rows per group the
+    total exceeds int32 and must still be bit-exact int64."""
+    from druid_tpu.engine import pallas_agg
+    segments = _gen(sort_by_dims=False, card_a=2, card_b=3, n=40_000,
+                    lo=200_000, hi=260_000)
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    orig = grouping.select_strategy
+
+    def force_proj(spec, kernels, col_dtypes, padded_rows, windowed_w):
+        return "projection", 0
+    monkeypatch.setattr(grouping, "select_strategy", force_proj)
+    aggs = [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")]
+    got = _run(segments, aggs, ["dimA", "dimB"])
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", False)
+    monkeypatch.setattr(grouping, "select_strategy", orig)
+    want = _run(segments, aggs, ["dimA", "dimB"], force="mixed",
+                monkeypatch=monkeypatch)
+    # per-group totals ~ 40000/6 * 230000 ≈ 1.5e9, sums overflow across limbs
+    assert any(v["lsum"] > 2**30 for v in want.values())
+    _compare(got, want)
+
+
+def test_pallas_fully_masked_blocks(monkeypatch):
+    """A selective filter leaves whole sorted blocks masked; those blocks
+    must contribute nothing (their keys read as the sentinel)."""
+    from druid_tpu.engine import pallas_agg
+    from druid_tpu.query.filters import SelectorFilter
+    segments = _gen(sort_by_dims=False)
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    flt = SelectorFilter("dimA", "v00000003")
+    got = _run(segments, AGGS, ["dimA", "dimB"], flt)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", False)
+    want = _run(segments, AGGS, ["dimA", "dimB"], flt, force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
+def test_pallas_compile_failure_falls_back(monkeypatch):
+    """A Mosaic compile failure must not fail the query: the executor latches
+    pallas off and re-runs the same plan on the XLA windowed/mixed path."""
+    from druid_tpu.engine import pallas_agg
+    segments = _gen(sort_by_dims=False)
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    monkeypatch.setattr(pallas_agg, "_BROKEN", None)
+    monkeypatch.setattr(grouping, "_JIT_CACHE", {})
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic failed to compile TPU kernel")
+    monkeypatch.setattr(pallas_agg, "pallas_reduce", boom)
+    got = _run(segments, AGGS, ["dimA", "dimB"])
+    assert pallas_agg._BROKEN is not None
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", False)
+    want = _run(segments, AGGS, ["dimA", "dimB"], force="mixed",
+                monkeypatch=monkeypatch)
+    _compare(got, want)
+
+
 def test_mm_double_sum_falls_back(monkeypatch):
     # doubleSum has no mm decomposition → strategy must not be "mm"
     segments = _gen(sort_by_dims=False, card_b=40)
