@@ -34,7 +34,8 @@ from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery,
                                    SegmentMetadataQuery, SelectQuery,
                                    TimeBoundaryQuery, TimeseriesQuery,
                                    TopNQuery, query_from_json)
-from druid_tpu.server.querymanager import Deadline, QueryManager
+from druid_tpu.server.querymanager import (Deadline, QueryInterruptedError,
+                                           QueryManager, QueryTimeoutError)
 from druid_tpu.utils.intervals import Interval, condense
 
 
@@ -233,6 +234,7 @@ class Broker:
         deadline = Deadline.for_query(query)
         pending: Dict[str, SegmentDescriptor] = {d.id: d for d in segments}
         tried: Dict[str, Set[str]] = {d.id: set() for d in segments}
+        seg_errors: Dict[str, BaseException] = {}
         gathered = []
         for _ in range(self.max_retries + 1):
             if not pending:
@@ -275,7 +277,21 @@ class Broker:
                         return server, sids, rows, served
                     ap, served = node.run_partials(q_round, sids)
                     return server, sids, ap, served
+                except (QueryInterruptedError, QueryTimeoutError):
+                    raise      # cancel/deadline: abort the whole scatter
                 except ConnectionError:
+                    # unreachable server: plain failover; exhausting
+                    # replicas is a MissingSegmentsError
+                    return server, sids, None, set()
+                except Exception as e:
+                    # a sick node (HTTP 500, crash mid-query) is retried on
+                    # another replica exactly like a missing segment
+                    # (reference: query/RetryQueryRunner.java:71-80); the
+                    # error is kept PER SEGMENT so exhausting replicas
+                    # reports the real failure for a segment that actually
+                    # failed — not a recovered one's stale error
+                    for sid in sids:
+                        seg_errors[sid] = e
                     return server, sids, None, set()
 
             with ThreadPoolExecutor(max_workers=self.max_threads) as pool:
@@ -289,6 +305,9 @@ class Broker:
                 for sid in served:
                     pending.pop(sid, None)
         if pending:
+            errs = [seg_errors[sid] for sid in pending if sid in seg_errors]
+            if errs:
+                raise errs[-1]
             raise MissingSegmentsError(list(pending))
         return gathered
 

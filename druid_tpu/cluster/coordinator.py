@@ -186,6 +186,7 @@ class CoordinatorStats:
     overshadowed_marked: int = 0
     deleted: int = 0
     unassigned: int = 0
+    nodes_removed: int = 0
 
 
 class Coordinator:
@@ -205,6 +206,10 @@ class Coordinator:
     def run_once(self, now_ms: Optional[int] = None) -> CoordinatorStats:
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         stats = CoordinatorStats()
+        # failure detection first: dead servers leave the view (their
+        # announcements retract), so this same cycle's rule run sees the
+        # replica deficit and re-replicates from deep storage
+        stats.nodes_removed = len(self.view.check_liveness())
         self._mark_overshadowed(stats)
         used = self.metadata.used_segments()
         self._run_rules(used, now_ms, stats)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -208,6 +209,21 @@ class RemoteDataNodeClient:
             return set(st.get("segments", []))
         except ConnectionError:
             return set()
+
+    def ping(self) -> bool:
+        """Liveness probe: a /status round-trip within connect_timeout,
+        retried once — one dropped packet must not read as a dead server
+        (the view additionally supports multi-cycle grace via
+        check_liveness(failures_required=...))."""
+        for attempt in (0, 1):
+            try:
+                self._status()
+                return True
+            except ConnectionError:
+                if attempt:
+                    return False
+                time.sleep(0.05)
+        return False
 
     def _status(self) -> dict:
         try:

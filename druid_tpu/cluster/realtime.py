@@ -132,3 +132,6 @@ class RealtimeServer:
 
     def drop_segment(self, segment_id: str) -> bool:
         return False
+
+    def ping(self) -> bool:
+        return self.alive

@@ -93,6 +93,10 @@ class DataNode:
         with self._lock:
             return set(self._segments)
 
+    def ping(self) -> bool:
+        """Liveness probe (the heartbeat a ZK ephemeral node implies)."""
+        return self.alive
+
     def segment_count(self) -> int:
         with self._lock:
             return len(self._segments)
@@ -197,6 +201,7 @@ class InventoryView:
         self._nodes: Dict[str, DataNode] = {}
         self._timelines: Dict[str, VersionedIntervalTimeline] = {}
         self._replicas: Dict[str, ReplicaSet] = {}   # segment id → replicas
+        self._probe_failures: Dict[str, int] = {}    # consecutive ping fails
         self._lock = threading.RLock()
         self._listeners: List[Callable[[str, str, str], None]] = []
 
@@ -233,6 +238,49 @@ class InventoryView:
     def nodes(self) -> List[DataNode]:
         with self._lock:
             return list(self._nodes.values())
+
+    def check_liveness(self, failures_required: int = 1) -> List[str]:
+        """Probe every node (concurrently — a dead remote must not stall
+        the cycle by its timeout) and drop the dead ones from the view: the
+        stand-in for ZK ephemeral-node expiry (curator/announcement/
+        Announcer.java). Removal retracts all of the server's announcements,
+        so brokers stop routing to it and the coordinator's rule run sees
+        the replica deficit and re-replicates.
+
+        failures_required > 1 adds a grace period: a node is removed only
+        after that many CONSECUTIVE failed cycles (ZK's session timeout is
+        likewise multiple missed heartbeats, not one). Transient-blip
+        tolerance also lives in RemoteDataNodeClient.ping (one in-call
+        retry). A recovered node re-registers + re-announces to rejoin."""
+        from concurrent.futures import ThreadPoolExecutor
+        nodes = self.nodes()
+        if not nodes:
+            return []
+
+        def probe(node) -> bool:
+            try:
+                ping = getattr(node, "ping", None)
+                return bool(ping()) if callable(ping) \
+                    else bool(getattr(node, "alive", True))
+            except Exception:
+                return False
+
+        with ThreadPoolExecutor(max_workers=min(len(nodes), 16)) as pool:
+            results = list(pool.map(probe, nodes))
+        dead = []
+        with self._lock:
+            for node, ok in zip(nodes, results):
+                if ok:
+                    self._probe_failures.pop(node.name, None)
+                    continue
+                n = self._probe_failures.get(node.name, 0) + 1
+                self._probe_failures[node.name] = n
+                if n >= failures_required:
+                    dead.append(node.name)
+                    del self._probe_failures[node.name]
+        for name in dead:
+            self.remove_node(name)
+        return dead
 
     # ---- announcements -------------------------------------------------
     def announce(self, server: str, descriptor: SegmentDescriptor) -> None:

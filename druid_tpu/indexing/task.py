@@ -111,6 +111,32 @@ class IndexTask(Task):
         self.tuning = tuning or IndexTuningConfig()
         self.appending = appending
 
+    def to_json(self) -> dict:
+        schema = {
+            "dataSource": self.datasource,
+            "metricsSpec": [a.to_json() for a in self.metric_specs],
+            "granularitySpec": {
+                "segmentGranularity": str(self.segment_granularity),
+                "queryGranularity": self.query_granularity,
+                "rollup": self.rollup},
+        }
+        if self.parser is not None:
+            schema["parser"] = self.parser.to_json()
+        if self.dimensions is not None:
+            schema["dimensionsSpec"] = {"dimensions": list(self.dimensions)}
+        if self.transform is not None:
+            schema["transformSpec"] = self.transform.to_json()
+        return {"type": "index", "id": self.id, "appending": self.appending,
+                "spec": {
+                    "ioConfig": {"type": "index",
+                                 "firehose": self.firehose.to_json()},
+                    "dataSchema": schema,
+                    "tuningConfig": {
+                        "maxRowsPerSegment": self.tuning.max_rows_per_segment,
+                        "maxRowsInMemory": self.tuning.max_rows_in_memory,
+                        "partitionDimensions":
+                            list(self.tuning.partition_dimensions)}}}
+
     def _parse(self, raw: List) -> RowBatch:
         if self.parser is not None:
             batch = self.parser.parse_batch(raw)
@@ -244,6 +270,13 @@ class CompactionTask(Task):
         self.metric_specs = list(metric_specs)
         self.query_granularity = query_granularity
 
+    def to_json(self) -> dict:
+        return {"type": "compact", "id": self.id,
+                "dataSource": self.datasource,
+                "interval": str(self.interval),
+                "metricsSpec": [a.to_json() for a in self.metric_specs],
+                "queryGranularity": self.query_granularity}
+
     def run(self, toolbox: "TaskToolbox") -> TaskStatus:
         # lock FIRST, then snapshot: reading before the lock races a batch
         # replace — the stale snapshot would republish replaced data under
@@ -287,6 +320,11 @@ class KillTask(Task):
         super().__init__(task_id, datasource)
         self.interval = interval
 
+    def to_json(self) -> dict:
+        return {"type": "kill", "id": self.id,
+                "dataSource": self.datasource,
+                "interval": str(self.interval)}
+
     def run(self, toolbox: "TaskToolbox") -> TaskStatus:
         descs = toolbox.metadata.unused_segments(self.datasource,
                                                  self.interval)
@@ -306,17 +344,31 @@ def task_from_json(j: dict) -> Task:
         parser = InputRowParser.from_json(schema["parser"]) \
             if "parser" in schema else None
         gran = schema.get("granularitySpec", {})
+        dims_spec = schema.get("dimensionsSpec")
+        tuning_j = spec.get("tuningConfig", {})
+        tuning = IndexTuningConfig(
+            max_rows_per_segment=tuning_j.get("maxRowsPerSegment", 5_000_000),
+            max_rows_in_memory=tuning_j.get("maxRowsInMemory", 1_000_000),
+            partition_dimensions=tuple(
+                tuning_j.get("partitionDimensions", ())))
+        transform = TransformSpec.from_json(schema.get("transformSpec")) \
+            if schema.get("transformSpec") else None
         return IndexTask(
             schema["dataSource"], firehose_from_json(io["firehose"]), parser,
             [A.agg_from_json(a) for a in schema.get("metricsSpec", [])],
+            dimensions=(dims_spec or {}).get("dimensions") or None,
+            transform=transform,
             segment_granularity=gran.get("segmentGranularity", "day"),
             query_granularity=gran.get("queryGranularity", "none"),
             rollup=gran.get("rollup", True),
-            task_id=j.get("id"))
+            tuning=tuning,
+            task_id=j.get("id"),
+            appending=j.get("appending", False))
     if t == "compact":
         return CompactionTask(
             j["dataSource"], Interval.parse(j["interval"]),
             [A.agg_from_json(a) for a in j.get("metricsSpec", [])],
+            query_granularity=j.get("queryGranularity", "none"),
             task_id=j.get("id"))
     if t == "kill":
         return KillTask(j["dataSource"], Interval.parse(j["interval"]),

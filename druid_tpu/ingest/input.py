@@ -63,6 +63,10 @@ class TimestampSpec:
                              j.get("format", "auto"),
                              j.get("missingValue"))
 
+    def to_json(self) -> dict:
+        return {"column": self.column, "format": self.format,
+                "missingValue": self.missing_value}
+
 
 @dataclass(frozen=True)
 class DimensionsSpec:
@@ -80,6 +84,10 @@ class DimensionsSpec:
             dims.append(d if isinstance(d, str) else d["name"])
         return DimensionsSpec(tuple(dims),
                               tuple(j.get("dimensionExclusions", [])))
+
+    def to_json(self) -> dict:
+        return {"dimensions": list(self.dimensions),
+                "dimensionExclusions": list(self.exclusions)}
 
 
 class RowBatch:
@@ -131,6 +139,18 @@ class InputRowParser:
             columns=ps.get("columns"),
             delimiter=ps.get("delimiter", "\t"),
             pattern=ps.get("pattern"))
+
+    def to_json(self) -> dict:
+        ps = {"format": self.fmt,
+              "timestampSpec": self.timestamp_spec.to_json(),
+              "dimensionsSpec": self.dimensions_spec.to_json()}
+        if self.columns is not None:
+            ps["columns"] = list(self.columns)
+        if self.fmt == "tsv":
+            ps["delimiter"] = self.delimiter
+        if self.pattern is not None:
+            ps["pattern"] = self.pattern.pattern
+        return {"parseSpec": ps}
 
     # -- record-level decode --------------------------------------------
     def _decode(self, record) -> Optional[dict]:
@@ -202,6 +222,10 @@ class ExpressionTransform:
     def from_json(j: dict) -> "ExpressionTransform":
         return ExpressionTransform(j["name"], j["expression"])
 
+    def to_json(self) -> dict:
+        return {"type": "expression", "name": self.name,
+                "expression": self.expression}
+
 
 @dataclass(frozen=True)
 class TransformSpec:
@@ -216,6 +240,10 @@ class TransformSpec:
             tuple(ExpressionTransform.from_json(t)
                   for t in j.get("transforms", [])),
             filter_from_json(j.get("filter")))
+
+    def to_json(self) -> dict:
+        return {"transforms": [t.to_json() for t in self.transforms],
+                "filter": self.filter.to_json() if self.filter else None}
 
     def apply(self, batch: RowBatch) -> RowBatch:
         if not self.transforms and self.filter is None:
@@ -289,6 +317,11 @@ class Firehose:
     def batches(self, batch_size: int = 65536) -> Iterator[List]:
         raise NotImplementedError
 
+    def to_json(self) -> dict:
+        """Wire form consumed by firehose_from_json — required so tasks can
+        ship to forked peons (ForkingTaskRunner)."""
+        raise NotImplementedError(f"{type(self).__name__} is not serializable")
+
 
 class InlineFirehose(Firehose):
     def __init__(self, records: Sequence):
@@ -298,11 +331,16 @@ class InlineFirehose(Firehose):
         for i in range(0, len(self.records), batch_size):
             yield self.records[i:i + batch_size]
 
+    def to_json(self) -> dict:
+        return {"type": "inline", "data": list(self.records)}
+
 
 class LocalFirehose(Firehose):
     """Reads newline-delimited files matching a glob (gzip-aware)."""
 
     def __init__(self, base_dir: str, glob: str = "*"):
+        self.base_dir = base_dir
+        self.glob = glob
         self.paths = sorted(globlib.glob(f"{base_dir}/{glob}"))
 
     def batches(self, batch_size: int = 65536):
@@ -319,6 +357,11 @@ class LocalFirehose(Firehose):
             yield buf
 
 
+    def to_json(self) -> dict:
+        return {"type": "local", "baseDir": self.base_dir,
+                "filter": self.glob}
+
+
 class CombiningFirehose(Firehose):
     def __init__(self, delegates: Sequence[Firehose]):
         self.delegates = list(delegates)
@@ -326,6 +369,10 @@ class CombiningFirehose(Firehose):
     def batches(self, batch_size: int = 65536):
         for d in self.delegates:
             yield from d.batches(batch_size)
+
+    def to_json(self) -> dict:
+        return {"type": "combining",
+                "delegates": [d.to_json() for d in self.delegates]}
 
 
 def firehose_from_json(j: dict) -> Firehose:

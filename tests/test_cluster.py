@@ -293,6 +293,77 @@ def test_coordinator_overshadow_cleanup(coordinated, segments, generator):
     assert coord.kill_unused("test") == 1
 
 
+class _SickNode(DataNode):
+    """Serves segments but fails queries N times with a server error (the
+    HTTP-500 case — reachable, sick)."""
+
+    def __init__(self, name, failures=1):
+        super().__init__(name)
+        self.failures = failures
+
+    def run_partials(self, query, segment_ids, check=None):
+        if self.failures > 0:
+            self.failures -= 1
+            raise RuntimeError("node exploded mid-query")
+        return super().run_partials(query, segment_ids, check)
+
+
+def test_broker_retries_sick_node_on_replica(segments):
+    """An HTTP-500-style node error must fail over to another replica, not
+    fail the query (RetryQueryRunner.java:71-80)."""
+    view = InventoryView()
+    sick = _SickNode("sick", failures=10**9)
+    good = DataNode("good")
+    for n in (sick, good):
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(n.name, descriptor_for(s))
+    broker = Broker(view)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    assert broker.run(q) == _local(segments, q)
+
+
+def test_broker_reports_node_error_when_replicas_exhausted(segments):
+    view = InventoryView()
+    sick = _SickNode("sick", failures=10**9)
+    view.register(sick)
+    for s in segments:
+        sick.load_segment(s)
+        view.announce("sick", descriptor_for(s))
+    broker = Broker(view)
+    with pytest.raises(RuntimeError, match="exploded"):
+        broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+
+
+def test_liveness_failure_triggers_rereplication(coordinated, segments):
+    """Kill one of two replicas: the coordinator's liveness probe removes
+    the dead server and the SAME cycle restores replication on a live node
+    while the broker keeps serving (Announcer ephemeral-expiry +
+    ReplicationThrottler behavior)."""
+    md, view, nodes, coord = coordinated
+    md.set_rules("_default", [{"type": "loadForever",
+                               "tieredReplicants": {"_default_tier": 2}}])
+    coord.run_once()
+    sid = descriptor_for(segments[0]).id
+    victim_name = sorted(view.replica_set(sid).servers)[0]
+    victim = view.node(victim_name)
+    victim.alive = False
+
+    broker = Broker(view)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    assert broker.run(q) == _local(segments, q)   # mid-outage serving
+
+    stats = coord.run_once()
+    assert stats.nodes_removed == 1
+    assert view.node(victim_name) is None
+    for s in segments:
+        rs = view.replica_set(descriptor_for(s).id)
+        assert rs is not None and len(rs.servers) == 2
+        assert victim_name not in rs.servers
+    assert broker.run(q) == _local(segments, q)
+
+
 def test_coordinator_balances(segments):
     md = MetadataStore()
     view = InventoryView()

@@ -1,0 +1,138 @@
+"""Process-isolated task execution (ForkingTaskRunner / peon / action server
+— reference: ForkingTaskRunnerTest, RemoteTaskRunner dead-worker restart)."""
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import MetadataStore
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.indexing import ForkingTaskRunner, IndexTask, KillTask
+from druid_tpu.indexing.task import task_from_json
+from druid_tpu.ingest import InlineFirehose
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+SPECS = [CountAggregator("rows"), LongSumAggregator("v", "value")]
+QSPECS = [LongSumAggregator("rows", "rows"), LongSumAggregator("v", "v")]
+WEEK = Interval.of("2026-04-01", "2026-04-08")
+T0 = WEEK.start
+
+
+def _records(n, days=3, seed=0):
+    rng = np.random.default_rng(seed)
+    day = 86_400_000
+    return [{"timestamp": int(T0 + (i % days) * day + i * 1000 % day),
+             "page": f"p{int(rng.integers(10))}",
+             "value": int(rng.integers(0, 10))} for i in range(n)]
+
+
+@pytest.fixture()
+def runner(tmp_path):
+    md = MetadataStore()
+    r = ForkingTaskRunner(md, deep_storage_dir=str(tmp_path / "deep"))
+    yield md, r
+    r.shutdown()
+
+
+def test_task_json_roundtrip():
+    recs = _records(10)
+    task = IndexTask("rt_ds", InlineFirehose(recs), None, SPECS,
+                     dimensions=["page"], segment_granularity="day",
+                     query_granularity="hour", rollup=False)
+    j = task.to_json()
+    back = task_from_json(j)
+    assert back.id == task.id
+    assert back.datasource == "rt_ds"
+    assert back.dimensions == ["page"]
+    assert back.query_granularity == "hour"
+    assert back.rollup is False
+    assert list(back.firehose.batches(100))[0] == recs
+
+
+def test_forked_index_task_end_to_end(runner):
+    """The task runs in a REAL child process: lock/publish actions flow over
+    HTTP to the parent, segment bytes land in shared deep storage."""
+    md, r = runner
+    recs = _records(3000, days=3)
+    task = IndexTask("fork_ds", InlineFirehose(recs), None, SPECS,
+                     segment_granularity="day")
+    status = r.run_task(task, timeout=120)
+    assert status.state == "SUCCESS", status.error
+    descs = md.used_segments("fork_ds")
+    assert len(descs) == 3
+    # the peon really was a separate process
+    proc = r.processes[task.id]
+    import os
+    assert proc.pid != os.getpid() and proc.returncode == 0
+    # actions arrived over the wire
+    kinds = [a["action"] for a in r.actions.actions if a["task"] == task.id]
+    assert "lock" in kinds and "publish" in kinds
+    segs = [r.deep_storage.pull(d) for d in descs]
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("fork_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 3000
+    assert rows[0]["result"]["v"] == sum(x["value"] for x in recs)
+
+
+def test_peon_killed_mid_task_reruns_to_success(runner):
+    """Kill the peon right as it acquires its lock (OOM-kill stand-in): the
+    runner must release the dead task's locks, re-fork, and the retry must
+    publish exactly once — while the parent keeps serving."""
+    md, r = runner
+    recs = _records(2000, days=2)
+    task = IndexTask("kill_ds", InlineFirehose(recs), None, SPECS,
+                     segment_granularity="day")
+    state = {"killed": False}
+    orig = r.actions._do_action
+
+    def hook(payload):
+        if payload["action"] == "lock" and not state["killed"]:
+            state["killed"] = True
+            proc = r.processes[payload["task"]]
+            proc.kill()
+            proc.wait()
+        return orig(payload)
+
+    r.actions._do_action = hook
+    status = r.run_task(task, timeout=120)
+    assert status.state == "SUCCESS", status.error
+    assert state["killed"] and r.attempts[task.id] == 2
+    # exactly-once: one publish, correct totals
+    descs = md.used_segments("kill_ds")
+    assert len(descs) == 2
+    segs = [r.deep_storage.pull(d) for d in descs]
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("kill_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 2000
+
+
+def test_peon_that_always_dies_reports_failure(runner):
+    md, r = runner
+    task = IndexTask("dead_ds", InlineFirehose(_records(500)), None, SPECS)
+    orig = r.actions._do_action
+
+    def hook(payload):
+        if payload["action"] == "lock":
+            proc = r.processes[payload["task"]]
+            proc.kill()
+            proc.wait()
+        return orig(payload)
+
+    r.actions._do_action = hook
+    status = r.run_task(task, timeout=120)
+    assert status.state == "FAILED"
+    assert "died" in status.error
+    assert md.used_segments("dead_ds") == []
+
+
+def test_forked_kill_task(runner):
+    md, r = runner
+    recs = _records(400, days=1)
+    t1 = IndexTask("purge_ds", InlineFirehose(recs), None, SPECS,
+                   segment_granularity="day")
+    assert r.run_task(t1, timeout=120).state == "SUCCESS"
+    ids = [d.id for d in md.used_segments("purge_ds")]
+    md.mark_unused(ids)
+    t2 = KillTask("purge_ds", WEEK)
+    assert r.run_task(t2, timeout=120).state == "SUCCESS"
+    assert md.unused_segments("purge_ds", WEEK) == []
