@@ -10,10 +10,19 @@ output of filter planning is either
   * a row-selectivity estimate used to decide bitmap-vs-device-predicate
     (the same decision as Filters.shouldUseBitmapIndex, reference
     processing/.../segment/filter/Filters.java).
+
+Density adaptivity (the CONCISE/Roaring capability, not the format): a
+value matching few rows stores a sorted row-id list (memory ∝ matches),
+a dense value stores packed words (memory ∝ rows/8); per-value bitmaps
+materialize lazily under an LRU byte budget, and multi-value unions build
+straight from the index's sorted row order without materializing any
+per-value bitmap at all.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -102,46 +111,162 @@ class Bitmap:
         return int(self.words.nbytes)
 
     def __eq__(self, other):
-        return (isinstance(other, Bitmap) and self.n_rows == other.n_rows
+        if not isinstance(other, Bitmap):
+            # defer to the reflected __eq__ (SparseBitmap compares content)
+            return NotImplemented
+        return (self.n_rows == other.n_rows
                 and np.array_equal(self.words, other.words))
 
 
+class SparseBitmap:
+    """Row-id-list bitmap for low-density values: memory scales with the
+    matching rows, not the segment rows (the capability ImmutableConciseSet
+    :79 / RoaringBitmap provide in the reference). Duck-types Bitmap —
+    algebra and `.words` densify transiently on demand."""
+
+    __slots__ = ("ids", "n_rows")
+
+    def __init__(self, ids: np.ndarray, n_rows: int):
+        self.ids = np.asarray(ids, dtype=np.int32)
+        self.n_rows = n_rows
+
+    @property
+    def words(self) -> np.ndarray:
+        return np.packbits(self.to_bool())
+
+    def _dense(self) -> Bitmap:
+        return Bitmap.from_bool(self.to_bool())
+
+    def to_bool(self) -> np.ndarray:
+        mask = np.zeros(self.n_rows, dtype=bool)
+        mask[self.ids] = True
+        return mask
+
+    def to_indices(self) -> np.ndarray:
+        return self.ids
+
+    def cardinality(self) -> int:
+        return int(self.ids.shape[0])
+
+    def size_bytes(self) -> int:
+        return int(self.ids.nbytes)
+
+    def __and__(self, other):
+        return self._dense() & other
+
+    def __or__(self, other):
+        return self._dense() | other
+
+    def __xor__(self, other):
+        return self._dense() ^ other
+
+    def __invert__(self):
+        return ~self._dense()
+
+    def __eq__(self, other):
+        if isinstance(other, SparseBitmap):
+            return (self.n_rows == other.n_rows
+                    and np.array_equal(self.ids, other.ids))
+        if isinstance(other, Bitmap):
+            return self._dense() == other
+        return NotImplemented
+
+
+AnyBitmap = Union[Bitmap, SparseBitmap]
+
+#: a value stores sparse when 4·matches < rows/8 (int32 ids vs packed words)
+SPARSE_DENSITY_DIVISOR = 32
+#: default budget for LRU-cached materialized per-value bitmaps per index
+BITMAP_CACHE_BUDGET = 16 << 20
+
+
 class BitmapIndex:
-    """Per-dimension inverted index: dictionary id -> row Bitmap.
+    """Per-dimension inverted index: dictionary id -> row bitmap.
 
     Reference analog: segment/column/BitmapIndex.java:27 backed by one
-    compressed bitmap per dictionary value. Stored packed; built from the id
-    column in one vectorized pass.
-    """
+    compressed bitmap per dictionary value. The index keeps ONE sorted row
+    order (built lazily from the id column); per-value bitmaps materialize
+    on demand — dense packed words or sparse row-id lists by density — and
+    live under an LRU byte budget, so a card-5000 dim on a 12.5M-row
+    segment costs ~index order (n·4B), not card · n/8 bytes."""
 
-    __slots__ = ("n_rows", "cardinality", "_bitmaps")
-
-    def __init__(self, n_rows: int, cardinality: int, bitmaps: List[Bitmap]):
+    def __init__(self, n_rows: int, cardinality: int,
+                 bitmaps: List[Optional[AnyBitmap]],
+                 ids: Optional[np.ndarray] = None):
         self.n_rows = n_rows
         self.cardinality = cardinality
         self._bitmaps = bitmaps
+        self._ids = ids
+        self._order: Optional[np.ndarray] = None
+        self._boundaries: Optional[np.ndarray] = None
+        self._lru: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()          # vid -> size_bytes
+        self._cached_bytes = 0
+        self._budget = BITMAP_CACHE_BUDGET
+        self._lock = threading.Lock()
 
     @staticmethod
     def build(ids: np.ndarray, cardinality: int) -> "BitmapIndex":
-        n = ids.shape[0]
-        # one-hot per value via sorted row ids (vectorized, O(n log n))
-        order = np.argsort(ids, kind="stable")
-        sorted_ids = ids[order]
-        boundaries = np.searchsorted(sorted_ids, np.arange(cardinality + 1))
-        bitmaps = []
-        for v in range(cardinality):
-            rows = order[boundaries[v]:boundaries[v + 1]]
-            bitmaps.append(Bitmap.from_indices(rows, n))
-        return BitmapIndex(n, cardinality, bitmaps)
+        ids = np.asarray(ids)
+        return BitmapIndex(int(ids.shape[0]), cardinality,
+                           [None] * cardinality, ids=ids)
 
-    def bitmap(self, value_id: int) -> Bitmap:
+    # ---- lazy sorted order ---------------------------------------------
+    def _sorted(self):
+        if self._order is None:
+            order = np.argsort(self._ids, kind="stable").astype(np.int32)
+            self._boundaries = np.searchsorted(
+                self._ids[order], np.arange(self.cardinality + 1))
+            self._order = order
+        return self._order, self._boundaries
+
+    def _materialize(self, value_id: int) -> AnyBitmap:
+        order, bounds = self._sorted()
+        rows = order[bounds[value_id]:bounds[value_id + 1]]
+        if rows.size < self.n_rows // SPARSE_DENSITY_DIVISOR:
+            return SparseBitmap(np.sort(rows), self.n_rows)
+        return Bitmap.from_indices(rows, self.n_rows)
+
+    def _cache_put(self, value_id: int, b: AnyBitmap) -> None:
+        size = b.size_bytes()
+        self._bitmaps[value_id] = b
+        self._lru[value_id] = size
+        self._lru.move_to_end(value_id)
+        self._cached_bytes += size
+        while self._cached_bytes > self._budget and len(self._lru) > 1:
+            vid, sz = self._lru.popitem(last=False)
+            self._bitmaps[vid] = None
+            self._cached_bytes -= sz
+
+    # ---- lookups --------------------------------------------------------
+    def bitmap(self, value_id: int) -> AnyBitmap:
         if value_id < 0 or value_id >= self.cardinality:
             return Bitmap.empty(self.n_rows)
-        return self._bitmaps[value_id]
+        with self._lock:
+            b = self._bitmaps[value_id]
+            if b is not None:
+                if value_id in self._lru:
+                    self._lru.move_to_end(value_id)
+                return b
+            b = self._materialize(value_id)
+            self._cache_put(value_id, b)
+            return b
 
     def union_of(self, value_ids: np.ndarray) -> Bitmap:
-        return Bitmap.union([self._bitmaps[int(v)] for v in value_ids
-                             if 0 <= v < self.cardinality], self.n_rows)
+        """Union over many values straight from the sorted row order — no
+        per-value bitmaps are materialized (an OR / IN / regex over
+        thousands of values touches each row id exactly once)."""
+        valid = [int(v) for v in value_ids if 0 <= v < self.cardinality]
+        if not valid:
+            return Bitmap.empty(self.n_rows)
+        if self._ids is None:       # subclass without a backing id column
+            return Bitmap.union([self.bitmap(v) for v in valid], self.n_rows)
+        with self._lock:
+            order, bounds = self._sorted()
+            parts = [order[bounds[v]:bounds[v + 1]] for v in valid]
+        return Bitmap.from_indices(np.concatenate(parts), self.n_rows)
 
     def size_bytes(self) -> int:
-        return sum(b.size_bytes() for b in self._bitmaps)
+        n = 0 if self._order is None else int(self._order.nbytes)
+        with self._lock:
+            return n + self._cached_bytes

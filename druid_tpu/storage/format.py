@@ -90,19 +90,43 @@ class LazyBitmapIndex(BitmapIndex):
     def bitmap(self, value_id: int) -> Bitmap:
         if value_id < 0 or value_id >= self.cardinality:
             return Bitmap.empty(self.n_rows)
-        b = self._bitmaps[value_id]
-        if b is None:
-            lo, hi = int(self._offsets[value_id]), int(self._offsets[value_id + 1])
-            words = np.frombuffer(
-                codecs.decompress_block(self._codec, self._blob[lo:hi],
-                                        self._word_bytes), dtype=np.uint8)
-            b = Bitmap(words.copy(), self.n_rows)
-            self._bitmaps[value_id] = b
-        return b
+        with self._lock:
+            b = self._bitmaps[value_id]
+            if b is None:
+                lo, hi = (int(self._offsets[value_id]),
+                          int(self._offsets[value_id + 1]))
+                words = np.frombuffer(
+                    codecs.decompress_block(self._codec, self._blob[lo:hi],
+                                            self._word_bytes), dtype=np.uint8)
+                b = Bitmap(words.copy(), self.n_rows)
+                # decompressed bitmaps live under the index's LRU byte
+                # budget exactly like lazily-built ones
+                self._cache_put(value_id, b)
+            elif value_id in self._lru:
+                self._lru.move_to_end(value_id)
+            return b
 
     def union_of(self, value_ids: np.ndarray) -> Bitmap:
-        return Bitmap.union([self.bitmap(int(v)) for v in value_ids
-                             if 0 <= v < self.cardinality], self.n_rows)
+        """Stream the OR into one accumulator: a wide IN/regex union over
+        thousands of values must neither hold every decompressed bitmap at
+        once nor thrash the LRU cache."""
+        valid = [int(v) for v in value_ids if 0 <= v < self.cardinality]
+        if not valid:
+            return Bitmap.empty(self.n_rows)
+        acc = np.zeros(self._word_bytes, dtype=np.uint8)
+        for v in valid:
+            with self._lock:
+                cached = self._bitmaps[v]
+            if cached is not None:
+                words = cached.words
+            else:
+                lo, hi = int(self._offsets[v]), int(self._offsets[v + 1])
+                words = np.frombuffer(
+                    codecs.decompress_block(self._codec, self._blob[lo:hi],
+                                            self._word_bytes),
+                    dtype=np.uint8)
+            np.bitwise_or(acc, words, out=acc)
+        return Bitmap(acc, self.n_rows)
 
     def size_bytes(self) -> int:
         return int(self._offsets[-1])

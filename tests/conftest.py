@@ -42,9 +42,34 @@ def generator():
     return DataGenerator(TEST_SCHEMA, seed=42)
 
 
+def persist_roundtrip(seg, directory: str):
+    """Persist to the on-disk format and reload (exercises codecs, smoosh,
+    lazy bitmap parts, dictionary serde on every engine test)."""
+    from druid_tpu.storage.format import load_segment, persist_segment
+    persist_segment(seg, directory)
+    return load_segment(directory)
+
+
 @pytest.fixture(scope="session")
-def segment(generator):
-    return generator.segment(20_000, DAY, datasource="test")
+def _base_segment():
+    # a DEDICATED generator: the shared `generator` fixture's RNG is
+    # stateful, and both `segment` params must see the SAME rows
+    return DataGenerator(TEST_SCHEMA, seed=42).segment(
+        20_000, DAY, datasource="test")
+
+
+@pytest.fixture(scope="session", params=("generated", "persisted"))
+def segment(request, _base_segment, tmp_path_factory):
+    """Engine tests run against BOTH the in-memory and the
+    persisted+reloaded form of the SAME segment (reference:
+    QueryRunnerTestHelper.makeQueryRunners parameterizes every query test
+    over incremental/mmapped/merged forms). The order-changing forms
+    (merged-from-spills, rollup-incremental) get their own equivalence
+    battery in test_representations.py."""
+    if request.param == "persisted":
+        return persist_roundtrip(
+            _base_segment, str(tmp_path_factory.mktemp("seg") / "test"))
+    return _base_segment
 
 
 @pytest.fixture(scope="session")

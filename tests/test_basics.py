@@ -95,6 +95,40 @@ def test_bitmap_index():
     assert idx.union_of(np.asarray([0, 1])).cardinality() == 4
 
 
+def test_bitmap_density_adaptive_and_budgeted():
+    """High-cardinality dims must not materialize card · n/8 bytes: sparse
+    values store row-id lists, the LRU budget bounds resident bitmaps, and
+    many-value unions never materialize per-value bitmaps at all
+    (capability of CONCISE/Roaring, ImmutableConciseSet.java:79)."""
+    from druid_tpu.data.bitmap import SparseBitmap
+    rng = np.random.default_rng(3)
+    n, card = 200_000, 5000
+    ids = rng.integers(0, card, n).astype(np.int32)
+    idx = BitmapIndex.build(ids, card)
+    # ~40 rows per value << n/32: sparse representation
+    b = idx.bitmap(7)
+    assert isinstance(b, SparseBitmap)
+    assert sorted(b.to_indices()) == sorted(np.flatnonzero(ids == 7))
+    # sparse algebra densifies transparently
+    dense = Bitmap.from_indices(np.flatnonzero(ids < 3), n)
+    assert (b & dense).cardinality() == 0
+    assert (b | dense).cardinality() == b.cardinality() + dense.cardinality()
+    # a full-cardinality union touches every row once, exactly
+    u = idx.union_of(np.arange(card))
+    assert u.cardinality() == n
+    # resident memory stays near the sorted-order cost, not card*n/8 (125MB)
+    for v in range(0, card, 7):
+        idx.bitmap(v)
+    assert idx.size_bytes() < 2 * ids.nbytes
+    # a dominant value goes dense
+    ids2 = np.zeros(n, dtype=np.int32)
+    ids2[::100] = 1
+    idx2 = BitmapIndex.build(ids2, 2)
+    assert isinstance(idx2.bitmap(0), Bitmap)
+    assert isinstance(idx2.bitmap(1), SparseBitmap)
+    assert idx2.bitmap(0).cardinality() + idx2.bitmap(1).cardinality() == n
+
+
 def test_expression_eval():
     e = parse_expression("metA * 2 + 1")
     out = e.evaluate({"metA": np.asarray([1.0, 2.0])})
