@@ -107,6 +107,8 @@ class Broker:
 
     # ---- the signature path (§3.1) -------------------------------------
     def run(self, query: Query):
+        from druid_tpu.engine.executor import apply_interval_chunking
+        query = apply_interval_chunking(query)
         if query.inner_query is not None:
             # subquery: inner runs cluster-wide; the outer re-groups the
             # materialized inner rows broker-locally (as the reference's

@@ -15,12 +15,35 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
+from dataclasses import replace
+
 from druid_tpu.data.segment import Segment
 from druid_tpu.engine import engines
 from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery, Query,
                                    ScanQuery, SearchQuery, SegmentMetadataQuery,
                                    SelectQuery, TimeBoundaryQuery,
                                    TimeseriesQuery, TopNQuery, query_from_json)
+from druid_tpu.utils.intervals import (condense, parse_period_ms,
+                                       split_by_period)
+
+
+def apply_interval_chunking(query: Query) -> Query:
+    """Honor the `chunkPeriod` query context: split long intervals into
+    aligned per-period chunks (IntervalChunkingQueryRunner.java:67-133).
+    The engine evaluates every interval in ONE device program — the time
+    mask is a fused elementwise op over the chunk list — so chunking here
+    is a semantics/caching surface, not the parallelism vehicle it is on
+    the reference's processing pools."""
+    p = query.context_map.get("chunkPeriod")
+    if not p:
+        return query
+    period = parse_period_ms(p)
+    chunks: list = []
+    for iv in condense(query.intervals):
+        chunks.extend(split_by_period(iv, period))
+    if tuple(chunks) == tuple(query.intervals):
+        return query
+    return replace(query, intervals=tuple(chunks))
 
 
 class QueryExecutor:
@@ -57,6 +80,7 @@ class QueryExecutor:
 
     # ---- execution -----------------------------------------------------
     def run(self, query: Query, segments: Optional[Sequence[Segment]] = None):
+        query = apply_interval_chunking(query)
         if segments is not None:
             segs = list(segments)
         elif query.inner_query is not None:

@@ -203,24 +203,38 @@ class ColumnCompareNode(FilterNode):
 
 
 class ExpressionNode(FilterNode):
-    """Expression over numeric columns / __time, traced to XLA elementwise ops."""
+    """Expression filter traced to XLA elementwise ops. String-dimension
+    comparisons are rewritten at plan time into per-dictionary-id boolean
+    LUT gathers (utils.expression.rewrite_string_sites) — the device path
+    stays purely numeric."""
 
-    def __init__(self, expression: str, time0: int):
+    def __init__(self, expression: str, time0: int, segment=None):
+        from druid_tpu.utils.expression import (lut_for_site,
+                                                rewrite_string_sites)
         self.expression = expression
         self.time0 = time0
-        self.expr = parse_expression(expression)
+        string_dims = frozenset(segment.dims) if segment is not None \
+            else frozenset()
+        self.expr, sites = rewrite_string_sites(
+            parse_expression(expression), string_dims)
+        self.luts = [lut_for_site(s, segment.dims[s[0]].dictionary.values)
+                     for s in sites] if segment is not None else []
 
     def signature(self):
-        return f"expr({self.expression})"
+        # the REWRITTEN AST must key the jit cache: the same expression
+        # string over different schemas (dim vs metric column) rewrites to
+        # structurally different programs
+        return f"expr({self.expr!r};l{len(self.luts)})"
 
     def aux_arrays(self):
-        return [np.asarray(self.time0, dtype=np.int64)]
+        return [np.asarray(self.time0, dtype=np.int64)] + list(self.luts)
 
     def build(self, cols, aux):
         import jax.numpy as jnp
         time0 = next(aux)
         bindings = dict(cols)
         bindings["__time"] = cols["__time_offset"].astype(jnp.int64) + time0
+        bindings["__luts"] = [next(aux) for _ in self.luts]
         out = self.expr.evaluate(bindings)
         return jnp.asarray(out, dtype=bool) if hasattr(out, "shape") else (
             jnp.full((cols["__valid"].shape[0],), bool(out)))
@@ -382,7 +396,7 @@ def _plan(flt: F.DimFilter, segment: Segment,
         _, remaps = merge_dictionaries(dicts)
         return ColumnCompareNode(flt.dimensions, remaps)
     if isinstance(flt, F.ExpressionFilter):
-        return ExpressionNode(flt.expression, segment.interval.start)
+        return ExpressionNode(flt.expression, segment.interval.start, segment)
 
     # single-column leaf filters
     dim = getattr(flt, "dimension", None)
@@ -553,6 +567,17 @@ def evaluate_filter_on_row(flt: F.DimFilter, row: Dict[str, object]) -> bool:
 # Host-side full mask evaluation (scan / search / timeBoundary paths)
 # ---------------------------------------------------------------------------
 
+def _bind_string_dims(expr, segment: Segment, bindings: Dict) -> None:
+    """Bind every string dim an expression references as a DECODED value
+    array — host-path numpy string comparison matches the reference's
+    lexicographic semantics directly."""
+    for c in expr.required_columns():
+        if c in segment.dims and c not in bindings:
+            col = segment.dims[c]
+            vals = np.asarray(list(col.dictionary.values), dtype=object)
+            bindings[c] = vals[col.ids]
+
+
 def host_mask(flt: Optional[F.DimFilter], segment: Segment,
               virtual_columns: Sequence = ()) -> np.ndarray:
     """Evaluate a filter to a host boolean row mask with vectorized numpy —
@@ -568,7 +593,9 @@ def host_mask(flt: Optional[F.DimFilter], segment: Segment,
         for name, m in segment.metrics.items():
             bindings[name] = m.values
         for v in virtual_columns:
-            arr = parse_expression(v.expression).evaluate(bindings)
+            expr = parse_expression(v.expression)
+            _bind_string_dims(expr, segment, bindings)
+            arr = expr.evaluate(bindings)
             vc_arrays[v.name] = np.broadcast_to(np.asarray(arr), (n,))
             bindings[v.name] = vc_arrays[v.name]
     return _host_mask(flt, segment, vc_arrays)
@@ -609,11 +636,13 @@ def _host_mask(flt: F.DimFilter, segment: Segment,
             out &= first == remap[segment.dims[d].ids]
         return out
     if isinstance(flt, F.ExpressionFilter):
+        expr = parse_expression(flt.expression)
         bindings = {"__time": segment.time_ms}
         for name, m in segment.metrics.items():
             bindings[name] = m.values
+        _bind_string_dims(expr, segment, bindings)
         bindings.update(vc_arrays)
-        out = parse_expression(flt.expression).evaluate(bindings)
+        out = expr.evaluate(bindings)
         return np.broadcast_to(np.asarray(out, dtype=bool), (n,)).copy()
 
     dim = getattr(flt, "dimension", None)

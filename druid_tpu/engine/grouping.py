@@ -287,18 +287,44 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
 _JIT_CACHE: Dict[str, object] = {}
 
 
-def eval_virtual_columns(arrays: Dict, t_abs, vc_exprs) -> Dict:
-    """Traced: evaluate expression virtual columns over staged columns
-    (reference: ExpressionVirtualColumn) into fused XLA elementwise ops.
+def plan_virtual_columns(segment: Segment, virtual_columns: Sequence
+                         ) -> Tuple[Tuple, List[np.ndarray]]:
+    """Per-(segment, query) virtual-column plan: parse each expression and
+    rewrite string-dimension comparisons into per-dictionary-id LUT gathers
+    (utils.expression.rewrite_string_sites) — the device never sees string
+    semantics, only an aux bool LUT indexed by dictionary ids.
+
+    Returns (vc_plans, luts): vc_plans = ((name, rewritten_expr, out_type,
+    n_luts), ...) — structural, shareable across segments with equal
+    signatures — and the flat per-segment LUT list for the aux stream."""
+    from druid_tpu.utils.expression import (lut_for_site, parse_expression,
+                                            rewrite_string_sites)
+    plans = []
+    luts: List[np.ndarray] = []
+    string_dims = frozenset(segment.dims)
+    for v in virtual_columns:
+        expr, sites = rewrite_string_sites(
+            parse_expression(v.expression), string_dims)
+        for site in sites:
+            luts.append(lut_for_site(
+                site, segment.dims[site[0]].dictionary.values))
+        plans.append((v.name, expr, v.output_type, len(sites)))
+    return tuple(plans), luts
+
+
+def eval_virtual_columns(arrays: Dict, t_abs, vc_plans, it=None) -> Dict:
+    """Traced: evaluate planned expression virtual columns over staged
+    columns (reference: ExpressionVirtualColumn) into fused XLA elementwise
+    ops; string-comparison LUTs stream in from the aux iterator `it`.
     Shared by the per-segment and sharded program builders."""
     import jax.numpy as jnp
-    from druid_tpu.utils.expression import parse_expression
 
     bindings = dict(arrays)
     bindings["__time"] = t_abs
     arrays = dict(arrays)
-    for name, expr_s, out_type in vc_exprs:
-        val = parse_expression(expr_s).evaluate(bindings)
+    for name, expr, out_type, n_luts in vc_plans:
+        bindings["__luts"] = [next(it) for _ in range(n_luts)]
+        val = expr.evaluate(bindings)
         dt = {"long": jnp.int64, "double": jnp.float64,
               "float": jnp.float32}.get(out_type, jnp.float64)
         arrays[name] = jnp.asarray(val).astype(dt)
@@ -628,11 +654,13 @@ def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
 
 
 def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
-                   virtual_columns) -> str:
+                   vc_plans) -> str:
     dims_sig = ",".join(
         f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in spec.dims)
-    vc_sig = ";".join(f"{v.name}={v.expression}:{v.output_type}"
-                      for v in virtual_columns)
+    # repr(expr) is the rewritten AST structure — two segments share a
+    # jitted program only when their LUT sites line up
+    vc_sig = ";".join(f"{name}={expr!r}:{out_type}:l{n_luts}"
+                      for name, expr, out_type, n_luts in vc_plans)
     return "|".join([
         f"bucket={spec.bucket_mode}",
         f"key={spec.key_mode}",
@@ -649,7 +677,7 @@ def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
 def _build_device_fn(spec: GroupSpec, n_intervals: int,
                      filter_node: Optional[FilterNode],
                      kernels: List[AggKernel],
-                     virtual_columns: Sequence = ()):
+                     vc_plans: Tuple = ()):
     """Build the traced program. Structure-only closure: every segment-specific
     constant arrives via `aux` (device arrays), so one jitted callable serves
     every segment with the same structure."""
@@ -662,17 +690,15 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
     dim_cols = tuple(d.column for d in spec.dims)
     has_remap = tuple(d.remap is not None for d in spec.dims)
 
-    vc_exprs = tuple((v.name, v.expression, v.output_type) for v in virtual_columns)
-
     def fn(arrays: Dict[str, object], aux: Tuple):
         it = iter(aux)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
-        if vc_exprs:
+        if vc_plans:
             time0 = next(it)
             arrays = eval_virtual_columns(arrays, t.astype(jnp.int64) + time0,
-                                          vc_exprs)
+                                          vc_plans, it)
 
         # time-in-intervals
         iv = next(it)  # int32 [k, 2]
@@ -715,18 +741,20 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
 def _assemble_aux(spec: GroupSpec, segment: Segment, intervals: Sequence[Interval],
                   filter_node: Optional[FilterNode],
                   kernels: List[AggKernel],
-                  virtual_columns: Sequence = ()) -> Tuple:
+                  vc_plans: Tuple = (),
+                  vc_luts: Sequence[np.ndarray] = ()) -> Tuple:
     t0 = segment.interval.start
     clip_lo, clip_hi = -(2**31) + 1, 2**31 - 1
     iv = np.asarray(
         [[min(max(ivl.start - t0, clip_lo), clip_hi),
           min(max(ivl.end - t0, clip_lo), clip_hi)] for ivl in intervals],
         dtype=np.int64).astype(np.int32)
-    # order must match the reads in _build_device_fn: vc time0 (if any), then
-    # interval bounds, then bucket/dim/filter/kernel aux
+    # order must match the reads in _build_device_fn: vc time0 + string
+    # LUTs (if any), then interval bounds, then bucket/dim/filter/kernel aux
     aux: List[np.ndarray] = []
-    if virtual_columns:
+    if vc_plans:
         aux.append(np.asarray(t0, dtype=np.int64))
+        aux.extend(vc_luts)
     aux.append(iv)
     if spec.key_mode == "dense":
         if spec.bucket_mode == "uniform":
@@ -757,6 +785,7 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
     spec = make_group_spec(segment, intervals, granularity, dims)
     filter_node = simplify_node(plan_filter(flt, segment, virtual_columns))
     kernels = [make_kernel(a, segment) for a in aggs]
+    vc_plans, vc_luts = plan_virtual_columns(segment, virtual_columns)
 
     if isinstance(filter_node, ConstNode) and not filter_node.value:
         # constant-false filter: nothing matches — skip the device entirely
@@ -834,14 +863,14 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
             block.padded_rows, -1)
 
     aux = _assemble_aux(spec, segment, intervals, filter_node, kernels,
-                        virtual_columns)
+                        vc_plans, vc_luts)
     while True:
         sig = _structure_sig(spec, len(intervals), filter_node, kernels,
-                             virtual_columns)
+                             vc_plans)
         fn = _JIT_CACHE.get(sig)
         if fn is None:
             fn = _build_device_fn(spec, len(intervals), filter_node, kernels,
-                                  virtual_columns)
+                                  vc_plans)
             _JIT_CACHE[sig] = fn
         try:
             counts, states = fn(arrays, aux)

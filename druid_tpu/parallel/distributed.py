@@ -29,7 +29,7 @@ from druid_tpu.engine import grouping
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
                                        eval_virtual_columns,
                                        fuse_filter_update, make_group_spec,
-                                       windowed_window)
+                                       plan_virtual_columns, windowed_window)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
@@ -108,9 +108,11 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     if spec0.key_mode != "dense" or spec0.bucket_mode not in ("all", "uniform"):
         return None
 
-    # plan filter + kernels per segment; constants must agree across segments
+    # plan filter + kernels + virtual columns per segment; constants must
+    # agree across segments
     filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns))
     kernels = [make_kernel(a, segments[0]) for a in aggs]
+    vc_plans, vc_luts = plan_virtual_columns(segments[0], virtual_columns)
     f_sig = filter_node.signature() if filter_node else "none"
     f_aux = filter_node.aux_arrays() if filter_node else []
     k_aux = [a for k in kernels for a in k.aux_arrays()]
@@ -124,6 +126,9 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         if [k.signature() for k in ks] != [k.signature() for k in kernels]:
             return None
         if not _aux_equal([a for k in ks for a in k.aux_arrays()], k_aux):
+            return None
+        vp_s, vl_s = plan_virtual_columns(s, virtual_columns)
+        if repr(vp_s) != repr(vc_plans) or not _aux_equal(vl_s, vc_luts):
             return None
     # only after every segment agreed on the plan is a const-false filter a
     # whole-query zero (a column may exist in some segments only)
@@ -206,14 +211,15 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     iv_rel = _jax.device_put(iv_rel, _NS(mesh, _P(axis, None, None)))
     bucket_off = _jax.device_put(bucket_off, _NS(mesh, _P(axis)))
 
-    aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity)
+    aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity,
+                        vc_luts)
 
     sig = _sharded_sig(mesh, axis, spec0, kds, filter_node, kernels,
-                       len(intervals), virtual_columns, K, R)
+                       len(intervals), vc_plans, K, R)
     fn = _FN_CACHE.get(sig)
     if fn is None:
         fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
-                               kernels, virtual_columns)
+                               kernels, vc_plans)
         _FN_CACHE[sig] = fn
         while len(_FN_CACHE) > _FN_CACHE_CAP:
             _FN_CACHE.popitem(last=False)
@@ -329,10 +335,12 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
 
 def _assemble_aux(spec: GroupSpec, intervals: Sequence[Interval],
                   kds: Sequence[KeyDim], f_aux: List[np.ndarray],
-                  k_aux: List[np.ndarray], granularity: Granularity) -> Tuple:
+                  k_aux: List[np.ndarray], granularity: Granularity,
+                  vc_luts: Sequence[np.ndarray] = ()) -> Tuple:
     # interval bounds + bucket origins arrive as per-segment int32 vmapped
-    # args (see try_sharded); only shared scalars live in aux
-    aux: List[np.ndarray] = []
+    # args (see try_sharded); only shared scalars live in aux.
+    # vc string-LUTs lead (consumed inside eval_virtual_columns first)
+    aux: List[np.ndarray] = list(vc_luts)
     if spec.bucket_mode == "uniform":
         aux.append(np.asarray(granularity.period_ms, dtype=np.int32))
         aux.append(np.asarray(spec.num_buckets, dtype=np.int32))
@@ -348,11 +356,11 @@ def _assemble_aux(spec: GroupSpec, intervals: Sequence[Interval],
 
 
 def _sharded_sig(mesh, axis, spec: GroupSpec, kds, filter_node, kernels,
-                 n_intervals, virtual_columns, K, R) -> Tuple:
+                 n_intervals, vc_plans, K, R) -> Tuple:
     dims_sig = ",".join(
         f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in kds)
-    vc_sig = ";".join(f"{v.name}={v.expression}:{v.output_type}"
-                      for v in virtual_columns)
+    vc_sig = ";".join(f"{name}={expr!r}:{out_type}:l{n_luts}"
+                      for name, expr, out_type, n_luts in vc_plans)
     mesh_key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
     return (mesh_key, axis, spec.bucket_mode, dims_sig, n_intervals, vc_sig,
             filter_node.signature() if filter_node else "none",
@@ -418,7 +426,7 @@ def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
 
 def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
                       kds: Sequence[KeyDim], filter_node,
-                      kernels: List[AggKernel], virtual_columns: Sequence):
+                      kernels: List[AggKernel], vc_plans: Tuple):
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -428,19 +436,17 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     num_total = spec.num_total
     dim_cols = tuple(d.column for d in kds)
     has_remap = tuple(d.remap is not None for d in kds)
-    vc_exprs = tuple((v.name, v.expression, v.output_type)
-                     for v in virtual_columns)
 
     def per_segment(arrays, time0, iv_rel, bucket_off, aux):
         it = iter(aux)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
-        if vc_exprs:
+        if vc_plans:
             # expressions may reference absolute __time — the one consumer
             # of 64-bit per-row time
             arrays = eval_virtual_columns(
-                arrays, t.astype(jnp.int64) + time0, vc_exprs)
+                arrays, t.astype(jnp.int64) + time0, vc_plans, it)
 
         # int32 relative bounds — no 64-bit elementwise time math
         within = (t[:, None] >= iv_rel[None, :, 0]) \

@@ -214,6 +214,87 @@ class FunctionCall(Expr):
         return out
 
 
+@dataclass(frozen=True)
+class DimLut(Expr):
+    """A comparison over a STRING dimension, precomputed at plan time as a
+    per-dictionary-id boolean LUT: device evaluation is one gather
+    `lut[ids]`. This is how string semantics ride the TPU path — the device
+    only ever sees integer ids; every string computation happens host-side
+    over the (small) dictionary (reference: ExpressionVirtualColumn
+    evaluates per row on the JVM; here per VALUE, once)."""
+    dim: str
+    index: int          # position in the bindings["__luts"] sequence
+
+    def evaluate(self, b):
+        return b["__luts"][self.index][b[self.dim]]
+
+    def required_columns(self):
+        return {self.dim}
+
+
+_STR_CMP_FLIP = {"==": "==", "!=": "!=", "<": ">", "<=": ">=",
+                 ">": "<", ">=": "<="}
+
+
+def rewrite_string_sites(expr: Expr, string_dims) -> Tuple[Expr, List[tuple]]:
+    """Replace (string dim ⋄ string literal) comparisons with DimLut
+    gathers. Returns (rewritten expr, sites) where sites[i] = (dim, op,
+    literal) defines LUT i; `lut_for_site` computes its contents from a
+    concrete dictionary. Deterministic in expression structure, so the
+    rewritten AST is shareable across segments while LUT contents ride the
+    per-segment aux stream. Any OTHER use of a string dim in the expression
+    raises — silently comparing dictionary ids would be wrong."""
+    sites: List[tuple] = []
+
+    def walk(e: Expr) -> Expr:
+        if isinstance(e, BinaryOp):
+            l, r = e.left, e.right
+            if e.op in _STR_CMP_FLIP:
+                if (isinstance(l, Identifier) and l.name in string_dims
+                        and isinstance(r, Literal)
+                        and isinstance(r.value, str)):
+                    sites.append((l.name, e.op, r.value))
+                    return DimLut(l.name, len(sites) - 1)
+                if (isinstance(r, Identifier) and r.name in string_dims
+                        and isinstance(l, Literal)
+                        and isinstance(l.value, str)):
+                    sites.append((r.name, _STR_CMP_FLIP[e.op], l.value))
+                    return DimLut(r.name, len(sites) - 1)
+            return BinaryOp(e.op, walk(l), walk(r))
+        if isinstance(e, UnaryOp):
+            return UnaryOp(e.op, walk(e.operand))
+        if isinstance(e, FunctionCall):
+            return FunctionCall(e.name, tuple(walk(a) for a in e.args))
+        if isinstance(e, Identifier) and e.name in string_dims:
+            raise ValueError(
+                f"string dimension {e.name!r} used outside a "
+                f"string-literal comparison — not expressible as a device "
+                f"expression (wrap it in a LUT-able comparison)")
+        return e
+
+    return walk(expr), sites
+
+
+def lut_for_site(site: tuple, values) -> np.ndarray:
+    """Boolean per-dictionary-id LUT for one rewrite site (lexicographic
+    ordering, matching the reference's StringComparators.LEXICOGRAPHIC)."""
+    dim, op, lit = site
+    vals = np.asarray(list(values), dtype=object)
+    if op == "==":
+        out = vals == lit
+    elif op == "!=":
+        out = vals != lit
+    elif op == "<":
+        out = vals < lit
+    elif op == "<=":
+        out = vals <= lit
+    elif op == ">":
+        out = vals > lit
+    else:
+        out = vals >= lit
+    return np.asarray(out, dtype=bool)
+
+
 class _Parser:
     _BINARY = [
         {"||"}, {"&&"}, {"==", "!="}, {"<", "<=", ">", ">="},

@@ -113,6 +113,52 @@ def condense(intervals: Iterable[Interval]) -> List[Interval]:
     return out
 
 
+_PERIOD_RE = re.compile(
+    r"^P(?:(?P<y>\d+)Y)?(?:(?P<mo>\d+)M)?(?:(?P<w>\d+)W)?(?:(?P<d>\d+)D)?"
+    r"(?:T(?:(?P<h>\d+)H)?(?:(?P<m>\d+)M)?(?:(?P<s>\d+)S)?)?$")
+
+#: chunk-count ceiling for split_by_period — beyond this, splitting is
+#: pure overhead (and an eternity-scale interval would try ~10^11 edges)
+MAX_PERIOD_CHUNKS = 4096
+
+
+def parse_period_ms(period) -> int:
+    """ISO-8601 duration ('P1D', 'PT6H', 'P1W', 'P1M') or plain millis →
+    milliseconds. Calendar units approximate (month=30d, year=365d): the
+    only consumer is chunk SIZING, where results are split-invariant —
+    boundaries need not be calendar-exact."""
+    if isinstance(period, bool):
+        raise TypeError("bool is not a period")
+    if isinstance(period, (int, float)):
+        return int(period)
+    m = _PERIOD_RE.match(str(period).strip().upper())
+    if not m or not any(m.groups()):
+        raise ValueError(f"cannot parse period {period!r}")
+    g = {k: int(v) if v else 0 for k, v in m.groupdict().items()}
+    days = g["y"] * 365 + g["mo"] * 30 + g["w"] * 7 + g["d"]
+    return ((days * 24 + g["h"]) * 60 + g["m"]) * 60_000 + g["s"] * 1000
+
+
+def split_by_period(interval: Interval, period_ms: int,
+                    origin_ms: int = 0) -> List[Interval]:
+    """Split one interval at period boundaries aligned to `origin_ms`
+    (reference: IntervalChunkingQueryRunner.java:67-133 — long intervals
+    become parallel per-period chunks; aligned edges keep per-chunk cache
+    keys stable across queries). Intervals that would exceed
+    MAX_PERIOD_CHUNKS (e.g. eternity) pass through unsplit."""
+    if period_ms <= 0 or interval.width <= period_ms \
+            or interval.width // period_ms > MAX_PERIOD_CHUNKS:
+        return [interval]
+    edges = [interval.start]
+    b = ((interval.start - origin_ms) // period_ms + 1) * period_ms \
+        + origin_ms
+    while b < interval.end:
+        edges.append(b)
+        b += period_ms
+    edges.append(interval.end)
+    return [Interval(a, b) for a, b in zip(edges, edges[1:]) if b > a]
+
+
 def normalize_intervals(spec) -> List[Interval]:
     """Accept an Interval, 'start/end' string, or sequence of either."""
     if spec is None:

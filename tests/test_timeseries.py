@@ -142,6 +142,57 @@ def test_timeseries_virtual_column(segment):
     assert rows[0]["result"]["sv"] == int((frame["metLong"] * 2 + 1).sum())
 
 
+def test_virtual_column_string_dim_comparison(segment):
+    """A CASE-style expression over a STRING dim must use true string
+    semantics on the device path (plan-time LUT rewrite), not raw
+    dictionary ids."""
+    ex = QueryExecutor([segment])
+    frame = rows_as_frame(segment)
+    val = frame["dimA"][0]
+    vc = ExpressionVirtualColumn(
+        "v", f"if(dimA == '{val}', metLong, 0)", "long")
+    q = TimeseriesQuery.of("test", DAY, [LongSumAggregator("sv", "v")],
+                           virtual_columns=[vc])
+    rows = ex.run(q)
+    want = int(frame["metLong"][frame["dimA"] == val].sum())
+    assert want > 0 and rows[0]["result"]["sv"] == want
+    # ordering comparison (lexicographic over dictionary values)
+    vc2 = ExpressionVirtualColumn(
+        "w", f"if(dimA <= '{val}', 1, 0)", "long")
+    q2 = TimeseriesQuery.of("test", DAY, [LongSumAggregator("sw", "w")],
+                            virtual_columns=[vc2])
+    want2 = int((frame["dimA"].astype(str) <= val).sum())
+    assert ex.run(q2)[0]["result"]["sw"] == want2
+
+
+def test_expression_filter_string_dim(segment):
+    from druid_tpu.query.filters import ExpressionFilter
+    ex = QueryExecutor([segment])
+    frame = rows_as_frame(segment)
+    val = frame["dimB"][1]
+    q = TimeseriesQuery.of(
+        "test", DAY, [CountAggregator("rows")],
+        filter=ExpressionFilter(f"dimB == '{val}' && metLong > 10"))
+    want = int(((frame["dimB"] == val) & (frame["metLong"] > 10)).sum())
+    assert ex.run(q)[0]["result"]["rows"] == want
+
+
+def test_virtual_column_string_dim_sharded(segments):
+    """Same semantics through the stacked sharded program (LUTs ride the
+    replicated aux stream)."""
+    from druid_tpu.parallel import make_mesh
+    frames = [rows_as_frame(s) for s in segments]
+    val = frames[0]["dimA"][0]
+    vc = ExpressionVirtualColumn(
+        "v", f"if(dimA == '{val}', metLong, 0)", "long")
+    q = TimeseriesQuery.of("test", Interval.of("2026-01-01", "2026-01-05"),
+                           [LongSumAggregator("sv", "v")],
+                           virtual_columns=[vc])
+    want = sum(int(f["metLong"][f["dimA"] == val].sum()) for f in frames)
+    got = QueryExecutor(segments, mesh=make_mesh(2)).run(q)
+    assert want > 0 and got[0]["result"]["sv"] == want
+
+
 def test_timeseries_empty_interval(segment):
     ex = QueryExecutor([segment])
     q = TimeseriesQuery.of("test", "2027-01-01/2027-01-02", AGGS)
