@@ -15,6 +15,8 @@ from druid_tpu.cluster.timeline import (PartitionChunk, PartitionHolder,
                                         TimelineObjectHolder,
                                         VersionedIntervalTimeline)
 from druid_tpu.cluster.dataserver import DataNodeServer, RemoteDataNodeClient
+from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                       LookupNodeSync)
 from druid_tpu.cluster.realtime import RealtimeServer
 from druid_tpu.cluster.view import DataNode, InventoryView, descriptor_for
 
@@ -28,5 +30,6 @@ __all__ = [
     "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",
     "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
     "IntervalDropRule", "rule_from_json", "DataNodeServer",
-    "RemoteDataNodeClient", "RealtimeServer",
+    "RemoteDataNodeClient", "RealtimeServer", "LookupCoordinatorManager",
+    "LookupNodeSync",
 ]

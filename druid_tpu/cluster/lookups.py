@@ -1,0 +1,97 @@
+"""Cluster-wide lookup management: coordinator-owned specs, node sync.
+
+Reference analog: server/src/main/java/org/apache/druid/server/lookup/cache/
+LookupCoordinatorManager.java — lookup definitions live in the metadata
+store keyed by TIER; the coordinator pushes them to every node in that
+tier; nodes apply version-gated updates into their process-local
+LookupReferencesManager (query/lookup.py). A node that (re)starts syncs to
+the current spec set on its next poll — the same convergence contract as
+the reference's periodic lookup management loop.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from druid_tpu.cluster.metadata import MetadataStore
+from druid_tpu.query.lookup import LookupReferencesManager
+
+_CONFIG_KEY = "lookups"
+
+
+class LookupCoordinatorManager:
+    """Authoritative lookup spec store + push loop."""
+
+    def __init__(self, metadata: MetadataStore):
+        self.metadata = metadata
+        self._lock = threading.Lock()
+
+    # ---- spec CRUD (POST /druid/coordinator/v1/lookups analog) ---------
+    def _load(self) -> Dict[str, Dict[str, dict]]:
+        return self.metadata.get_config(_CONFIG_KEY, {}) or {}
+
+    def _store(self, specs: Dict[str, Dict[str, dict]]) -> None:
+        self.metadata.set_config(_CONFIG_KEY, specs)
+
+    def set_lookup(self, tier: str, name: str, mapping: Dict[str, str],
+                   version: Optional[str] = None) -> str:
+        """Create/update one lookup; bumps the version unless given."""
+        with self._lock:
+            specs = self._load()
+            tier_specs = specs.setdefault(tier, {})
+            if version is None:
+                cur = tier_specs.get(name, {}).get("version")
+                version = f"v{int(cur[1:]) + 1}" \
+                    if cur and cur[0] == "v" and cur[1:].isdigit() else \
+                    (f"v{int(time.time() * 1000)}" if cur else "v0")
+            tier_specs[name] = {"version": version,
+                                "lookupExtractorFactory": {
+                                    "type": "map", "map": dict(mapping)}}
+            self._store(specs)
+            return version
+
+    def delete_lookup(self, tier: str, name: str) -> bool:
+        with self._lock:
+            specs = self._load()
+            if name not in specs.get(tier, {}):
+                return False
+            del specs[tier][name]
+            self._store(specs)
+            return True
+
+    def get_tier(self, tier: str) -> Dict[str, dict]:
+        return dict(self._load().get(tier, {}))
+
+    def tiers(self) -> List[str]:
+        return sorted(self._load())
+
+
+class LookupNodeSync:
+    """Node-side sync: pull the tier's specs and apply version-gated
+    updates into the local registry (LookupReferencesManager start-and-
+    listen behavior). Call poll() from the node's periodic loop."""
+
+    def __init__(self, manager: LookupCoordinatorManager, tier: str,
+                 registry: LookupReferencesManager):
+        self.manager = manager
+        self.tier = tier
+        self.registry = registry
+
+    def poll(self) -> int:
+        """Apply current specs; returns how many lookups changed."""
+        specs = self.manager.get_tier(self.tier)
+        changed = 0
+        for name, spec in specs.items():
+            factory = spec.get("lookupExtractorFactory", {})
+            if factory.get("type") != "map":
+                continue
+            if self.registry.add(name, factory.get("map", {}),
+                                 version=spec.get("version", "v0")):
+                changed += 1
+        # drop local lookups the coordinator no longer defines
+        for name in self.registry.names():
+            if name not in specs:
+                self.registry.remove(name)
+                changed += 1
+        return changed

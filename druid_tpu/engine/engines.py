@@ -74,8 +74,25 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
     reference applying ExtractionFn per row, at O(cardinality) instead of
     O(rows)."""
     col = segment.dims.get(spec.dimension)
+    num_ids = None
+    num_key = None
+    dim_col = spec.dimension
     if col is None:
-        return KeyDim(None, 1, None), [""]
+        m = segment.metrics.get(spec.dimension)
+        if m is None or np.asarray(m.values).ndim != 1:
+            return KeyDim(None, 1, None), [""]
+        # numeric dimension handler (reference: Double/Long/Float
+        # DimensionHandler + GroupByQueryEngineV2 numeric grouping): build a
+        # query-time dictionary over the column's values — the device groups
+        # by compact int32 ids exactly like a string dim, decode emits the
+        # numeric values
+        num_key = ("numdim", spec.dimension)
+
+        def _compute_num():
+            uniq, inv = np.unique(m.values, return_inverse=True)
+            return inv.astype(np.int32), [v.item() for v in uniq]
+        num_ids, num_vals = segment.aux_cached(num_key, _compute_num)
+        dim_col = f"__numdim_{spec.dimension}"
 
     fn = spec.extraction_fn
     whitelist = None
@@ -85,6 +102,11 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
         is_white = spec.is_whitelist
 
     if fn is None and whitelist is None:
+        if col is None:
+            return KeyDim(dim_col, max(len(num_vals), 1), None,
+                          host_ids=num_ids,
+                          ids_key=("numdim_ids", spec.dimension)), \
+                (num_vals or [""])
         return KeyDim(spec.dimension, col.cardinality, None), col.dictionary.values
 
     cache_key = ("keydim", spec.dimension,
@@ -93,7 +115,10 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
                  is_white)
 
     def _compute():
-        vals = col.dictionary.values
+        # extraction fns see the STRING form of numeric values (reference
+        # ExtractionFn contract)
+        vals = [str(v) for v in num_vals] if col is None \
+            else col.dictionary.values
         raw = fn.apply_all(vals) if fn else vals
         outs = ["" if o is None else str(o) for o in raw]
         keep = [True] * len(outs)
@@ -108,7 +133,9 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
         return remap, uniq
 
     remap, uniq = segment.aux_cached(cache_key, _compute)
-    return KeyDim(spec.dimension, max(len(uniq), 1), remap), (uniq or [""])
+    return KeyDim(dim_col, max(len(uniq), 1), remap, host_ids=num_ids,
+                  ids_key=("numdim_ids", spec.dimension)
+                  if num_ids is not None else None), (uniq or [""])
 
 
 def _bucket_starts(granularity: Granularity,

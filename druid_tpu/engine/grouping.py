@@ -67,10 +67,18 @@ class KeyDim:
     column=None means the dimension is absent from the segment — it
     contributes a constant id 0 (value "" at decode time), matching the
     reference's treatment of missing columns as null.
+
+    host_ids set means the ids come from a derived host array rather than a
+    segment dim column (numeric dimension handlers: a query-time dictionary
+    over a metric column's values — DoubleDimensionHandler capability);
+    `column` is then a synthetic name the executor stages the array under,
+    and ids_key its cache identity for the padded device copy.
     """
     column: Optional[str]
     cardinality: int             # output cardinality (after remap)
     remap: Optional[np.ndarray]  # int32[input_card] -> output id or -1
+    host_ids: Optional[np.ndarray] = None
+    ids_key: Optional[Tuple] = None
 
 
 @dataclass
@@ -130,7 +138,8 @@ def _fused_raw_keys(segment: Segment, bucket_mode: str, bucket_starts,
     for d in dims:
         if d.column is None:
             continue
-        ids = segment.dims[d.column].ids
+        ids = d.host_ids if d.host_ids is not None \
+            else segment.dims[d.column].ids
         if d.remap is not None:
             ids = d.remap[ids]
             valid &= ids >= 0
@@ -522,7 +531,8 @@ def windowed_window(segment: Segment, intervals: Sequence[Interval],
         for d in spec.dims:
             if d.column is None:
                 continue
-            ids = segment.dims[d.column].ids
+            ids = d.host_ids if d.host_ids is not None \
+                else segment.dims[d.column].ids
             if d.remap is not None:
                 ids = d.remap[ids]
                 ok = ok & (ids >= 0)
@@ -808,7 +818,8 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                    if c in segment.dims or c in segment.metrics}
     needed = set(base_needed)
     for d in spec.dims:
-        if spec.key_mode == "dense" and d.column is not None:
+        if spec.key_mode == "dense" and d.column is not None \
+                and d.host_ids is None:
             needed.add(d.column)
 
     # strategy BEFORE staging: the projection path stages a permuted layout,
@@ -822,6 +833,10 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
     for c in needed:
         col_dtypes[c] = np.dtype(np.int32) if c in segment.dims \
             else np.dtype(segment.staged_dtype(c))
+    if spec.key_mode == "dense":
+        for d in spec.dims:
+            if d.host_ids is not None:
+                col_dtypes[d.column] = np.dtype(np.int32)
     if spec.key_mode == "host":
         col_dtypes["__key"] = np.dtype(np.int32)
     elif spec.bucket_mode == "host":
@@ -853,6 +868,13 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
     block = segment.device_block(sorted(needed), perm=perm, perm_key=perm_key)
 
     arrays = dict(block.arrays)
+    if spec.key_mode == "dense":
+        for d in spec.dims:
+            if d.host_ids is not None:
+                # derived id column (numeric dimension): staged via the
+                # bounded device cache like any other derived key column
+                arrays[d.column] = _pad_device_cached(
+                    segment, d.ids_key, d.host_ids, block.padded_rows, 0)
     if spec.key_mode == "host":
         arrays["__key"] = _pad_device_cached(
             segment, spec.host_keys_cache, spec.host_keys,

@@ -97,7 +97,10 @@ def merge_partials(partials: Sequence[SegmentPartial],
         for p_i, (p, nz, buckets, dim_ids) in enumerate(compacted):
             local_vals = dim_values[p_i][d]
             vals.update(local_vals[int(i)] for i in np.unique(dim_ids[d]))
-        ordered = sorted(vals)
+        # numbers (numeric dims) sort before strings so mixed schemas
+        # (column numeric in one segment, absent -> "" in another) never
+        # compare across types
+        ordered = sorted(vals, key=lambda v: (isinstance(v, str), v))
         merged_values.append(ordered)
         value_to_merged.append({v: i for i, v in enumerate(ordered)})
 

@@ -86,6 +86,11 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     n_dev = mesh.shape[axis]
 
     kds = list(kds_per_seg[0])
+    if any(d.host_ids is not None for d in kds):
+        # numeric-dimension ids are per-segment query-time dictionaries —
+        # a stacked program cannot share one id space; per-segment path
+        # merges them host-side
+        return None
     for other in kds_per_seg[1:]:
         if not _keydims_equal(kds, other):
             return None

@@ -32,13 +32,20 @@ class LookupReferencesManager:
         self._lock = threading.Lock()
         self._lookups: Dict[str, LookupContainer] = {}
 
+    @staticmethod
+    def _version_key(v: str):
+        # length-then-lexicographic: numeric suffixes compare naturally
+        # ("v9" < "v10"), equal-length versions compare lexicographically
+        return (len(v), v)
+
     def add(self, name: str, mapping: Dict[str, str],
             version: str = "v0") -> bool:
         """Register/replace; a replace with a version <= current is a no-op
         (mirrors LookupReferencesManager version-gated updates)."""
         with self._lock:
             cur = self._lookups.get(name)
-            if cur is not None and version <= cur.version:
+            if cur is not None and \
+                    self._version_key(version) <= self._version_key(cur.version):
                 return False
             self._lookups[name] = LookupContainer(name, dict(mapping), version)
             return True

@@ -2,7 +2,19 @@ from druid_tpu.server.lifecycle import QueryLifecycle, RequestLogger
 from druid_tpu.server.http import QueryHttpServer
 from druid_tpu.server.querymanager import (Deadline, QueryInterruptedError,
                                            QueryManager, QueryTimeoutError)
+from druid_tpu.server.router import (Router, RouterHttpServer,
+                                     TieredBrokerSelector)
+from druid_tpu.server.security import (AllowAllAuthenticator,
+                                       AllowAllAuthorizer, AuthChain,
+                                       AuthenticationResult,
+                                       BasicHTTPAuthenticator, Escalator,
+                                       Permission, RoleBasedAuthorizer,
+                                       authorizer_for_query)
 
 __all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer",
            "QueryManager", "Deadline", "QueryInterruptedError",
-           "QueryTimeoutError"]
+           "QueryTimeoutError", "Router", "RouterHttpServer",
+           "TieredBrokerSelector", "AuthChain", "AuthenticationResult",
+           "AllowAllAuthenticator", "BasicHTTPAuthenticator",
+           "AllowAllAuthorizer", "RoleBasedAuthorizer", "Permission",
+           "Escalator", "authorizer_for_query"]

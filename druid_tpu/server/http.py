@@ -46,9 +46,15 @@ class QueryHttpServer:
     """Serves a QueryLifecycle (+ optional SqlExecutor) over HTTP."""
 
     def __init__(self, lifecycle: QueryLifecycle, sql_executor=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_chain=None):
+        """auth_chain: optional server.security.AuthChain — requests
+        authenticate at the HTTP boundary (401 on failure) and the
+        resulting AuthenticationResult flows into the lifecycle, whose
+        authorizer makes the per-datasource decision (403)."""
         self.lifecycle = lifecycle
         self.sql_executor = sql_executor
+        self.auth_chain = auth_chain
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -67,23 +73,59 @@ class QueryHttpServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            def _authenticated(self) -> bool:
+                """Non-POST paths also sit behind the chain (the reference
+                wraps EVERY resource in the auth filter); /status stays
+                open for load-balancer health checks."""
+                if outer.auth_chain is None:
+                    return True
+                if outer.auth_chain.authenticate(dict(self.headers)) is None:
+                    self._reply(401, {"error": "unauthenticated"})
+                    return False
+                return True
+
             def do_GET(self):
                 if self.path == "/status":
                     self._reply(200, {"version": "druid-tpu-0.1",
                                       "modules": []})
                 elif self.path in ("/druid/v2/datasources",
                                    "/druid/v2/datasources/"):
-                    self._reply(200, outer._datasources())
+                    if self._authenticated():
+                        self._reply(200, outer._datasources())
                 else:
                     self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
                 try:
+                    identity = self.headers.get("X-Druid-Identity")
+                    if outer.auth_chain is not None:
+                        auth = outer.auth_chain.authenticate(
+                            dict(self.headers))
+                        if auth is None:
+                            self._reply(401, {"error": "unauthenticated"})
+                            return
+                        identity = auth
                     payload = self._body()
                     if self.path.rstrip("/") == "/druid/v2/sql":
                         if outer.sql_executor is None:
                             self._reply(404, {"error": "SQL not enabled"})
                             return
+                        if outer.auth_chain is not None:
+                            # SQL authorizes over the statement's tables —
+                            # the same per-datasource decision the native
+                            # path makes (SqlResource)
+                            from druid_tpu.server.security import (
+                                READ, Resource, ResourceAction)
+                            tables, is_meta = outer.sql_executor.tables_of(
+                                payload["query"],
+                                payload.get("parameters") or ())
+                            if not is_meta and not \
+                                    outer.auth_chain.authorize_all(
+                                        identity,
+                                        [ResourceAction(Resource(t), READ)
+                                         for t in tables]):
+                                self._reply(403, {"error": "unauthorized"})
+                                return
                         cols, rows = outer.sql_executor.execute(
                             payload["query"],
                             payload.get("parameters") or ())
@@ -95,8 +137,7 @@ class QueryHttpServer:
                                               for r in rows])
                     elif self.path.rstrip("/") == "/druid/v2":
                         rows = outer.lifecycle.run_json(
-                            payload, identity=self.headers.get(
-                                "X-Druid-Identity"))
+                            payload, identity=identity)
                         self._reply(200, rows)
                     else:
                         self._reply(404, {"error": "unknown path"})
@@ -119,6 +160,8 @@ class QueryHttpServer:
                 # DELETE /druid/v2/{id} — QueryResource.cancelQuery:
                 # 202 accepted whether or not the id was in flight
                 from druid_tpu.server.querymanager import cancel_path_id
+                if not self._authenticated():
+                    return
                 qid = cancel_path_id(self.path)
                 if qid is not None:
                     found = outer.lifecycle.cancel(qid)

@@ -63,6 +63,22 @@ class SqlExecutor:
         rows = self.qe.run(planned.native)
         return self._shape(planned, rows)
 
+    def tables_of(self, sql: str, parameters: Sequence[object] = ()
+                  ) -> Tuple[List[str], bool]:
+        """(datasources a statement reads, is_information_schema) — the
+        authorization surface (reference: SqlResource resource-action
+        collection before execution)."""
+        sel = parse_sql(sql, parameters)
+        planned = plan_sql(sel, self.schema())
+        if planned.meta_table is not None:
+            return [], True
+        tables: List[str] = []
+        q = planned.native
+        while q is not None:
+            tables += list(q.union_datasources or (q.datasource,))
+            q = q.inner_query
+        return sorted({t for t in tables if t}), False
+
     def execute_dicts(self, sql: str, parameters: Sequence[object] = ()
                       ) -> List[dict]:
         cols, rows = self.execute(sql, parameters)

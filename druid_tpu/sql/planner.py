@@ -549,9 +549,8 @@ def _dimension_spec(e, alias: str, table: str, schema: SqlSchema,
         t = schema.type_of(table, e.name)
         if t is None:
             raise PlannerError(f"unknown column [{e.name}]")
-        if t != "string":
-            raise PlannerError(
-                f"GROUP BY numeric column [{e.name}] not supported yet")
+        # numeric columns group through the engine's numeric dimension
+        # handler (query-time value dictionary)
         return DefaultDimensionSpec(e.name, alias)
     if isinstance(e, P.Fn) and e.name == "SUBSTRING" \
             and isinstance(e.args[0], P.Col):

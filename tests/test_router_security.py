@@ -1,0 +1,317 @@
+"""Router tier selection/forwarding, security SPI chain, and cluster-wide
+lookup management (reference: AsyncQueryForwardingServlet,
+TieredBrokerHostSelector, Authenticator/Authorizer/Escalator,
+LookupCoordinatorManager)."""
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from druid_tpu.cluster import MetadataStore
+from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                       LookupNodeSync)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.lookup import LookupReferencesManager
+from druid_tpu.server.http import QueryHttpServer
+from druid_tpu.server.lifecycle import QueryLifecycle, Unauthorized
+from druid_tpu.server.router import (Router, RouterHttpServer,
+                                     TieredBrokerSelector)
+from druid_tpu.server.security import (AllowAllAuthorizer, AuthChain,
+                                       BasicHTTPAuthenticator, Escalator,
+                                       Permission, READ, RoleBasedAuthorizer,
+                                       authorizer_for_query)
+from druid_tpu.utils.intervals import Interval
+
+TS_Q = {"queryType": "timeseries", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-08"], "granularity": "all",
+        "aggregations": [{"type": "count", "name": "n"}]}
+
+
+class FakeBroker:
+    def __init__(self, name):
+        self.name = name
+        self.calls = []
+
+    def run_json(self, payload):
+        self.calls.append(payload)
+        return [{"broker": self.name}]
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+def test_router_manual_and_default_tier():
+    hot, cold = FakeBroker("hot"), FakeBroker("cold")
+    sel = TieredBrokerSelector({"hot": [hot], "_default": [cold]},
+                               default_tier="_default")
+    router = Router(sel)
+    assert router.run_json(TS_Q) == [{"broker": "cold"}]
+    q2 = {**TS_Q, "context": {"brokerService": "hot"}}
+    assert router.run_json(q2) == [{"broker": "hot"}]
+
+
+def test_router_priority_tier():
+    hot, low = FakeBroker("hot"), FakeBroker("low")
+    sel = TieredBrokerSelector({"hot": [hot], "low": [low]},
+                               default_tier="hot", min_priority=0,
+                               priority_tier="low")
+    router = Router(sel)
+    assert router.run_json(
+        {**TS_Q, "context": {"priority": -5}}) == [{"broker": "low"}]
+    assert router.run_json(TS_Q) == [{"broker": "hot"}]
+
+
+def test_router_datasource_period_rule():
+    hot, cold = FakeBroker("hot"), FakeBroker("cold")
+    sel = TieredBrokerSelector(
+        {"hot": [hot], "_default": [cold]}, default_tier="_default",
+        rules={"test": [{"periodMs": 30 * 86_400_000, "tier": "hot"}]})
+    now = Interval.of("2026-01-07", "2026-01-08").start
+    tier, b = sel.pick(TS_Q, now_ms=now)        # recent interval → hot
+    assert tier == "hot"
+    old_q = {**TS_Q, "intervals": ["2020-01-01/2020-01-02"]}
+    tier, b = sel.pick(old_q, now_ms=now)
+    assert tier == "_default"
+
+
+def test_router_round_robin_within_tier():
+    b1, b2 = FakeBroker("a"), FakeBroker("b")
+    sel = TieredBrokerSelector({"_default": [b1, b2]},
+                               default_tier="_default")
+    router = Router(sel)
+    seen = {router.run_json(TS_Q)[0]["broker"] for _ in range(4)}
+    assert seen == {"a", "b"}
+    assert len(b1.calls) == len(b2.calls) == 2
+
+
+def test_router_http_proxies_to_broker_http(segments):
+    """Full proxy path: router HTTP → broker HTTP → engine."""
+    ex = QueryExecutor(segments)
+    lc = QueryLifecycle(ex)
+    broker_http = QueryHttpServer(lc).start()
+    sel = TieredBrokerSelector(
+        {"_default": [f"http://127.0.0.1:{broker_http.port}"]},
+        default_tier="_default")
+    router_http = RouterHttpServer(sel).start()
+    try:
+        body = json.dumps(TS_Q).encode()
+        req = urllib.request.Request(
+            router_http.url + "/druid/v2", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            rows = json.loads(r.read())
+        assert rows[0]["result"]["n"] == sum(s.n_rows for s in segments)
+    finally:
+        router_http.stop()
+        broker_http.stop()
+
+
+# ---------------------------------------------------------------------------
+# Security chain
+# ---------------------------------------------------------------------------
+
+def _chain():
+    authz = RoleBasedAuthorizer(
+        role_permissions={
+            "analyst": [Permission("test", actions=(READ,))],
+            "admin": [Permission("*")]},
+        user_roles={"alice": ["analyst"], "root": ["admin"]})
+    return AuthChain(
+        authenticators=[BasicHTTPAuthenticator(
+            {"alice": "pw1", "root": "pw2"}, authorizer_name="rbac")],
+        authorizers={"rbac": authz, "allowAll": AllowAllAuthorizer()})
+
+
+def _basic(user, pw):
+    return {"Authorization":
+            "Basic " + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+
+def test_authenticator_chain():
+    chain = _chain()
+    assert chain.authenticate(_basic("alice", "pw1")).identity == "alice"
+    assert chain.authenticate(_basic("alice", "wrong")) is None
+    assert chain.authenticate({}) is None
+    # escalated internal identity bypasses user ACLs via its own authorizer
+    assert chain.escalator.escalate().authorizer_name == "allowAll"
+
+
+def test_rbac_authorization_per_datasource(segments):
+    chain = _chain()
+    lc = QueryLifecycle(QueryExecutor(segments),
+                        authorizer=authorizer_for_query(chain))
+    alice = chain.authenticate(_basic("alice", "pw1"))
+    rows = lc.run_json(TS_Q, identity=alice)
+    assert rows[0]["result"]["n"] > 0
+    with pytest.raises(Unauthorized):
+        lc.run_json({**TS_Q, "dataSource": "secret"}, identity=alice)
+    root = chain.authenticate(_basic("root", "pw2"))
+    assert lc.run_json(TS_Q, identity=root)
+    with pytest.raises(Unauthorized):
+        lc.run_json(TS_Q, identity=None)
+
+
+def test_http_auth_401_and_403(segments):
+    chain = _chain()
+    lc = QueryLifecycle(QueryExecutor(segments),
+                        authorizer=authorizer_for_query(chain))
+    srv = QueryHttpServer(lc, auth_chain=chain).start()
+    url = f"http://127.0.0.1:{srv.port}/druid/v2"
+    try:
+        body = json.dumps(TS_Q).encode()
+
+        def post(headers):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json", **headers},
+                method="POST")
+            return urllib.request.urlopen(req, timeout=30)
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({})                               # no credentials
+        assert e.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post(_basic("alice", "nope"))          # bad credentials
+        assert e.value.code == 401
+        rows = json.loads(post(_basic("alice", "pw1")).read())
+        assert rows[0]["result"]["n"] > 0
+        bad = json.dumps({**TS_Q, "dataSource": "secret"}).encode()
+        req = urllib.request.Request(
+            url, data=bad, headers={"Content-Type": "application/json",
+                                    **_basic("alice", "pw1")},
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 403                 # authenticated, denied
+    finally:
+        srv.stop()
+
+
+def test_bad_basic_credentials_do_not_fall_through():
+    """Wrong password on a PRESENT Basic header must deny the request, not
+    launder into a weaker downstream authenticator."""
+    from druid_tpu.server.security import AllowAllAuthenticator
+    chain = AuthChain(
+        authenticators=[BasicHTTPAuthenticator({"alice": "pw1"}),
+                        AllowAllAuthenticator()],
+        authorizers={"allowAll": AllowAllAuthorizer()})
+    assert chain.authenticate(_basic("alice", "WRONG")) is None
+    assert chain.authenticate({}).identity == "allowAll"  # truly anonymous
+
+
+def test_sql_endpoint_authorizes_tables(segments):
+    chain = _chain()
+    from druid_tpu.sql import SqlExecutor
+    ex = QueryExecutor(segments)
+    lc = QueryLifecycle(ex, authorizer=authorizer_for_query(chain))
+    srv = QueryHttpServer(lc, sql_executor=SqlExecutor(ex),
+                          auth_chain=chain).start()
+    url = f"http://127.0.0.1:{srv.port}/druid/v2/sql"
+    try:
+        def post_sql(stmt, headers):
+            body = json.dumps({"query": stmt}).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json", **headers},
+                method="POST")
+            return urllib.request.urlopen(req, timeout=30)
+
+        rows = json.loads(
+            post_sql("SELECT COUNT(*) c FROM test",
+                     _basic("alice", "pw1")).read())
+        assert rows[0]["c"] > 0
+        # alice has no grant on any other table → 403, same as native path
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post_sql("SELECT COUNT(*) FROM test2",
+                     _basic("alice", "pw1"))
+        assert e.value.code in (400, 403)
+    finally:
+        srv.stop()
+
+
+def test_get_and_delete_require_auth(segments):
+    chain = _chain()
+    lc = QueryLifecycle(QueryExecutor(segments),
+                        authorizer=authorizer_for_query(chain))
+    srv = QueryHttpServer(lc, auth_chain=chain).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/druid/v2/datasources",
+                                   timeout=30)
+        assert e.value.code == 401
+        req = urllib.request.Request(base + "/druid/v2/qid1",
+                                     method="DELETE")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 401
+        # /status stays open for health checks
+        assert urllib.request.urlopen(base + "/status",
+                                      timeout=30).status == 200
+    finally:
+        srv.stop()
+
+
+def test_router_priority_tier_without_brokers_falls_back():
+    hot = FakeBroker("hot")
+    sel = TieredBrokerSelector({"hot": [hot]}, default_tier="hot",
+                               min_priority=0, priority_tier="cold")
+    router = Router(sel)
+    assert router.run_json(
+        {**TS_Q, "context": {"priority": -5}}) == [{"broker": "hot"}]
+
+
+def test_lookup_version_ordering_past_v9():
+    reg = LookupReferencesManager()
+    for i in range(12):
+        assert reg.add("x", {"n": str(i)}, version=f"v{i}")
+    assert reg.get("x").mapping == {"n": "11"}
+    assert not reg.add("x", {"n": "stale"}, version="v9")
+
+
+# ---------------------------------------------------------------------------
+# Lookup cluster management
+# ---------------------------------------------------------------------------
+
+def test_lookup_coordinator_push_and_node_sync():
+    md = MetadataStore()
+    mgr = LookupCoordinatorManager(md)
+    mgr.set_lookup("_default", "country_names", {"us": "United States"})
+    reg = LookupReferencesManager()
+    sync = LookupNodeSync(mgr, "_default", reg)
+    assert sync.poll() == 1
+    assert reg.get("country_names").mapping == {"us": "United States"}
+
+    # version-gated update propagates; unchanged spec is a no-op
+    assert sync.poll() == 0
+    mgr.set_lookup("_default", "country_names",
+                   {"us": "USA", "fr": "France"})
+    assert sync.poll() == 1
+    assert reg.get("country_names").mapping["fr"] == "France"
+
+    # deletion converges
+    mgr.delete_lookup("_default", "country_names")
+    assert sync.poll() == 1
+    assert reg.get("country_names") is None
+
+    # a freshly-started node converges from an empty registry
+    mgr.set_lookup("_default", "x", {"1": "one"})
+    reg2 = LookupReferencesManager()
+    assert LookupNodeSync(mgr, "_default", reg2).poll() == 1
+    assert reg2.get("x").mapping == {"1": "one"}
+
+
+def test_lookup_tiers_are_isolated():
+    md = MetadataStore()
+    mgr = LookupCoordinatorManager(md)
+    mgr.set_lookup("hot", "a", {"k": "hotval"})
+    mgr.set_lookup("cold", "a", {"k": "coldval"})
+    hot_reg, cold_reg = LookupReferencesManager(), LookupReferencesManager()
+    LookupNodeSync(mgr, "hot", hot_reg).poll()
+    LookupNodeSync(mgr, "cold", cold_reg).poll()
+    assert hot_reg.get("a").mapping == {"k": "hotval"}
+    assert cold_reg.get("a").mapping == {"k": "coldval"}
+    assert mgr.tiers() == ["cold", "hot"]

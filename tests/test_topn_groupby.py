@@ -188,6 +188,90 @@ def test_groupby_multi_segment(segments):
         assert ev["rows"] == total
 
 
+def test_groupby_numeric_long_dimension(segment):
+    """Grouping by a LONG metric column (numeric dimension handler): keys
+    are the numeric VALUES, exact."""
+    ex = QueryExecutor([segment])
+    q = GroupByQuery.of("test", DAY, ["metLong"], [CountAggregator("rows")])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    vals, counts = np.unique(frame["metLong"], return_counts=True)
+    got = {r["event"]["metLong"]: r["event"]["rows"] for r in rows}
+    assert got == {int(v): int(c) for v, c in zip(vals, counts)}
+    assert all(isinstance(k, int) for k in got)
+
+
+def test_groupby_numeric_double_dimension(segment):
+    ex = QueryExecutor([segment])
+    q = GroupByQuery.of("test", DAY, ["metDouble"],
+                        [CountAggregator("rows")])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    vals, counts = np.unique(frame["metDouble"], return_counts=True)
+    got = {r["event"]["metDouble"]: r["event"]["rows"] for r in rows}
+    assert len(got) == len(vals)
+    assert got == {float(v): int(c) for v, c in zip(vals, counts)}
+
+
+def test_groupby_mixed_string_numeric_dims(segment):
+    ex = QueryExecutor([segment])
+    q = GroupByQuery.of("test", DAY, ["dimA", "metLong"], AGGS)
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    want = golden_groupby([frame], [np.ones(segment.n_rows, bool)],
+                          ["dimA", "metLong"])
+    assert len(rows) == len(want)
+    for r in rows:
+        e = r["event"]
+        g = want[(e["dimA"], e["metLong"])]
+        assert e["rows"] == g["rows"] and e["sumLong"] == g["sumLong"]
+
+
+def test_groupby_numeric_multi_segment_merge(segments):
+    """Per-segment numeric value dictionaries differ; the host merge must
+    reconcile them by VALUE."""
+    ex = QueryExecutor(segments)
+    iv = Interval.of("2026-01-01", "2026-01-05")
+    q = GroupByQuery.of("test", iv, ["metLong"],
+                        [CountAggregator("rows"),
+                         LongSumAggregator("sumLong", "metLong")])
+    rows = ex.run(q)
+    frames = [rows_as_frame(s) for s in segments]
+    allv = np.concatenate([f["metLong"] for f in frames])
+    vals, counts = np.unique(allv, return_counts=True)
+    got = {r["event"]["metLong"]: r["event"]["rows"] for r in rows}
+    assert got == {int(v): int(c) for v, c in zip(vals, counts)}
+    for r in rows:
+        e = r["event"]
+        assert e["sumLong"] == e["metLong"] * e["rows"]
+
+
+def test_topn_numeric_dimension(segment):
+    ex = QueryExecutor([segment])
+    q = TopNQuery.of("test", DAY, "metLong", metric="rows", threshold=5,
+                     aggregations=[CountAggregator("rows")])
+    rows = ex.run(q)
+    frame = rows_as_frame(segment)
+    vals, counts = np.unique(frame["metLong"], return_counts=True)
+    order = np.argsort(-counts, kind="stable")
+    want_top = int(counts[order[0]])
+    got = rows[0]["result"]
+    assert len(got) == 5
+    assert got[0]["rows"] == want_top
+    assert all(isinstance(e["metLong"], int) for e in got)
+
+
+def test_sql_group_by_numeric(segment):
+    from druid_tpu.sql import SqlExecutor
+    sql = SqlExecutor(QueryExecutor([segment]))
+    cols, rows = sql.execute(
+        "SELECT metLong, COUNT(*) c FROM test GROUP BY metLong "
+        "ORDER BY c DESC LIMIT 3")
+    frame = rows_as_frame(segment)
+    vals, counts = np.unique(frame["metLong"], return_counts=True)
+    assert rows[0][1] == int(counts.max())
+
+
 def test_groupby_missing_dimension(segment):
     ex = QueryExecutor([segment])
     q = GroupByQuery.of("test", DAY, ["nonexistent"], [CountAggregator("rows")])
