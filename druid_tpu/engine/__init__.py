@@ -5,9 +5,26 @@ timestamps are int64 host-side. Device kernels still use int32/float32 where
 hot (time offsets, dictionary ids, float metrics); int64 work on TPU lowers
 to emulated 32-bit pairs only where a query actually asks for longs.
 """
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# persistent XLA compilation cache: repeated-shape queries skip the 20-40s
+# cold compile across PROCESSES (the reference's warm JVM + code cache have
+# no cold-start; this is our equivalent). Opt out with
+# DRUID_TPU_COMPILE_CACHE=0; override the directory by setting it to a path.
+_cc = os.environ.get("DRUID_TPU_COMPILE_CACHE", "")
+if _cc != "0":
+    cache_dir = _cc if _cc not in ("", "1") else os.path.expanduser(
+        "~/.cache/druid_tpu/xla")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # cache is an optimization, never a failure
+        pass
 
 from druid_tpu.engine.executor import QueryExecutor  # noqa: E402
 

@@ -1,11 +1,12 @@
 from druid_tpu.indexing.locks import LockType, TaskLock, TaskLockbox
 from druid_tpu.indexing.task import (CompactionTask, IndexTask, KillTask,
-                                     Task, TaskStatus, task_from_json)
+                                     ParallelIndexTask, Task, TaskStatus,
+                                     task_from_json)
 from druid_tpu.indexing.overlord import Overlord, TaskToolbox
 from druid_tpu.indexing.forking import ForkingTaskRunner, TaskActionServer
 
 __all__ = [
     "TaskLockbox", "TaskLock", "LockType", "Task", "TaskStatus", "IndexTask",
     "CompactionTask", "KillTask", "task_from_json", "Overlord", "TaskToolbox",
-    "ForkingTaskRunner", "TaskActionServer",
+    "ForkingTaskRunner", "TaskActionServer", "ParallelIndexTask",
 ]

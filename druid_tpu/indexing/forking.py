@@ -42,9 +42,12 @@ class TaskActionServer:
     observability and tests."""
 
     def __init__(self, metadata: MetadataStore, lockbox: TaskLockbox,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, runner=None):
         self.metadata = metadata
         self.lockbox = lockbox
+        #: the runner sub-task submissions fan out on (set by the runner
+        #: that owns this server)
+        self.runner = runner
         self.actions: List[dict] = []          # received action log
         self.statuses: Dict[str, TaskStatus] = {}
         self.heartbeats: Dict[str, float] = {}
@@ -121,11 +124,14 @@ class TaskActionServer:
         with self._lock:
             self.actions.append({"task": task_id, "action": action})
         if action == "lock":
+            from druid_tpu.indexing.locks import LockType
+            lt = LockType(args.get("lockType", "exclusive"))
             out = []
             for iv_s in args["intervals"]:
                 lk = self.lockbox.acquire(task_id, args["datasource"],
                                           Interval.parse(iv_s),
-                                          priority=args.get("priority", 50))
+                                          priority=args.get("priority", 50),
+                                          lock_type=lt)
                 if lk is None:
                     self.lockbox.release_all(task_id)
                     return {"lock": None}
@@ -154,6 +160,22 @@ class TaskActionServer:
         if action == "delete_segments":
             self.metadata.delete_segments(args["ids"])
             return {"ok": True}
+        if action == "submit_task":
+            # supervisor tasks (ParallelIndexTask) fan sub-tasks out
+            # through the overlord — each gets its own peon
+            if self.runner is None:
+                raise ValueError("no task runner attached")
+            from druid_tpu.indexing.task import task_from_json
+            sub = task_from_json(args["spec"])
+            self.runner.submit(sub)
+            return {"ok": True, "task": sub.id}
+        if action == "task_status":
+            if self.runner is None:
+                raise ValueError("no task runner attached")
+            st = self.runner.status(args["id"])
+            if st is None:
+                return {"state": "UNKNOWN", "error": None}
+            return {"state": st.state, "error": st.error}
         raise ValueError(f"unknown task action {action!r}")
 
 
@@ -224,6 +246,28 @@ class _RemoteLockbox:
         return bool(self._a.call("is_revoked")["revoked"])
 
 
+class _RemoteTaskRunner:
+    """Peon-side sub-task fan-out: submissions go to the overlord's action
+    endpoint, which forks a peon per sub-task; await polls status (the
+    reference supervisor task's HTTP round to the overlord)."""
+
+    def __init__(self, actions: _RemoteActions, poll_interval: float = 0.2):
+        self._a = actions
+        self.poll_interval = poll_interval
+
+    def submit(self, task: Task) -> str:
+        return self._a.call("submit_task", spec=task.to_json())["task"]
+
+    def await_task(self, task_id: str, timeout: float = 600.0) -> TaskStatus:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            r = self._a.call("task_status", id=task_id)
+            if r["state"] in ("SUCCESS", "FAILED"):
+                return TaskStatus(task_id, r["state"], r.get("error"))
+            time.sleep(self.poll_interval)
+        raise TimeoutError(f"sub-task {task_id} still running")
+
+
 class PeonToolbox:
     """TaskToolbox for a forked peon: lock/publish/metadata actions go to
     the overlord over HTTP; segment bytes go straight to shared deep
@@ -235,12 +279,15 @@ class PeonToolbox:
         self.deep_storage = deep_storage
         self.metadata = _RemoteMetadata(actions)
         self.lockbox = _RemoteLockbox(actions)
+        self.task_runner = _RemoteTaskRunner(actions)
 
-    def lock(self, task: Task, intervals: Sequence[Interval]):
+    def lock(self, task: Task, intervals: Sequence[Interval],
+             lock_type=None):
         from druid_tpu.utils.intervals import condense
         r = self._a.call("lock", datasource=task.datasource,
                          intervals=[str(iv) for iv in condense(intervals)],
-                         priority=task.priority)
+                         priority=task.priority,
+                         lockType=getattr(lock_type, "value", "exclusive"))
         lk = r.get("lock")
         return _PeonLock(lk["version"]) if lk else None
 
@@ -321,6 +368,7 @@ class ForkingTaskRunner:
         self._lock = threading.Lock()
         self._listeners: List[Callable[[TaskStatus], None]] = []
         self._shutdown = False
+        self.actions.runner = self
 
     def add_listener(self, fn: Callable[[TaskStatus], None]) -> None:
         self._listeners.append(fn)
@@ -421,6 +469,21 @@ class ForkingTaskRunner:
     def run_task(self, task: Task, timeout: float = 300.0) -> TaskStatus:
         self.submit(task)
         return self.await_task(task.id, timeout)
+
+    def task_log(self, task_id: str) -> str:
+        """The task's captured stdout/stderr across all peon attempts
+        (reference: TaskLogStreamer / overlord GET /task/{id}/log)."""
+        spec = self._specs.get(task_id)
+        if spec is None:
+            return ""
+        import glob as globlib
+        parts = []
+        for path in sorted(globlib.glob(spec + ".log.*")):
+            attempt = path.rsplit(".", 1)[-1]
+            with open(path, "rb") as f:
+                parts.append(f"--- attempt {attempt} ---\n"
+                             + f.read().decode(errors="replace"))
+        return "\n".join(parts)
 
     def shutdown(self) -> None:
         # order matters: the flag stops monitors from re-forking the peons

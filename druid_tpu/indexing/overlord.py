@@ -26,21 +26,27 @@ from druid_tpu.utils.intervals import Interval, condense
 
 class TaskToolbox:
     """What a running task may touch (reference TaskToolbox): metadata
-    actions, the lockbox, deep storage push/pull."""
+    actions, the lockbox, deep storage push/pull, and (for supervisor
+    tasks) the task runner to fan sub-tasks out on."""
 
     def __init__(self, metadata: MetadataStore, lockbox: TaskLockbox,
-                 deep_storage: DeepStorage):
+                 deep_storage: DeepStorage, task_runner=None):
         self.metadata = metadata
         self.lockbox = lockbox
         self.deep_storage = deep_storage
+        self.task_runner = task_runner
 
-    def lock(self, task: Task, intervals: Sequence[Interval]
-             ) -> Optional[TaskLock]:
-        """LockAcquireAction: one lock covering the task's intervals."""
+    def lock(self, task: Task, intervals: Sequence[Interval],
+             lock_type=None) -> Optional[TaskLock]:
+        """LockAcquireAction: one lock covering the task's intervals.
+        Appending tasks take SHARED locks so parallel sub-tasks / streaming
+        replicas can append to one interval concurrently."""
+        from druid_tpu.indexing.locks import LockType
+        lt = lock_type or LockType.EXCLUSIVE
         locks = []
         for iv in condense(intervals):
             l = self.lockbox.acquire(task.id, task.datasource, iv,
-                                     priority=task.priority)
+                                     priority=task.priority, lock_type=lt)
             if l is None:
                 self.lockbox.release_all(task.id)
                 return None
@@ -79,7 +85,11 @@ class Overlord:
         self._listeners: List[Callable[[TaskStatus], None]] = []
 
     def toolbox(self) -> TaskToolbox:
-        return TaskToolbox(self.metadata, self.lockbox, self.deep_storage)
+        # sub-tasks get DEDICATED threads: a supervisor task blocks one of
+        # the bounded pool's workers while awaiting its sub-tasks, so
+        # scheduling those on the same pool deadlocks under exhaustion
+        return TaskToolbox(self.metadata, self.lockbox, self.deep_storage,
+                           task_runner=_DedicatedSubtaskRunner(self))
 
     def add_listener(self, fn: Callable[[TaskStatus], None]) -> None:
         self._listeners.append(fn)
@@ -126,3 +136,35 @@ class Overlord:
 
     def shutdown(self):
         self._pool.shutdown(wait=True)
+
+
+class _DedicatedSubtaskRunner:
+    """Runs sub-tasks on their own threads (never the overlord's bounded
+    pool) — see Overlord.toolbox. Status/lock bookkeeping goes through the
+    overlord's _run so sub-tasks are observable like any other task."""
+
+    def __init__(self, overlord: Overlord):
+        self.overlord = overlord
+        self._threads: Dict[str, threading.Thread] = {}
+        self._results: Dict[str, TaskStatus] = {}
+
+    def submit(self, task: Task) -> str:
+        if task.id in self._threads:
+            return task.id
+        self.overlord.metadata.insert_task(task.id, task.datasource,
+                                           "RUNNING", task.to_json())
+
+        def run():
+            self._results[task.id] = self.overlord._run(task)
+
+        t = threading.Thread(target=run, daemon=True)
+        self._threads[task.id] = t
+        t.start()
+        return task.id
+
+    def await_task(self, task_id: str, timeout: float = 600.0) -> TaskStatus:
+        t = self._threads[task_id]
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(f"sub-task {task_id} still running")
+        return self._results[task_id]

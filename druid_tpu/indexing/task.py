@@ -173,7 +173,10 @@ class IndexTask(Task):
         intervals = condense([
             Interval(st, self.segment_granularity.next_bucket(st))
             for st in buckets])
-        lock = toolbox.lock(self, intervals)
+        from druid_tpu.indexing.locks import LockType
+        lock = toolbox.lock(self, intervals,
+                            lock_type=LockType.SHARED if self.appending
+                            else LockType.EXCLUSIVE)
         if lock is None:
             return TaskStatus.failure(self.id, "could not acquire lock")
 
@@ -252,6 +255,87 @@ class IndexTask(Task):
                             for v in (col[i] for col in cols)]) % n_parts
                  for i in range(len(batch))], dtype=np.int64)
         return np.arange(len(batch), dtype=np.int64) % n_parts
+
+
+class ParallelIndexTask(Task):
+    """Parallel single-phase batch ingest (reference:
+    indexing-service/.../parallel/ParallelIndexSupervisorTask.java, dynamic
+    partitioning mode): the supervisor splits the firehose, fans sub-
+    IndexTasks out over the task runner (forked peons under
+    ForkingTaskRunner), and each sub-task allocates + transactionally
+    publishes its own appended segments — the same per-bucket allocator
+    streaming uses, so concurrent sub-tasks get sibling partitions, never
+    overshadowing ones."""
+    task_type = "index_parallel"
+    priority = 50
+
+    def __init__(self, datasource: str, firehose: Firehose,
+                 parser: Optional[InputRowParser],
+                 metric_specs: Sequence[A.AggregatorSpec],
+                 dimensions: Optional[Sequence[str]] = None,
+                 transform: Optional[TransformSpec] = None,
+                 segment_granularity: str = "day",
+                 query_granularity: str = "none",
+                 rollup: bool = True,
+                 tuning: Optional[IndexTuningConfig] = None,
+                 max_num_subtasks: int = 4,
+                 task_id: Optional[str] = None):
+        super().__init__(task_id, datasource)
+        self.firehose = firehose
+        self.parser = parser
+        self.metric_specs = list(metric_specs)
+        self.dimensions = list(dimensions) if dimensions else None
+        self.transform = transform
+        self.segment_granularity = Granularity.of(segment_granularity)
+        self.query_granularity = query_granularity
+        self.rollup = rollup
+        self.tuning = tuning or IndexTuningConfig()
+        self.max_num_subtasks = max_num_subtasks
+        self.appending = False   # for IndexTask.to_json reuse
+
+    def _subtasks(self) -> List[IndexTask]:
+        return [IndexTask(
+            self.datasource, split, self.parser, self.metric_specs,
+            dimensions=self.dimensions, transform=self.transform,
+            segment_granularity=str(self.segment_granularity),
+            query_granularity=self.query_granularity, rollup=self.rollup,
+            tuning=self.tuning, task_id=f"{self.id}_sub{i}", appending=True)
+            for i, split in enumerate(
+                self.firehose.splits(self.max_num_subtasks))]
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        subtasks = self._subtasks()
+        runner = getattr(toolbox, "task_runner", None)
+        if runner is not None:
+            for t in subtasks:
+                runner.submit(t)
+            statuses = [runner.await_task(t.id) for t in subtasks]
+        else:
+            # no runner surface: degrade to sequential in-process
+            # execution — same results, no fan-out. Sub-task lock ids must
+            # be released here; no runner will ever do it for them.
+            statuses = []
+            for t in subtasks:
+                try:
+                    statuses.append(t.run(toolbox))
+                finally:
+                    release = getattr(toolbox.lockbox, "release_all", None)
+                    if callable(release):
+                        release(t.id)
+        failed = [s for s in statuses if s.state != "SUCCESS"]
+        if failed:
+            return TaskStatus.failure(
+                self.id, f"{len(failed)}/{len(statuses)} sub-tasks failed: "
+                f"{failed[0].error}")
+        return TaskStatus.success(self.id)
+
+    def to_json(self) -> dict:
+        j = IndexTask.to_json(self)
+        j["type"] = "index_parallel"
+        j["spec"]["tuningConfig"]["maxNumConcurrentSubTasks"] = \
+            self.max_num_subtasks
+        del j["appending"]
+        return j
 
 
 class CompactionTask(Task):
@@ -336,6 +420,17 @@ class KillTask(Task):
 
 def task_from_json(j: dict) -> Task:
     t = j["type"]
+    if t == "index_parallel":
+        base = task_from_json({**j, "type": "index"})
+        return ParallelIndexTask(
+            base.datasource, base.firehose, base.parser, base.metric_specs,
+            dimensions=base.dimensions, transform=base.transform,
+            segment_granularity=str(base.segment_granularity),
+            query_granularity=base.query_granularity, rollup=base.rollup,
+            tuning=base.tuning,
+            max_num_subtasks=j["spec"].get("tuningConfig", {}).get(
+                "maxNumConcurrentSubTasks", 4),
+            task_id=j.get("id"))
     if t == "index":
         from druid_tpu.ingest.input import firehose_from_json
         spec = j["spec"]

@@ -322,6 +322,12 @@ class Firehose:
         ship to forked peons (ForkingTaskRunner)."""
         raise NotImplementedError(f"{type(self).__name__} is not serializable")
 
+    def splits(self, n: int) -> List["Firehose"]:
+        """Partition into ≤ n independent firehoses for parallel ingest
+        (reference: SplittableInputSource.createSplits). Default:
+        unsplittable → one split."""
+        return [self]
+
 
 class InlineFirehose(Firehose):
     def __init__(self, records: Sequence):
@@ -333,6 +339,14 @@ class InlineFirehose(Firehose):
 
     def to_json(self) -> dict:
         return {"type": "inline", "data": list(self.records)}
+
+    def splits(self, n: int) -> List["Firehose"]:
+        if not self.records:
+            return [self]
+        n = max(1, min(n, len(self.records)))
+        per = -(-len(self.records) // n)
+        return [InlineFirehose(self.records[i:i + per])
+                for i in range(0, len(self.records), per)]
 
 
 class LocalFirehose(Firehose):
@@ -358,8 +372,23 @@ class LocalFirehose(Firehose):
 
 
     def to_json(self) -> dict:
+        # explicit paths so SPLIT instances round-trip exactly (a split
+        # shipped to a peon must not re-glob the whole directory)
         return {"type": "local", "baseDir": self.base_dir,
-                "filter": self.glob}
+                "filter": self.glob, "paths": list(self.paths)}
+
+    def splits(self, n: int) -> List["Firehose"]:
+        if len(self.paths) <= 1:
+            return [self]
+        n = max(1, min(n, len(self.paths)))
+        out = []
+        for i in range(n):
+            fh = LocalFirehose.__new__(LocalFirehose)
+            fh.base_dir = self.base_dir
+            fh.glob = self.glob
+            fh.paths = self.paths[i::n]
+            out.append(fh)
+        return out
 
 
 class CombiningFirehose(Firehose):
@@ -378,6 +407,13 @@ class CombiningFirehose(Firehose):
 def firehose_from_json(j: dict) -> Firehose:
     t = j.get("type")
     if t == "local":
+        if "paths" in j:
+            # explicit split: do NOT re-glob the directory
+            fh = LocalFirehose.__new__(LocalFirehose)
+            fh.base_dir = j["baseDir"]
+            fh.glob = j.get("filter", "*")
+            fh.paths = list(j["paths"])
+            return fh
         return LocalFirehose(j["baseDir"], j.get("filter", "*"))
     if t == "inline":
         return InlineFirehose(j.get("data", "").splitlines()

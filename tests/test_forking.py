@@ -125,6 +125,40 @@ def test_peon_that_always_dies_reports_failure(runner):
     assert md.used_segments("dead_ds") == []
 
 
+def test_parallel_index_fans_out_over_peons(runner):
+    """ParallelIndexTask's supervisor peon submits sub-tasks back to the
+    overlord, which forks one peon per sub-task
+    (ParallelIndexSupervisorTask dynamic-partitioning mode)."""
+    from druid_tpu.indexing import ParallelIndexTask
+    md, r = runner
+    recs = _records(4000, days=2)
+    task = ParallelIndexTask("par_ds", InlineFirehose(recs), None, SPECS,
+                             segment_granularity="day", max_num_subtasks=3)
+    status = r.run_task(task, timeout=180)
+    assert status.state == "SUCCESS", status.error
+    sub_ids = {a["task"] for a in r.actions.actions
+               if a["task"].startswith(f"{task.id}_sub")}
+    assert len(sub_ids) == 3
+    # every sub-task ran in its own forked peon
+    assert all(f"{task.id}_sub{i}" in r.processes for i in range(3))
+    descs = md.used_segments("par_ds")
+    assert len(descs) >= 2      # ≥ one appended partition per day bucket
+    segs = [r.deep_storage.pull(d) for d in descs]
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("par_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 4000
+    assert rows[0]["result"]["v"] == sum(x["value"] for x in recs)
+
+
+def test_task_log_captured(runner):
+    md, r = runner
+    task = IndexTask("log_ds", InlineFirehose(_records(100, days=1)), None,
+                     SPECS, segment_granularity="day")
+    assert r.run_task(task, timeout=120).state == "SUCCESS"
+    log = r.task_log(task.id)
+    assert "attempt 1" in log    # attempts are 1-based
+
+
 def test_forked_kill_task(runner):
     md, r = runner
     recs = _records(400, days=1)

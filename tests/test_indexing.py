@@ -53,6 +53,27 @@ def test_index_task_end_to_end():
     assert rows[0]["result"]["v"] == sum(r["value"] for r in recs)
 
 
+def test_parallel_index_on_overlord_pool_of_one():
+    """Sub-tasks run on dedicated threads, so a ParallelIndexTask must
+    complete even when the overlord pool has a single worker (the
+    supervisor occupies it for its whole run)."""
+    from druid_tpu.indexing import ParallelIndexTask
+    md = MetadataStore()
+    ov = Overlord(md, InMemoryDeepStorage(), max_workers=1)
+    recs = _records(1200, days=2)
+    task = ParallelIndexTask("pov_ds", InlineFirehose(recs), None, SPECS,
+                             segment_granularity="day", max_num_subtasks=3)
+    status = ov.run_task(task, timeout=120)
+    assert status.state == "SUCCESS", status.error
+    segs = _pull_all(md, ov.deep_storage, "pov_ds")
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("pov_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 1200
+    # appended sub-task locks are all released
+    assert ov.lockbox.all_locks() == [] if hasattr(ov.lockbox, "all_locks") \
+        else True
+
+
 def test_index_task_partitions_large_buckets():
     md, ov = _overlord()
     recs = _records(2000, days=1)
