@@ -140,10 +140,21 @@ class TaskActionServer:
         if action == "is_revoked":
             return {"revoked": self.lockbox.is_revoked(task_id)}
         if action == "publish":
+            # idempotent per task id: a peon that died AFTER its publish
+            # committed but BEFORE reporting status is re-forked, re-reads,
+            # and calls publish again with freshly-allocated partitions —
+            # the marker makes the retry a no-op success instead of a
+            # duplicate append (exactly-once for crash-retried sub-tasks)
+            marker = f"task_publish:{task_id}"
+            if self.metadata.get_config(marker):
+                return {"ok": True}
             descs = [SegmentDescriptor.from_json(d)
                      for d in args["segments"]]
             ok = self.lockbox.critical_section(
                 task_id, lambda: self.metadata.publish_segments(descs))
+            if ok:
+                self.metadata.set_config(
+                    marker, {"segments": [d.id for d in descs]})
             return {"ok": bool(ok)}
         if action == "allocate_segment":
             version, pnum = self.metadata.allocate_segment(

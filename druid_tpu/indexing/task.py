@@ -257,7 +257,7 @@ class IndexTask(Task):
         return np.arange(len(batch), dtype=np.int64) % n_parts
 
 
-class ParallelIndexTask(Task):
+class ParallelIndexTask(IndexTask):
     """Parallel single-phase batch ingest (reference:
     indexing-service/.../parallel/ParallelIndexSupervisorTask.java, dynamic
     partitioning mode): the supervisor splits the firehose, fans sub-
@@ -265,33 +265,19 @@ class ParallelIndexTask(Task):
     ForkingTaskRunner), and each sub-task allocates + transactionally
     publishes its own appended segments — the same per-bucket allocator
     streaming uses, so concurrent sub-tasks get sibling partitions, never
-    overshadowing ones."""
+    overshadowing ones.
+
+    Retry contract: resubmitting with the SAME task id is idempotent —
+    sub-task ids are deterministic and the overlord's publish marker makes
+    an already-committed sub-task's publish a no-op (a resubmission under
+    a NEW id re-appends everything a previous partial run committed)."""
     task_type = "index_parallel"
     priority = 50
 
-    def __init__(self, datasource: str, firehose: Firehose,
-                 parser: Optional[InputRowParser],
-                 metric_specs: Sequence[A.AggregatorSpec],
-                 dimensions: Optional[Sequence[str]] = None,
-                 transform: Optional[TransformSpec] = None,
-                 segment_granularity: str = "day",
-                 query_granularity: str = "none",
-                 rollup: bool = True,
-                 tuning: Optional[IndexTuningConfig] = None,
-                 max_num_subtasks: int = 4,
-                 task_id: Optional[str] = None):
-        super().__init__(task_id, datasource)
-        self.firehose = firehose
-        self.parser = parser
-        self.metric_specs = list(metric_specs)
-        self.dimensions = list(dimensions) if dimensions else None
-        self.transform = transform
-        self.segment_granularity = Granularity.of(segment_granularity)
-        self.query_granularity = query_granularity
-        self.rollup = rollup
-        self.tuning = tuning or IndexTuningConfig()
+    def __init__(self, *args, max_num_subtasks: int = 4, **kwargs):
+        kwargs.pop("appending", None)
+        super().__init__(*args, appending=False, **kwargs)
         self.max_num_subtasks = max_num_subtasks
-        self.appending = False   # for IndexTask.to_json reuse
 
     def _subtasks(self) -> List[IndexTask]:
         return [IndexTask(
@@ -330,7 +316,7 @@ class ParallelIndexTask(Task):
         return TaskStatus.success(self.id)
 
     def to_json(self) -> dict:
-        j = IndexTask.to_json(self)
+        j = super().to_json()
         j["type"] = "index_parallel"
         j["spec"]["tuningConfig"]["maxNumConcurrentSubTasks"] = \
             self.max_num_subtasks

@@ -72,9 +72,13 @@ class TimestampSpec:
 class DimensionsSpec:
     """Reference analog: api/.../data/input/impl/DimensionsSpec.java.
     Empty `dimensions` means schemaless discovery (all non-excluded,
-    non-timestamp, non-metric fields become string dims)."""
+    non-timestamp, non-metric fields become string dims).
+    spatial_dimensions: ((dimName, (coord fields...)), ...) — the parser
+    joins the coordinate fields into one 'x,y' string dim that
+    SpatialFilter understands (SpatialDimensionSchema)."""
     dimensions: tuple = ()
     exclusions: tuple = ()
+    spatial_dimensions: tuple = ()
 
     @staticmethod
     def from_json(j: Optional[dict]) -> "DimensionsSpec":
@@ -82,12 +86,18 @@ class DimensionsSpec:
         dims = []
         for d in j.get("dimensions", []):
             dims.append(d if isinstance(d, str) else d["name"])
+        spatial = tuple(
+            (s["dimName"], tuple(s["dims"]))
+            for s in j.get("spatialDimensions", []))
         return DimensionsSpec(tuple(dims),
-                              tuple(j.get("dimensionExclusions", [])))
+                              tuple(j.get("dimensionExclusions", [])),
+                              spatial)
 
     def to_json(self) -> dict:
         return {"dimensions": list(self.dimensions),
-                "dimensionExclusions": list(self.exclusions)}
+                "dimensionExclusions": list(self.exclusions),
+                "spatialDimensions": [{"dimName": n, "dims": list(d)}
+                                      for n, d in self.spatial_dimensions]}
 
 
 class RowBatch:
@@ -183,15 +193,25 @@ class InputRowParser:
         malformed records raise (callers may count+skip per task config)."""
         ts_col = self.timestamp_spec.column
         explicit_dims = self.dimensions_spec.dimensions
-        exclusions = set(self.dimensions_spec.exclusions) | {ts_col}
+        spatial_specs = self.dimensions_spec.spatial_dimensions
+        spatial_fields = {f for _, fields in spatial_specs for f in fields}
+        # spatial sources are read from the RAW record (pre-exclusion) and
+        # consumed by the join — excluding them must not empty the joined
+        # dim, and they don't become discovered dims of their own
+        # (SpatialDimensionRowTransformer consumes them from the row)
+        exclusions = (set(self.dimensions_spec.exclusions) | {ts_col}
+                      | spatial_fields) - set(explicit_dims)
         timestamps: List[int] = []
         columns: Dict[str, list] = {d: [] for d in explicit_dims}
+        spatial_src: Dict[str, list] = {f: [] for f in spatial_fields}
         n = 0
         for record in records:
             d = self._decode(record)
             if d is None:
                 continue
             timestamps.append(self.timestamp_spec.parse(d.get(ts_col)))
+            for f in spatial_src:
+                spatial_src[f].append(d.get(f))
             # keep ALL non-timestamp fields: the dimensions spec decides what
             # becomes a dim downstream, but metric inputs must survive parse
             keys = [k for k in d.keys() if k not in exclusions]
@@ -204,6 +224,13 @@ class InputRowParser:
                 if len(col) < len(timestamps):
                     col.append(None)
             n += 1
+        # join spatial coordinate fields into 'x,y' dims
+        # (SpatialDimensionRowTransformer)
+        for dim_name, fields in spatial_specs:
+            src = [spatial_src[f] for f in fields]
+            columns[dim_name] = [
+                ",".join("" if c[i] is None else str(c[i]) for c in src)
+                for i in range(n)]
         return RowBatch(timestamps, columns)
 
 
@@ -377,18 +404,22 @@ class LocalFirehose(Firehose):
         return {"type": "local", "baseDir": self.base_dir,
                 "filter": self.glob, "paths": list(self.paths)}
 
+    @classmethod
+    def _from_paths(cls, base_dir: str, glob: str,
+                    paths: Sequence[str]) -> "LocalFirehose":
+        fh = cls.__new__(cls)
+        fh.base_dir = base_dir
+        fh.glob = glob
+        fh.paths = list(paths)
+        return fh
+
     def splits(self, n: int) -> List["Firehose"]:
         if len(self.paths) <= 1:
             return [self]
         n = max(1, min(n, len(self.paths)))
-        out = []
-        for i in range(n):
-            fh = LocalFirehose.__new__(LocalFirehose)
-            fh.base_dir = self.base_dir
-            fh.glob = self.glob
-            fh.paths = self.paths[i::n]
-            out.append(fh)
-        return out
+        return [LocalFirehose._from_paths(self.base_dir, self.glob,
+                                          self.paths[i::n])
+                for i in range(n)]
 
 
 class CombiningFirehose(Firehose):
@@ -409,11 +440,9 @@ def firehose_from_json(j: dict) -> Firehose:
     if t == "local":
         if "paths" in j:
             # explicit split: do NOT re-glob the directory
-            fh = LocalFirehose.__new__(LocalFirehose)
-            fh.base_dir = j["baseDir"]
-            fh.glob = j.get("filter", "*")
-            fh.paths = list(j["paths"])
-            return fh
+            return LocalFirehose._from_paths(j["baseDir"],
+                                             j.get("filter", "*"),
+                                             j["paths"])
         return LocalFirehose(j["baseDir"], j.get("filter", "*"))
     if t == "inline":
         return InlineFirehose(j.get("data", "").splitlines()

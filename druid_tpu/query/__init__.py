@@ -2,7 +2,8 @@ from druid_tpu.query.filters import (
     DimFilter, SelectorFilter, InFilter, BoundFilter, LikeFilter, RegexFilter,
     AndFilter, OrFilter, NotFilter, IntervalFilter, SearchFilter,
     ColumnComparisonFilter, TrueFilter, FalseFilter, JavaScriptFilter,
-    ExpressionFilter, filter_from_json,
+    ExpressionFilter, SpatialFilter, SpatialBound, RectangularBound,
+    RadiusBound, PolygonBound, filter_from_json,
 )
 from druid_tpu.query.aggregators import (
     AggregatorSpec, CountAggregator, LongSumAggregator, DoubleSumAggregator,

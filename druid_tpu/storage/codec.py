@@ -10,6 +10,7 @@ incompressible data.
 """
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from typing import Tuple
@@ -49,35 +50,77 @@ def decompress_block(codec: int, data, out_size: int) -> bytes:
     raise ValueError(f"unknown codec {codec}")
 
 
-def compress_array(arr: np.ndarray, codec: int | None = None) -> bytes:
-    """Serialize a numpy array (any rank) as a block-compressed column part.
-
-    Layout: [codec u8][dtype_len u8][dtype str][ndim u8][shape i64 * ndim]
-            [block_size i32][n_blocks i32][(size i32, codec u8) * n_blocks]
-            [blocks...]
-    """
-    if codec is None:
-        codec = default_codec()
-    arr = np.ascontiguousarray(arr)
-    raw = arr.reshape(-1).view(np.uint8)
-    dtype_s = arr.dtype.str.encode()
+def _array_blocks(raw: np.ndarray, codec: int):
+    """Yield (block_codec, compressed_bytes) per BLOCK_SIZE slice — the ONE
+    definition of the block layout both the in-memory and writeout-file
+    writers share."""
     n_bytes = raw.shape[0]
     n_blocks = (n_bytes + BLOCK_SIZE - 1) // BLOCK_SIZE if n_bytes else 0
-    blocks = []
     for i in range(n_blocks):
         chunk = raw[i * BLOCK_SIZE:(i + 1) * BLOCK_SIZE].tobytes()
         comp = compress_block(codec, chunk)
         if len(comp) >= len(chunk):  # incompressible block — store raw
-            comp = compress_block(NONE, chunk)
-            blocks.append((NONE, comp))
+            yield NONE, compress_block(NONE, chunk)
         else:
-            blocks.append((codec, comp))
+            yield codec, comp
+
+
+def _array_header(arr: np.ndarray, codec: int,
+                  block_meta: "list[Tuple[int, int]]") -> bytes:
+    """[codec u8][dtype_len u8][dtype str][ndim u8][shape i64 * ndim]
+       [block_size i32][n_blocks i32][(size i32, codec u8) * n_blocks]"""
+    dtype_s = arr.dtype.str.encode()
     header = struct.pack("<BB", codec, len(dtype_s)) + dtype_s
     header += struct.pack("<B", arr.ndim)
     header += struct.pack(f"<{arr.ndim}q", *arr.shape)
-    header += struct.pack("<ii", BLOCK_SIZE, n_blocks)
-    header += b"".join(struct.pack("<iB", len(c), bc) for bc, c in blocks)
+    header += struct.pack("<ii", BLOCK_SIZE, len(block_meta))
+    header += b"".join(struct.pack("<iB", sz, bc) for bc, sz in block_meta)
+    return header
+
+
+def compress_array(arr: np.ndarray, codec: int | None = None) -> bytes:
+    """Serialize a numpy array (any rank) as a block-compressed column part
+    (layout: _array_header + blocks)."""
+    if codec is None:
+        codec = default_codec()
+    arr = np.ascontiguousarray(arr)
+    raw = arr.reshape(-1).view(np.uint8)
+    blocks = list(_array_blocks(raw, codec))
+    header = _array_header(arr, codec, [(bc, len(c)) for bc, c in blocks])
     return header + b"".join(c for _, c in blocks)
+
+
+def _copy_file_into(dst, path: str, copy_chunk: int = 1 << 20) -> None:
+    with open(path, "rb") as src:
+        while True:
+            buf = src.read(copy_chunk)
+            if not buf:
+                break
+            dst.write(buf)
+
+
+def compress_array_to_file(arr: np.ndarray, out_path: str,
+                           codec: int | None = None) -> None:
+    """compress_array with O(block) peak memory: blocks stream to a temp
+    writeout file while sizes accumulate, then the final part file is
+    header + streamed blocks (the WriteOutMedium capability —
+    processing/.../segment/writeout/FileWriteOutMedium.java). Byte-
+    identical output by construction: both writers share _array_blocks /
+    _array_header."""
+    if codec is None:
+        codec = default_codec()
+    arr = np.ascontiguousarray(arr)
+    raw = arr.reshape(-1).view(np.uint8)
+    blocks_path = out_path + ".blocks"
+    meta: list = []
+    with open(blocks_path, "wb") as bf:
+        for bc, comp in _array_blocks(raw, codec):
+            meta.append((bc, len(comp)))
+            bf.write(comp)
+    with open(out_path, "wb") as f:
+        f.write(_array_header(arr, codec, meta))
+        _copy_file_into(f, blocks_path)
+    os.remove(blocks_path)
 
 
 def decompress_array(buf) -> np.ndarray:

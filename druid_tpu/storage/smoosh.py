@@ -52,6 +52,25 @@ class FileSmoosher:
         self._chunk_pos += len(data)
         self._entries.append((name, self._chunk_idx, start, self._chunk_pos))
 
+    def add_from_file(self, name: str, path: str,
+                      copy_chunk: int = 1 << 20):
+        """Stream a part in from a writeout file without materializing it
+        (reference: FileWriteOutMedium — intermediate persist data lives in
+        temp files, not heap)."""
+        if any(e[0] == name for e in self._entries):
+            raise ValueError(f"duplicate smoosh part {name!r}")
+        size = os.path.getsize(path)
+        self._ensure_chunk(size)
+        start = self._chunk_pos
+        with open(path, "rb") as src:
+            while True:
+                buf = src.read(copy_chunk)
+                if not buf:
+                    break
+                self._fh.write(buf)
+                self._chunk_pos += len(buf)
+        self._entries.append((name, self._chunk_idx, start, self._chunk_pos))
+
     def close(self):
         if self._fh is not None:
             self._fh.close()

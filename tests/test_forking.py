@@ -106,6 +106,37 @@ def test_peon_killed_mid_task_reruns_to_success(runner):
     assert rows[0]["result"]["rows"] == 2000
 
 
+def test_peon_killed_after_publish_does_not_duplicate(runner):
+    """A peon that dies AFTER its transactional publish but BEFORE
+    reporting status is re-forked; the retry's publish must be a no-op
+    (exactly-once for crash-retried appends)."""
+    md, r = runner
+    recs = _records(800, days=1)
+    task = IndexTask("dup_ds", InlineFirehose(recs), None, SPECS,
+                     segment_granularity="day", appending=True)
+    state = {"killed": False}
+    orig = r.actions._do_action
+
+    def hook(payload):
+        out = orig(payload)
+        if payload["action"] == "publish" and not state["killed"]:
+            state["killed"] = True
+            proc = r.processes[payload["task"]]
+            proc.kill()     # dies before the response reaches it
+            proc.wait()
+        return out
+
+    r.actions._do_action = hook
+    status = r.run_task(task, timeout=120)
+    assert status.state == "SUCCESS", status.error
+    assert state["killed"] and r.attempts[task.id] == 2
+    descs = md.used_segments("dup_ds")
+    segs = [r.deep_storage.pull(d) for d in descs]
+    rows = QueryExecutor(segs).run(
+        TimeseriesQuery.of("dup_ds", [WEEK], QSPECS))
+    assert rows[0]["result"]["rows"] == 800      # not 1600
+
+
 def test_peon_that_always_dies_reports_failure(runner):
     md, r = runner
     task = IndexTask("dead_ds", InlineFirehose(_records(500)), None, SPECS)

@@ -76,6 +76,27 @@ def test_dictionary_roundtrip():
     assert out.values == d.values
 
 
+def test_tmpfile_writeout_byte_identical(tmp_path, segment):
+    """FileWriteOutMedium path: streamed persist must produce the same
+    bytes as the in-memory path and reload identically."""
+    from druid_tpu.storage.format import load_segment, persist_segment
+    d_mem, d_wo = str(tmp_path / "mem"), str(tmp_path / "wo")
+    persist_segment(segment, d_mem)
+    persist_segment(segment, d_wo, writeout="tmpfile")
+    import os
+    files_mem = sorted(f for f in os.listdir(d_mem))
+    assert files_mem == sorted(f for f in os.listdir(d_wo))
+    for f in files_mem:
+        with open(os.path.join(d_mem, f), "rb") as a, \
+                open(os.path.join(d_wo, f), "rb") as b:
+            assert a.read() == b.read(), f
+    back = load_segment(d_wo)
+    assert back.n_rows == segment.n_rows
+    assert np.array_equal(back.time_ms, segment.time_ms)
+    # no writeout temp dirs left behind
+    assert not [f for f in files_mem if f.startswith("writeout_")]
+
+
 def test_bitmap_index_roundtrip():
     rng = np.random.default_rng(11)
     ids = rng.integers(0, 17, 5000).astype(np.int32)
