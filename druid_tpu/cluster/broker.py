@@ -75,7 +75,10 @@ class Broker:
                  cache_config: Optional[CacheConfig] = None,
                  max_retries: int = 2, seed: int = 0,
                  max_threads: int = 8,
-                 query_manager: Optional[QueryManager] = None):
+                 query_manager: Optional[QueryManager] = None,
+                 selector_strategy=None):
+        """selector_strategy: view.ServerSelectorStrategy for replica
+        choice (default: random within the replica set)."""
         self.view = view
         self.cache = cache
         self.cache_config = cache_config or CacheConfig()
@@ -83,6 +86,7 @@ class Broker:
         self.rng = random.Random(seed)
         self.max_threads = max_threads
         self.query_manager = query_manager or QueryManager()
+        self.selector_strategy = selector_strategy
         self._lock = threading.Lock()
 
     # ---- QueryExecutor-compatible surface ------------------------------
@@ -255,7 +259,9 @@ class Broker:
             unassigned = []
             for sid, d in pending.items():
                 rs = self.view.replica_set(sid)
-                server = rs.pick(self.rng, exclude=tried[sid]) if rs else None
+                server = rs.pick(self.rng, exclude=tried[sid],
+                                 strategy=self.selector_strategy,
+                                 view=self.view) if rs else None
                 if server is None:
                     unassigned.append(sid)
                 else:
@@ -273,6 +279,7 @@ class Broker:
                 if token is not None and qid and hasattr(node, "cancel"):
                     token.add_remote_cancel(
                         lambda n=node: n.cancel(qid), key=server)
+                self.view.connection_started(server)
                 try:
                     if rows_mode:
                         rows, served = node.run_rows(q_round, sids)
@@ -295,6 +302,8 @@ class Broker:
                     for sid in sids:
                         seg_errors[sid] = e
                     return server, sids, None, set()
+                finally:
+                    self.view.connection_finished(server)
 
             with ThreadPoolExecutor(max_workers=self.max_threads) as pool:
                 outcomes = list(pool.map(run_one, by_server.items()))

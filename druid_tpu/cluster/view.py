@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from druid_tpu.cluster.cache import CacheConfig, LruCache, query_cache_key
@@ -64,16 +65,37 @@ class DataNode:
                  max_segments: Optional[int] = None,
                  cache: Optional[LruCache] = None,
                  cache_config: Optional[CacheConfig] = None,
-                 mesh=None):
+                 mesh=None, emitter=None, per_segment_metrics: bool = False):
+        """emitter: optional ServiceEmitter — per-segment query metrics
+        (query/segment/time, query/segmentAndCache/time, query/cpu/time)
+        emit here, the MetricsEmittingQueryRunner layer of the reference.
+        per_segment_metrics=True additionally runs the uncached path
+        segment-by-segment so each gets its own timing — an observability/
+        throughput trade (the fused multi-segment program is faster); off,
+        fused executions emit ONE aggregate timing."""
         self.name = name
         self.tier = tier
         self.max_segments = max_segments
         self.cache = cache
         self.cache_config = cache_config or CacheConfig()
         self.mesh = mesh
+        self.emitter = emitter
+        self.per_segment_metrics = per_segment_metrics
         self._segments: Dict[str, Segment] = {}
         self._lock = threading.RLock()
         self.alive = True
+
+    def _emit_segment(self, query, segment_id: str, wall_ms: float,
+                      cpu_ms: float, cached: bool) -> None:
+        if self.emitter is None:
+            return
+        qid = query.context_map.get("queryId", "")
+        dims = dict(dataSource=query.datasource, type=query.query_type,
+                    id=qid, segment=str(segment_id), server=self.name)
+        if not cached:
+            self.emitter.metric("query/segment/time", wall_ms, **dims)
+            self.emitter.metric("query/cpu/time", cpu_ms, **dims)
+        self.emitter.metric("query/segmentAndCache/time", wall_ms, **dims)
 
     # ---- load/drop (SegmentLoadDropHandler analog) ---------------------
     def load_segment(self, segment: Segment) -> bool:
@@ -134,29 +156,52 @@ class DataNode:
                      and self.cache_config.cacheable(query)
                      and self.cache_config.use_segment_cache)
         if not use_cache:
-            if check is None or self.mesh is not None or len(segs) <= 1:
+            if (check is None and not (self.emitter is not None
+                                       and self.per_segment_metrics)) \
+                    or self.mesh is not None or len(segs) <= 1:
+                t0, c0 = time.monotonic(), time.thread_time()
                 ap = make_aggregate_partials(query, segs, clamp=False)
+                if segs:
+                    # fused/mesh execution: one timing over the whole set
+                    self._emit_segment(
+                        query, f"{len(segs)}-segments",
+                        (time.monotonic() - t0) * 1e3,
+                        (time.thread_time() - c0) * 1e3, cached=False)
             else:
                 parts = []
                 for s in segs:
-                    check()
+                    if check is not None:
+                        check()
+                    t0, c0 = time.monotonic(), time.thread_time()
                     parts.append(
                         make_aggregate_partials(query, [s], clamp=False))
+                    self._emit_segment(query, s.id,
+                                       (time.monotonic() - t0) * 1e3,
+                                       (time.thread_time() - c0) * 1e3,
+                                       cached=False)
                 ap = AggregatePartials.concat(parts)
             return ap, served
         qkey = query_cache_key(query)
         parts: List[AggregatePartials] = []
         to_compute: List[Segment] = []
         for s in segs:
+            t0 = time.monotonic()
             hit = self.cache.get("segment", f"{s.id}|{qkey}")
             if hit is not None:
                 parts.append(hit)
+                self._emit_segment(query, s.id,
+                                   (time.monotonic() - t0) * 1e3, 0.0,
+                                   cached=True)
             else:
                 to_compute.append(s)
         for s in to_compute:
             if check is not None:
                 check()
+            t0, c0 = time.monotonic(), time.thread_time()
             ap = make_aggregate_partials(query, [s], clamp=False)
+            self._emit_segment(query, s.id, (time.monotonic() - t0) * 1e3,
+                               (time.thread_time() - c0) * 1e3,
+                               cached=False)
             if self.cache_config.populate_segment_cache:
                 self.cache.put("segment", f"{s.id}|{qkey}", ap)
             parts.append(ap)
@@ -175,21 +220,78 @@ class DataNode:
         return rows, served
 
 
+class ServerSelectorStrategy:
+    """Replica-choice SPI (client/selector/ServerSelectorStrategy.java +
+    TierSelectorStrategy): given candidate server names, pick one."""
+
+    def pick(self, candidates: List[str], view: Optional["InventoryView"],
+             rng: random.Random) -> str:
+        raise NotImplementedError
+
+
+class RandomServerSelectorStrategy(ServerSelectorStrategy):
+    def pick(self, candidates, view, rng):
+        return candidates[rng.randrange(len(candidates))]
+
+
+class ConnectionCountServerSelectorStrategy(ServerSelectorStrategy):
+    """Least-loaded replica by open query count
+    (client/selector/ConnectionCountServerSelectorStrategy.java); the view
+    tracks in-flight queries per server. Ties break RANDOMLY — on an idle
+    cluster every replica shows zero connections and a deterministic
+    tie-break would route everything to one server."""
+
+    def pick(self, candidates, view, rng):
+        if view is None:
+            return candidates[rng.randrange(len(candidates))]
+        loads = [(view.open_connections(s), s) for s in candidates]
+        lo = min(l for l, _ in loads)
+        pool = [s for l, s in loads if l == lo]
+        return pool[rng.randrange(len(pool))]
+
+
+class TierPreferenceStrategy(ServerSelectorStrategy):
+    """Prefer replicas on the listed tiers in order (Highest/Lowest
+    PriorityTierSelectorStrategy capability), falling back to `delegate`
+    within the chosen tier."""
+
+    def __init__(self, preferred_tiers: Sequence[str],
+                 delegate: Optional[ServerSelectorStrategy] = None):
+        self.preferred_tiers = list(preferred_tiers)
+        self.delegate = delegate or RandomServerSelectorStrategy()
+
+    def pick(self, candidates, view, rng):
+        if view is not None:
+            by_tier: Dict[str, List[str]] = {}
+            for s in candidates:
+                node = view.node(s)
+                by_tier.setdefault(
+                    getattr(node, "tier", "_default_tier"), []).append(s)
+            for tier in self.preferred_tiers:
+                if by_tier.get(tier):
+                    return self.delegate.pick(by_tier[tier], view, rng)
+        return self.delegate.pick(candidates, view, rng)
+
+
 class ReplicaSet:
     """Which servers hold one segment chunk (ServerSelector analog);
-    pick() implements the replica-choice strategy
-    (client/selector/TierSelectorStrategy.java — random within tier)."""
+    pick() delegates to the configured ServerSelectorStrategy
+    (client/selector/TierSelectorStrategy.java)."""
 
     def __init__(self, descriptor: SegmentDescriptor):
         self.descriptor = descriptor
         self.servers: Set[str] = set()
 
     def pick(self, rng: random.Random,
-             exclude: Optional[Set[str]] = None) -> Optional[str]:
+             exclude: Optional[Set[str]] = None,
+             strategy: Optional[ServerSelectorStrategy] = None,
+             view: Optional["InventoryView"] = None) -> Optional[str]:
         pool = sorted(self.servers - (exclude or set()))
         if not pool:
             return None
-        return pool[rng.randrange(len(pool))]
+        if strategy is None:
+            return pool[rng.randrange(len(pool))]
+        return strategy.pick(pool, view, rng)
 
 
 class InventoryView:
@@ -202,8 +304,26 @@ class InventoryView:
         self._timelines: Dict[str, VersionedIntervalTimeline] = {}
         self._replicas: Dict[str, ReplicaSet] = {}   # segment id → replicas
         self._probe_failures: Dict[str, int] = {}    # consecutive ping fails
+        self._connections: Dict[str, int] = {}       # in-flight per server
         self._lock = threading.RLock()
         self._listeners: List[Callable[[str, str, str], None]] = []
+
+    # ---- in-flight accounting (ConnectionCount strategy input) ---------
+    def connection_started(self, server: str) -> None:
+        with self._lock:
+            self._connections[server] = self._connections.get(server, 0) + 1
+
+    def connection_finished(self, server: str) -> None:
+        with self._lock:
+            n = self._connections.get(server, 0) - 1
+            if n <= 0:
+                self._connections.pop(server, None)
+            else:
+                self._connections[server] = n
+
+    def open_connections(self, server: str) -> int:
+        with self._lock:
+            return self._connections.get(server, 0)
 
     # ---- node lifecycle ------------------------------------------------
     def register(self, node: DataNode) -> None:

@@ -4,9 +4,14 @@ from druid_tpu.indexing.task import (CompactionTask, IndexTask, KillTask,
                                      task_from_json)
 from druid_tpu.indexing.overlord import Overlord, TaskToolbox
 from druid_tpu.indexing.forking import ForkingTaskRunner, TaskActionServer
+from druid_tpu.indexing.autoscaling import (PendingTaskProvisioningStrategy,
+                                            ProvisioningConfig,
+                                            ScalingMonitor, WorkerInfo)
 
 __all__ = [
     "TaskLockbox", "TaskLock", "LockType", "Task", "TaskStatus", "IndexTask",
     "CompactionTask", "KillTask", "task_from_json", "Overlord", "TaskToolbox",
     "ForkingTaskRunner", "TaskActionServer", "ParallelIndexTask",
+    "PendingTaskProvisioningStrategy", "ProvisioningConfig",
+    "ScalingMonitor", "WorkerInfo",
 ]

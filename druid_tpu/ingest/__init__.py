@@ -4,6 +4,7 @@ from druid_tpu.ingest.input import (CombiningFirehose, DimensionsSpec,
                                     LocalFirehose, RowBatch, TimestampSpec,
                                     TransformSpec, firehose_from_json)
 from druid_tpu.ingest.merger import merge_segments
+from druid_tpu.ingest.receiver import EventReceiverFirehose
 from druid_tpu.ingest.appenderator import (Appenderator, SegmentAllocator,
                                            Sink, StreamAppenderatorDriver)
 from druid_tpu.ingest.streaming import (SimulatedStream, StreamIngestTask,
@@ -12,7 +13,7 @@ from druid_tpu.ingest.streaming import (SimulatedStream, StreamIngestTask,
                                         StreamTuningConfig)
 
 __all__ = [
-    "IncrementalIndex", "merge_segments", "InputRowParser", "TimestampSpec",
+    "IncrementalIndex", "merge_segments", "EventReceiverFirehose", "InputRowParser", "TimestampSpec",
     "DimensionsSpec", "TransformSpec", "RowBatch", "Firehose",
     "InlineFirehose", "LocalFirehose", "CombiningFirehose",
     "firehose_from_json", "Appenderator", "SegmentAllocator", "Sink",

@@ -451,4 +451,8 @@ def firehose_from_json(j: dict) -> Firehose:
     if t == "combining":
         return CombiningFirehose([firehose_from_json(d)
                                   for d in j["delegates"]])
+    if t == "receiver":
+        from druid_tpu.ingest.receiver import EventReceiverFirehose
+        return EventReceiverFirehose(j["serviceName"],
+                                     port=int(j.get("port", 0)))
     raise ValueError(f"unknown firehose type {t!r}")

@@ -1,0 +1,200 @@
+"""Autoscaling strategy, push firehose, replica selection strategies, and
+per-segment query metrics (reference: PendingTaskBasedWorker
+ProvisioningStrategy, EventReceiverFirehoseFactory,
+ConnectionCountServerSelectorStrategy, MetricsEmittingQueryRunner)."""
+import json
+import urllib.request
+
+import pytest
+
+from druid_tpu.cluster import (Broker, DataNode, InventoryView,
+                               descriptor_for)
+from druid_tpu.cluster.view import (ConnectionCountServerSelectorStrategy,
+                                    TierPreferenceStrategy)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.indexing import (IndexTask, Overlord,
+                                PendingTaskProvisioningStrategy,
+                                ProvisioningConfig, ScalingMonitor,
+                                WorkerInfo)
+from druid_tpu.ingest import EventReceiverFirehose
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+AGGS = [CountAggregator("rows"), LongSumAggregator("ls", "metLong")]
+
+
+# ---------------------------------------------------------------------------
+# Autoscaling
+# ---------------------------------------------------------------------------
+
+def test_provision_on_pending_pressure():
+    strat = PendingTaskProvisioningStrategy(ProvisioningConfig(
+        max_workers=4, worker_capacity=2, scale_up_step=2))
+    workers = [WorkerInfo("w0", capacity=2, running_tasks=2)]
+    d = strat.compute(pending_tasks=5, workers=workers, now=1000.0)
+    assert d.provision == 2 and d.terminate == []
+    # spare capacity absorbs pending → no scaling
+    idle = [WorkerInfo("w0", capacity=2, running_tasks=0,
+                       last_task_time=999.0)]
+    d2 = strat.compute(pending_tasks=1, workers=idle, now=1000.0)
+    assert d2.provision == 0 and d2.terminate == []
+
+
+def test_terminate_idle_respects_min_and_cooldown():
+    cfg = ProvisioningConfig(min_workers=1, max_workers=4,
+                             idle_seconds_before_terminate=600.0)
+    strat = PendingTaskProvisioningStrategy(cfg)
+    now = 10_000.0
+    workers = [WorkerInfo("w0", running_tasks=0, last_task_time=now - 700),
+               WorkerInfo("w1", running_tasks=0, last_task_time=now - 800),
+               WorkerInfo("w2", running_tasks=0, last_task_time=now - 10)]
+    d = strat.compute(0, workers, now=now)
+    # w2 inside cooldown; min_workers=1 keeps one of the idle pair
+    assert set(d.terminate) == {"w0", "w1"}
+    cfg.min_workers = 2
+    d2 = strat.compute(0, workers, now=now)
+    assert d2.terminate == ["w1"]      # oldest-idle first
+
+
+def test_scaling_monitor_applies_decisions():
+    created, killed = [], []
+    workers = []
+    strat = PendingTaskProvisioningStrategy(ProvisioningConfig(
+        max_workers=2, worker_capacity=1, scale_up_step=2))
+    mon = ScalingMonitor(strat, pending=lambda: 3,
+                         workers=lambda: list(workers),
+                         provision=lambda n: created.append(n),
+                         terminate=lambda ids: killed.extend(ids))
+    d = mon.run_once(now=0.0)
+    assert created == [2] and d.provision == 2
+    assert len(mon.history) == 1
+
+
+# ---------------------------------------------------------------------------
+# Push firehose
+# ---------------------------------------------------------------------------
+
+def test_event_receiver_firehose_end_to_end():
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.storage.deep import InMemoryDeepStorage
+    fh = EventReceiverFirehose("svc1")
+    try:
+        t0 = WEEK.start
+        events = [{"timestamp": int(t0 + i * 1000), "page": f"p{i % 3}",
+                   "value": 1} for i in range(500)]
+        for i in range(0, 500, 100):
+            body = json.dumps(events[i:i + 100]).encode()
+            req = urllib.request.Request(
+                fh.url + "/push-events", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            r = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert r["eventCount"] == 100
+        assert fh.events_received == 500
+        # producer signals completion over HTTP
+        req = urllib.request.Request(fh.url + "/shutdown", data=b"{}",
+                                     method="POST")
+        urllib.request.urlopen(req, timeout=30)
+
+        md = MetadataStore()
+        ov = Overlord(md, InMemoryDeepStorage())
+        task = IndexTask("push_ds", fh, None,
+                         [CountAggregator("rows"),
+                          LongSumAggregator("v", "value")],
+                         segment_granularity="day")
+        assert ov.run_task(task).state == "SUCCESS"
+        segs = [ov.deep_storage.pull(d) for d in md.used_segments("push_ds")]
+        rows = QueryExecutor(segs).run(TimeseriesQuery.of(
+            "push_ds", [WEEK],
+            [LongSumAggregator("rows", "rows")]))
+        assert rows[0]["result"]["rows"] == 500
+    finally:
+        fh.stop()
+
+
+def test_event_receiver_rejects_after_close():
+    fh = EventReceiverFirehose("svc2")
+    try:
+        fh.close()
+        req = urllib.request.Request(
+            fh.url + "/push-events", data=b"[{}]",
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 409
+    finally:
+        fh.stop()
+
+
+# ---------------------------------------------------------------------------
+# Selection strategies + per-segment metrics
+# ---------------------------------------------------------------------------
+
+def test_connection_count_strategy_prefers_idle(segments):
+    view = InventoryView()
+    a, b = DataNode("a"), DataNode("b")
+    for n in (a, b):
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(n.name, descriptor_for(s))
+    view.connection_started("a")
+    view.connection_started("a")
+    broker = Broker(view,
+                    selector_strategy=ConnectionCountServerSelectorStrategy())
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    rows = broker.run(q)
+    assert rows[0]["result"]["rows"] == sum(s.n_rows for s in segments)
+    # with 'a' loaded, 'b' must have been chosen for every segment
+    sid = descriptor_for(segments[0]).id
+    rs = view.replica_set(sid)
+    assert rs.pick(broker.rng,
+                   strategy=ConnectionCountServerSelectorStrategy(),
+                   view=view) == "b"
+
+
+def test_tier_preference_strategy(segments):
+    view = InventoryView()
+    hot = DataNode("hot0", tier="hot")
+    cold = DataNode("cold0", tier="cold")
+    for n in (hot, cold):
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(n.name, descriptor_for(s))
+    import random
+    rs = view.replica_set(descriptor_for(segments[0]).id)
+    strat = TierPreferenceStrategy(["hot", "cold"])
+    assert rs.pick(random.Random(0), strategy=strat, view=view) == "hot0"
+    view.remove_node("hot0")
+    rs = view.replica_set(descriptor_for(segments[0]).id)
+    assert rs.pick(random.Random(0), strategy=strat, view=view) == "cold0"
+
+
+def test_per_segment_metrics_emitted(segments):
+    from druid_tpu.utils.emitter import Emitter, ServiceEmitter
+
+    class Collect(Emitter):
+        def __init__(self):
+            self.events = []
+
+        def emit(self, e):
+            self.events.append(e)
+
+    sink = Collect()
+    node = DataNode("h0", emitter=ServiceEmitter("druid/historical", "h0",
+                                                 sink),
+                    per_segment_metrics=True)
+    view = InventoryView()
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("h0", descriptor_for(s))
+    broker = Broker(view)
+    broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+    names = [e.metric for e in sink.events]
+    assert names.count("query/segment/time") == len(segments)
+    assert names.count("query/cpu/time") == len(segments)
+    segs_seen = {e.dims["segment"] for e in sink.events}
+    assert segs_seen == {str(descriptor_for(s).id) for s in segments}
