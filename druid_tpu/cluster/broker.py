@@ -126,6 +126,10 @@ class Broker:
         if not segments:
             return []
         if _is_aggregate(query):
+            if query.context_map.get("bySegment"):
+                # per-segment unmerged results: the row path concatenates
+                # what every node's BySegmentQueryRunner produced
+                return self._run_rows(query, segments)
             return self._run_aggregate(query, segments)
         return self._run_rows(query, segments)
 
@@ -325,6 +329,10 @@ class Broker:
     # ---- row merges (QueryToolChest.mergeResults analogs) --------------
     def _merge_rows(self, query: Query, results: List[List[dict]],
                     segments: List[SegmentDescriptor]):
+        if _is_aggregate(query) and query.context_map.get("bySegment"):
+            merged = [r for rows in results for r in rows]
+            merged.sort(key=lambda r: r["result"]["segment"])
+            return merged
         if isinstance(query, ScanQuery):
             batches = [b for rows in results for b in rows]
             if query.order != "none":

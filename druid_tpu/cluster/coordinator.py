@@ -176,6 +176,7 @@ class DynamicConfig:
     max_segments_to_move: int = 5
     replication_throttle_limit: int = 10
     max_non_primary_replicants: int = 10_000
+    max_segments_in_node_loading_queue: int = 100
 
 
 @dataclass
@@ -196,11 +197,36 @@ class Coordinator:
 
     def __init__(self, metadata: MetadataStore, view: InventoryView,
                  segment_source: Callable[[SegmentDescriptor], Segment],
-                 config: Optional[DynamicConfig] = None):
+                 config: Optional[DynamicConfig] = None,
+                 async_loading: bool = False):
+        """async_loading=True assigns loads through per-server
+        LoadQueuePeons (bounded queues, background workers) instead of
+        blocking the cycle on each segment pull — the reference's
+        LoadQueuePeon model. The announcement then happens when the worker
+        finishes, so a load counts as `assigned` when enqueued."""
         self.metadata = metadata
         self.view = view
         self.segment_source = segment_source
         self.config = config or DynamicConfig()
+        self.async_loading = async_loading
+        self._peons: Dict[str, "LoadQueuePeon"] = {}
+
+    def _peon_for(self, node: DataNode) -> "LoadQueuePeon":
+        from druid_tpu.cluster.loadqueue import LoadQueuePeon
+        peon = self._peons.get(node.name)
+        if peon is None:
+            peon = self._peons[node.name] = LoadQueuePeon(
+                node, self.view, self.segment_source,
+                max_queue_size=self.config.max_segments_in_node_loading_queue)
+        return peon
+
+    def wait_loads(self, timeout: float = 30.0) -> bool:
+        """Drain every peon queue (tests / controlled handover)."""
+        return all(p.wait_idle(timeout) for p in self._peons.values())
+
+    def stop(self) -> None:
+        for p in self._peons.values():
+            p.stop()
 
     # ---- one coordinator period ---------------------------------------
     def run_once(self, now_ms: Optional[int] = None) -> CoordinatorStats:
@@ -209,7 +235,14 @@ class Coordinator:
         # failure detection first: dead servers leave the view (their
         # announcements retract), so this same cycle's rule run sees the
         # replica deficit and re-replicates from deep storage
-        stats.nodes_removed = len(self.view.check_liveness())
+        dead = self.view.check_liveness()
+        stats.nodes_removed = len(dead)
+        for name in dead:
+            # a removed server's peon must stop, or its queued loads would
+            # ghost-announce for a node no broker can reach
+            peon = self._peons.pop(name, None)
+            if peon is not None:
+                peon.stop()
         self._mark_overshadowed(stats)
         used = self.metadata.used_segments()
         self._run_rules(used, now_ms, stats)
@@ -282,14 +315,25 @@ class Coordinator:
                         stats.dropped += 1
                 continue
             rs = self.view.replica_set(d.id)
-            holders = set(rs.servers) if rs is not None else set()
+            announced = set(rs.servers) if rs is not None else set()
+            holders = set(announced)
+            if self.async_loading:
+                # an enqueued-but-unannounced load counts as a holder, or
+                # every cycle until the worker finishes would pile extra
+                # replicas onto OTHER nodes (currentlyLoading accounting)
+                holders |= {name for name, peon in self._peons.items()
+                            if peon.is_pending(d.id)}
             for tier, wanted in rule.tiered_replicants.items():
                 nodes = tiers.get(tier, [])
                 tier_holders = [n for n in nodes if n.name in holders]
                 deficit = wanted - len(tier_holders)
-                # drop excess replicas (from the costliest server)
-                while deficit < 0 and tier_holders:
-                    victim = tier_holders.pop()
+                # drop excess replicas (from the costliest server) — only
+                # ANNOUNCED ones; dropping a pending-only holder would be a
+                # no-op that still decremented the deficit
+                droppable = [n for n in tier_holders
+                             if n.name in announced]
+                while deficit < 0 and droppable:
+                    victim = droppable.pop()
                     victim.drop_segment(d.id)
                     self.view.unannounce(victim.name, d.id)
                     served_by[victim.name] = [
@@ -319,8 +363,12 @@ class Coordinator:
                     stats.unassigned += deficit
 
     def _load_on(self, node: DataNode, d: SegmentDescriptor) -> bool:
+        if self.async_loading:
+            # enqueue-and-continue: the peon's worker pulls, loads, and
+            # announces; a full queue defers to the next cycle
+            return self._peon_for(node).load(d)
         segment = self.segment_source(d)
-        if segment is None or not node.load_segment(segment):
+        if segment is None or not node.load_segment(segment, d):
             return False
         self.view.announce(node.name, d)
         return True
@@ -333,8 +381,17 @@ class Coordinator:
             if len(nodes) < 2:
                 continue
             moves_left = self.config.max_segments_to_move
+            in_flight_out: Dict[str, int] = {}
             while moves_left > 0:
-                counts = {n.name: n.segment_count() for n in nodes}
+                # async: a scheduled move means src WILL lose one and dst
+                # WILL gain one — account for it, or a gated worker makes
+                # the stale counts re-move everything src holds
+                counts = {}
+                for n in nodes:
+                    c = n.segment_count() - in_flight_out.get(n.name, 0)
+                    if self.async_loading and n.name in self._peons:
+                        c += self._peons[n.name].pending_count()
+                    counts[n.name] = c
                 src = max(nodes, key=lambda n: counts[n.name])
                 dst = min(nodes, key=lambda n: counts[n.name])
                 if counts[src.name] - counts[dst.name] < 2:
@@ -343,14 +400,32 @@ class Coordinator:
                 dst_ids = {d.id for d in dst_served}
                 movable = [d for d in self.view.served_segments(src.name)
                            if d.id not in dst_ids]
+                if self.async_loading:
+                    dst_peon = self._peon_for(dst)
+                    movable = [m for m in movable
+                               if not dst_peon.is_pending(m.id)]
                 if not movable:
                     break
                 d = min(movable,
                         key=lambda m: placement_cost(m, dst_served))
-                if not self._load_on(dst, d):
-                    break
-                src.drop_segment(d.id)
-                self.view.unannounce(src.name, d.id)
+                if self.async_loading:
+                    # load-then-drop: the source replica must stay
+                    # announced until the destination's worker FINISHES —
+                    # dropping on enqueue would leave a window (or, on a
+                    # failed load, an eternity) with zero replicas
+                    def after(ok, s=src, dd=d):
+                        if ok:
+                            s.drop_segment(dd.id)
+                            self.view.unannounce(s.name, dd.id)
+                    if not self._peon_for(dst).load(d, callback=after):
+                        break
+                    in_flight_out[src.name] = \
+                        in_flight_out.get(src.name, 0) + 1
+                else:
+                    if not self._load_on(dst, d):
+                        break
+                    src.drop_segment(d.id)
+                    self.view.unannounce(src.name, d.id)
                 stats.moved += 1
                 moves_left -= 1
 

@@ -90,11 +90,17 @@ class DataNodeServer:
 
             def do_GET(self):
                 if self.path == "/status":
+                    descs = [d.to_json()
+                             for d in outer.node.served_descriptors()]
                     self._reply_json(200, {
                         "version": "druid-tpu-0.2",
                         "server": outer.node.name,
                         "tier": outer.node.tier,
-                        "segments": sorted(outer.node.served_segment_ids())})
+                        "segments": sorted(outer.node.served_segment_ids()),
+                        # full descriptors so a broker's inventory sync can
+                        # announce without being hand-fed
+                        # (HttpServerInventoryView's segment listing)
+                        "segmentDescriptors": descs})
                 else:
                     self._reply_json(404, {"error": "unknown path"})
 
@@ -209,6 +215,16 @@ class RemoteDataNodeClient:
             return set(st.get("segments", []))
         except ConnectionError:
             return set()
+
+    def served_descriptors(self) -> List:
+        """Full segment descriptors from the node's /status — the sync
+        loop's announcement source. PROPAGATES ConnectionError: a blip must
+        abort the sync round for this server (liveness handles real
+        deaths), not read as 'serves nothing' and mass-unannounce."""
+        st = self._status()
+        from druid_tpu.cluster.metadata import SegmentDescriptor
+        return [SegmentDescriptor.from_json(j)
+                for j in st.get("segmentDescriptors", [])]
 
     def ping(self) -> bool:
         """Liveness probe: a /status round-trip within connect_timeout,

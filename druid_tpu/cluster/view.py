@@ -82,6 +82,7 @@ class DataNode:
         self.emitter = emitter
         self.per_segment_metrics = per_segment_metrics
         self._segments: Dict[str, Segment] = {}
+        self._descriptors: Dict[str, SegmentDescriptor] = {}
         self._lock = threading.RLock()
         self.alive = True
 
@@ -98,18 +99,33 @@ class DataNode:
         self.emitter.metric("query/segmentAndCache/time", wall_ms, **dims)
 
     # ---- load/drop (SegmentLoadDropHandler analog) ---------------------
-    def load_segment(self, segment: Segment) -> bool:
+    def load_segment(self, segment: Segment,
+                     descriptor: Optional[SegmentDescriptor] = None) -> bool:
+        """`descriptor` (when the loader has it) preserves the REAL shard
+        spec for /status inventory listings — descriptor_for can only
+        reconstruct default specs, and the timeline completeness check
+        depends on the real one."""
         with self._lock:
             if self.max_segments is not None \
                     and len(self._segments) >= self.max_segments \
                     and str(segment.id) not in self._segments:
                 return False
             self._segments[str(segment.id)] = segment
+            if descriptor is not None:
+                self._descriptors[str(segment.id)] = descriptor
             return True
 
     def drop_segment(self, segment_id: str) -> bool:
         with self._lock:
+            self._descriptors.pop(str(segment_id), None)
             return self._segments.pop(str(segment_id), None) is not None
+
+    def served_descriptors(self) -> List[SegmentDescriptor]:
+        """Descriptors for every served segment — stored ones (real shard
+        specs) where known, reconstructed defaults otherwise."""
+        with self._lock:
+            return [self._descriptors.get(sid) or descriptor_for(s)
+                    for sid, s in self._segments.items()]
 
     def served_segment_ids(self) -> Set[str]:
         with self._lock:
@@ -358,6 +374,44 @@ class InventoryView:
     def nodes(self) -> List[DataNode]:
         with self._lock:
             return list(self._nodes.values())
+
+    def sync_server(self, node) -> Tuple[int, int]:
+        """One inventory-sync round for a node exposing
+        served_descriptors() (RemoteDataNodeClient): announce segments the
+        node now serves, unannounce ones it no longer does — the poll loop
+        of HttpServerInventoryView, replacing hand-registration. Returns
+        (announced, unannounced)."""
+        descs = node.served_descriptors() \
+            if hasattr(node, "served_descriptors") else \
+            [descriptor_for(s) for s in node.segments()]
+        current = {d.id: d for d in descs}
+        added = removed = 0
+        # snapshot + diff under ONE lock hold (RLock: announce/unannounce
+        # nest fine) so a concurrent announce between the snapshot and the
+        # writes cannot be reverted by this stale round
+        with self._lock:
+            known = {sid for sid, rs in self._replicas.items()
+                     if node.name in rs.servers}
+            for sid, d in current.items():
+                if sid not in known:
+                    self.announce(node.name, d)
+                    added += 1
+            for sid in known - set(current):
+                self.unannounce(node.name, sid)
+                removed += 1
+        return added, removed
+
+    def sync_all(self) -> Tuple[int, int]:
+        """Sync every registered node (the periodic inventory refresh)."""
+        a = r = 0
+        for node in self.nodes():
+            try:
+                da, dr = self.sync_server(node)
+                a += da
+                r += dr
+            except Exception:
+                continue      # liveness handles dead nodes
+        return a, r
 
     def check_liveness(self, failures_required: int = 1) -> List[str]:
         """Probe every node (concurrently — a dead remote must not stall

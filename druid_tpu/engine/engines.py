@@ -275,6 +275,33 @@ def make_aggregate_partials(query, segments: Sequence[Segment],
 # Timeseries
 # ---------------------------------------------------------------------------
 
+def run_by_segment(query, segments: Sequence[Segment]) -> List[dict]:
+    """context.bySegment=true: per-segment UNMERGED results, each wrapped
+    with its segment identity (reference: BySegmentQueryRunner.java — the
+    caching/debug surface where the broker sees exactly what every segment
+    contributed)."""
+    from dataclasses import replace
+    inner = replace(query, context=tuple(
+        (k, v) for k, v in query.context_map.items() if k != "bySegment"))
+    out: List[dict] = []
+    intervals = condense(query.intervals)
+    for s in _segments_for(segments, intervals):
+        if isinstance(query, TimeseriesQuery):
+            rows = finish_timeseries(
+                inner, make_aggregate_partials(inner, [s]))
+        elif isinstance(query, TopNQuery):
+            rows = finish_topn(inner, make_aggregate_partials(inner, [s]))
+        else:
+            rows = finish_groupby(inner, make_aggregate_partials(inner, [s]))
+        out.append({
+            "timestamp": rows[0]["timestamp"] if rows else None,
+            "result": {"results": rows, "segment": str(s.id),
+                       "interval": str(s.interval)},
+            "bySegment": True,
+        })
+    return out
+
+
 def run_timeseries(query: TimeseriesQuery, segments: Sequence[Segment]) -> List[dict]:
     return finish_timeseries(query, make_aggregate_partials(query, segments))
 

@@ -102,6 +102,9 @@ class QueryExecutor:
         return self._dispatch(query, segs)
 
     def _dispatch(self, query: Query, segs: List[Segment]):
+        if isinstance(query, (TimeseriesQuery, TopNQuery, GroupByQuery)) \
+                and query.context_map.get("bySegment"):
+            return engines.run_by_segment(query, segs)
         if isinstance(query, TimeseriesQuery):
             return engines.run_timeseries(query, segs)
         if isinstance(query, TopNQuery):

@@ -255,6 +255,47 @@ def test_builder_type_widening():
     assert float(seg.metrics["m"].values.sum()) == 2.5
 
 
+def test_by_segment_results(segments):
+    """context.bySegment returns per-segment UNMERGED results wrapped with
+    segment identity (BySegmentQueryRunner), locally and via the broker."""
+    from druid_tpu.query.model import TimeseriesQuery
+    from druid_tpu.query.aggregators import CountAggregator
+    iv = Interval.of("2026-01-01", "2026-01-05")
+    q = TimeseriesQuery.of("test", [iv], [CountAggregator("rows")],
+                           granularity="all",
+                           context={"bySegment": True})
+    rows = QueryExecutor(segments).run(q)
+    assert len(rows) == len(segments)
+    assert all(r["bySegment"] for r in rows)
+    by_id = {r["result"]["segment"]: r for r in rows}
+    total = 0
+    for s in segments:
+        r = by_id[str(s.id)]
+        assert r["result"]["results"][0]["result"]["rows"] == s.n_rows
+        total += s.n_rows
+    # merged result for comparison
+    plain = QueryExecutor(segments).run(
+        TimeseriesQuery.of("test", [iv], [CountAggregator("rows")],
+                           granularity="all"))
+    assert plain[0]["result"]["rows"] == total
+
+    # broker path concatenates the nodes' per-segment wrappers
+    from druid_tpu.cluster import (Broker, DataNode, InventoryView,
+                                   descriptor_for)
+    view = InventoryView()
+    n1, n2 = DataNode("n1"), DataNode("n2")
+    for i, s in enumerate(segments):
+        node = (n1, n2)[i % 2]
+        node.load_segment(s)
+    for n in (n1, n2):
+        view.register(n)
+        for s in n.segments():
+            view.announce(n.name, descriptor_for(s))
+    brows = Broker(view).run(q)
+    assert {r["result"]["segment"] for r in brows} == \
+        {str(s.id) for s in segments}
+
+
 def test_scan_filter_on_virtual_column(segment):
     from druid_tpu.query.model import ExpressionVirtualColumn
     from druid_tpu.query import BoundFilter
