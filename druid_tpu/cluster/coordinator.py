@@ -239,10 +239,12 @@ class Coordinator:
         stats.nodes_removed = len(dead)
         for name in dead:
             # a removed server's peon must stop, or its queued loads would
-            # ghost-announce for a node no broker can reach
+            # ghost-announce for a node no broker can reach. No join: a
+            # worker mid-pull must not stall failure detection (the worker
+            # already refuses to announce for unregistered nodes)
             peon = self._peons.pop(name, None)
             if peon is not None:
-                peon.stop()
+                peon.stop(join=False)
         self._mark_overshadowed(stats)
         used = self.metadata.used_segments()
         self._run_rules(used, now_ms, stats)
@@ -291,6 +293,11 @@ class Coordinator:
         served_by: Dict[str, List[SegmentDescriptor]] = {
             n.name: self.view.served_segments(n.name)
             for ns in tiers.values() for n in ns}
+        # one pending-set snapshot per peon per cycle (not one lock take
+        # per segment x peon)
+        pending_by_server = {name: peon.pending_ids()
+                             for name, peon in self._peons.items()} \
+            if self.async_loading else {}
         replicas_created = 0
         rules_cache: Dict[str, List[Rule]] = {}
         for d in used:
@@ -317,21 +324,25 @@ class Coordinator:
             rs = self.view.replica_set(d.id)
             announced = set(rs.servers) if rs is not None else set()
             holders = set(announced)
+            pending_holders = set()
             if self.async_loading:
                 # an enqueued-but-unannounced load counts as a holder, or
                 # every cycle until the worker finishes would pile extra
                 # replicas onto OTHER nodes (currentlyLoading accounting)
-                holders |= {name for name, peon in self._peons.items()
-                            if peon.is_pending(d.id)}
+                pending_holders = {name for name, ids in pending_by_server
+                                   .items() if d.id in ids}
+                holders |= pending_holders
             for tier, wanted in rule.tiered_replicants.items():
                 nodes = tiers.get(tier, [])
                 tier_holders = [n for n in nodes if n.name in holders]
                 deficit = wanted - len(tier_holders)
-                # drop excess replicas (from the costliest server) — only
-                # ANNOUNCED ones; dropping a pending-only holder would be a
-                # no-op that still decremented the deficit
-                droppable = [n for n in tier_holders
-                             if n.name in announced]
+                # drop excess ANNOUNCED replicas — but never while a load
+                # for this segment is in flight: the "excess" may be a
+                # balancer move's still-serving source, and dropping it
+                # opens a zero-replica window until (or forever if) the
+                # destination's load completes
+                droppable = [] if pending_holders else \
+                    [n for n in tier_holders if n.name in announced]
                 while deficit < 0 and droppable:
                     victim = droppable.pop()
                     victim.drop_segment(d.id)

@@ -73,6 +73,12 @@ class LoadQueuePeon:
         with self._lock:
             return str(segment_id) in self._pending
 
+    def pending_ids(self) -> Set[str]:
+        """Snapshot of queued/in-flight segment ids (one lock hold — the
+        coordinator's rules loop must not take this lock per segment)."""
+        with self._lock:
+            return set(self._pending)
+
     # ---- worker ---------------------------------------------------------
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -110,20 +116,24 @@ class LoadQueuePeon:
             except Exception as e:   # a bad segment must not kill the peon
                 self.failures.append(f"{op} {d.id}: {e}")
             finally:
-                with self._lock:
-                    self._pending.discard(d.id)
-                    if not self._pending:
-                        self._idle.set()
+                # callback BEFORE the idle signal: wait_idle() returning
+                # means every completion effect (e.g. a balancer move's
+                # drop-source) has been applied, not merely scheduled
                 if callback is not None:
                     try:
                         callback(ok)
                     except Exception:
                         pass
+                with self._lock:
+                    self._pending.discard(d.id)
+                    if not self._pending:
+                        self._idle.set()
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Block until the queue drains (tests / graceful handover)."""
         return self._idle.wait(timeout)
 
-    def stop(self) -> None:
+    def stop(self, join: bool = True) -> None:
         self._stop.set()
-        self._worker.join(timeout=5.0)
+        if join:
+            self._worker.join(timeout=5.0)

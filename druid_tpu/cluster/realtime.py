@@ -127,7 +127,7 @@ class RealtimeServer:
 
     # the coordinator never manages realtime sinks; keep the node surface
     # total so a misdirected call is a no-op, not a crash
-    def load_segment(self, segment) -> bool:
+    def load_segment(self, segment, descriptor=None) -> bool:
         return False
 
     def drop_segment(self, segment_id: str) -> bool:

@@ -297,6 +297,8 @@ class ReplicaSet:
     def __init__(self, descriptor: SegmentDescriptor):
         self.descriptor = descriptor
         self.servers: Set[str] = set()
+        #: per-server announce sequence (sync_server stale-round guard)
+        self.server_seq: Dict[str, int] = {}
 
     def pick(self, rng: random.Random,
              exclude: Optional[Set[str]] = None,
@@ -321,6 +323,7 @@ class InventoryView:
         self._replicas: Dict[str, ReplicaSet] = {}   # segment id → replicas
         self._probe_failures: Dict[str, int] = {}    # consecutive ping fails
         self._connections: Dict[str, int] = {}       # in-flight per server
+        self._announce_seq = 0                       # monotonic, under lock
         self._lock = threading.RLock()
         self._listeners: List[Callable[[str, str, str], None]] = []
 
@@ -381,22 +384,28 @@ class InventoryView:
         node now serves, unannounce ones it no longer does — the poll loop
         of HttpServerInventoryView, replacing hand-registration. Returns
         (announced, unannounced)."""
+        with self._lock:
+            fetch_seq = self._announce_seq
         descs = node.served_descriptors() \
             if hasattr(node, "served_descriptors") else \
             [descriptor_for(s) for s in node.segments()]
         current = {d.id: d for d in descs}
         added = removed = 0
-        # snapshot + diff under ONE lock hold (RLock: announce/unannounce
-        # nest fine) so a concurrent announce between the snapshot and the
-        # writes cannot be reverted by this stale round
         with self._lock:
-            known = {sid for sid, rs in self._replicas.items()
+            known = {sid: rs for sid, rs in self._replicas.items()
                      if node.name in rs.servers}
             for sid, d in current.items():
                 if sid not in known:
                     self.announce(node.name, d)
                     added += 1
-            for sid in known - set(current):
+            for sid, rs in known.items():
+                if sid in current:
+                    continue
+                # an announce NEWER than our /status fetch (e.g. a load
+                # peon finishing mid-sync) must not be reverted by this
+                # round's stale snapshot
+                if rs.server_seq.get(node.name, 0) > fetch_seq:
+                    continue
                 self.unannounce(node.name, sid)
                 removed += 1
         return added, removed
@@ -469,6 +478,8 @@ class InventoryView:
                 tl.add(descriptor.interval, descriptor.version,
                        PartitionChunk(spec, rs))
             rs.servers.add(server)
+            self._announce_seq += 1
+            rs.server_seq[server] = self._announce_seq
         for fn in list(self._listeners):
             fn("announce", server, sid)
 
@@ -478,6 +489,7 @@ class InventoryView:
             if rs is None:
                 return
             rs.servers.discard(server)
+            rs.server_seq.pop(server, None)
             if not rs.servers:
                 d = rs.descriptor
                 tl = self._timelines.get(d.datasource)

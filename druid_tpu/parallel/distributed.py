@@ -193,9 +193,14 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     spec0.strategy, spec0.window = grouping.select_strategy(
         spec0, kernels, col_dtypes, R, _windowed_all)
     if spec0.strategy == "projection":
-        # sorted projections are per-segment layouts; the stacked sharded
-        # program cannot share one — run the per-segment path instead
-        return None
+        # sorted projections are per-segment layouts the stacked program
+        # cannot share. Falling back to per-segment pallas would also pay
+        # per-call dispatch/merge overhead once per segment; ONE stacked
+        # scatter-mixed program amortizes it across the whole set and
+        # measured ~2x faster at bench scale (8x12.5M rows) on v5e — so
+        # the stacked program overrides to mixed and the projection path
+        # stays the meshless per-segment winner.
+        spec0.strategy, spec0.window = "mixed", 0
 
     # per-segment RELATIVE interval bounds + bucket start offsets: the
     # device program stays in int32 offset space (64-bit elementwise time
