@@ -38,7 +38,7 @@ from druid_tpu.storage import codec as codecs
 from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
 from druid_tpu.utils.intervals import Interval
 
-FORMAT_VERSION = 2  # v2: codec parts carry ndim+shape (N-D complex columns)
+FORMAT_VERSION = 3  # v3: value-encoding byte in column parts (delta longs)
 
 
 def _encode_dictionary(d: Dictionary) -> bytes:
