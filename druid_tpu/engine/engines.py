@@ -73,6 +73,9 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
     producing an id remap table (cached per segment) — the analog of the
     reference applying ExtractionFn per row, at O(cardinality) instead of
     O(rows)."""
+    from druid_tpu.query.model import ExpressionDimensionSpec
+    if isinstance(spec, ExpressionDimensionSpec):
+        return _expr_keydim(segment, spec)
     col = segment.dims.get(spec.dimension)
     num_ids = None
     num_key = None
@@ -136,6 +139,37 @@ def _keydim_for(segment: Segment, spec: DimensionSpec) -> Tuple[KeyDim, List[str
     return KeyDim(dim_col, max(len(uniq), 1), remap, host_ids=num_ids,
                   ids_key=("numdim_ids", spec.dimension)
                   if num_ids is not None else None), (uniq or [""])
+
+
+def _expr_keydim(segment: Segment, spec) -> Tuple[KeyDim, List]:
+    """Host-evaluate an expression dimension into a per-segment value
+    dictionary (numeric dims generalized to computed values; string dims
+    bind decoded so string comparisons/CASE work)."""
+    from druid_tpu.engine.filters import _bind_string_dims
+    from druid_tpu.utils.expression import parse_expression
+
+    cache_key = ("exprdim", spec.expression, spec.output_type)
+
+    def _compute():
+        expr = parse_expression(spec.expression)
+        bindings: Dict[str, np.ndarray] = {"__time": segment.time_ms}
+        for name, m in segment.metrics.items():
+            if np.asarray(m.values).ndim == 1:
+                bindings[name] = m.values
+        _bind_string_dims(expr, segment, bindings)
+        vals = np.broadcast_to(np.asarray(expr.evaluate(bindings)),
+                               (segment.n_rows,))
+        uniq, inv = np.unique(vals, return_inverse=True)
+        out = [v.item() if hasattr(v, "item") else v for v in uniq]
+        if spec.output_type == "string":
+            out = [str(v) for v in out]
+        return inv.astype(np.int32), out
+
+    ids, vals = segment.aux_cached(cache_key, _compute)
+    return KeyDim(f"__exprdim_{spec.output_name}", max(len(vals), 1), None,
+                  host_ids=ids,
+                  ids_key=("exprdim_ids", spec.expression,
+                           spec.output_type)), (vals or [""])
 
 
 def _bucket_starts(granularity: Granularity,

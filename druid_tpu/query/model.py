@@ -316,12 +316,36 @@ class ListFilteredDimensionSpec(DimensionSpec):
                 "values": list(self.values), "isWhitelist": self.is_whitelist}
 
 
+@dataclass(frozen=True)
+class ExpressionDimensionSpec(DimensionSpec):
+    """Group by a computed expression (the capability of the reference's
+    virtualColumn-as-dimension path). Evaluated HOST-side per segment into
+    a query-time value dictionary — the device then groups by compact ids
+    exactly like any other dimension (engines._keydim_for)."""
+    expression: str = ""
+    output_name: str = ""
+    output_type: str = "long"     # long | double | string
+
+    @property
+    def dimension(self):
+        return self.output_name
+
+    def to_json(self):
+        return {"type": "expression", "expression": self.expression,
+                "outputName": self.output_name,
+                "outputType": self.output_type}
+
+
 def dimspec_from_json(j) -> DimensionSpec:
     if isinstance(j, str):
         return DefaultDimensionSpec(j, j)
     t = j.get("type", "default")
     if t == "default":
         return DefaultDimensionSpec(j["dimension"], j.get("outputName") or j["dimension"])
+    if t == "expression":
+        return ExpressionDimensionSpec(j["expression"],
+                                       j.get("outputName") or "expr",
+                                       j.get("outputType", "long"))
     if t == "extraction":
         return ExtractionDimensionSpec(j["dimension"],
                                        j.get("outputName") or j["dimension"],

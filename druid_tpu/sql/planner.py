@@ -10,6 +10,7 @@ of DruidSchema's segmentMetadata-driven table discovery.
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -18,6 +19,7 @@ from druid_tpu.query import filters as F
 from druid_tpu.query import postaggs as PA
 from druid_tpu.query.model import (DefaultDimensionSpec, DefaultLimitSpec,
                                    DimensionSpec, EqualToHaving,
+                                   ExpressionDimensionSpec,
                                    ExpressionVirtualColumn,
                                    ExtractionDimensionSpec, FilterHaving,
                                    GreaterThanHaving, GroupByQuery, HavingSpec,
@@ -101,7 +103,42 @@ _SQL_FN_TO_EXPR = {"ABS": "abs", "CEIL": "ceil", "FLOOR": "floor",
                    "EXP": "exp", "LN": "log", "LOG10": "log10",
                    "SQRT": "sqrt", "SIN": "sin", "COS": "cos", "TAN": "tan",
                    "POWER": "pow", "POW": "pow", "COALESCE": "nvl",
-                   "NVL": "nvl"}
+                   "NVL": "nvl", "MOD": "mod", "ROUND": "round",
+                   "SIGN": "sign", "TRUNCATE": "trunc", "TRUNC": "trunc",
+                   "GREATEST": "greatest", "LEAST": "least",
+                   "SAFE_DIVIDE": "safe_divide"}
+
+
+_UNIT_MS = {"SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,
+            "DAY": 86_400_000, "WEEK": 7 * 86_400_000}
+#: ISO weeks are Monday-aligned; epoch day 0 is a Thursday
+_WEEK_ORIGIN_MS = -3 * 86_400_000
+
+def _check_extract_unit(unit: str) -> None:
+    from druid_tpu.utils.expression import EXTRACT_UNITS
+    if unit not in EXTRACT_UNITS:
+        raise PlannerError(
+            f"EXTRACT unit {unit!r} not supported "
+            f"(supported: {', '.join(sorted(EXTRACT_UNITS))})")
+
+
+def _period_literal_ms(e) -> Tuple[int, int]:
+    """(period_ms, origin_ms) for a UNIFORM ISO period literal. Calendar
+    periods (months/years) are non-uniform in millis and reject — an
+    approximation here would return silently wrong buckets (those belong
+    in the GROUP BY granularity path). Week periods align to ISO Mondays."""
+    from druid_tpu.utils.intervals import parse_period_ms
+    if not isinstance(e, P.Lit):
+        raise PlannerError("period argument must be a literal")
+    s = str(e.value).strip().upper()
+    # months appear before any T section; minutes only after it
+    if re.search(r"\d+Y", s) or re.match(r"^P[^T]*?\d+M", s):
+        raise PlannerError(
+            f"calendar period {e.value!r} is non-uniform in millis; use "
+            f"FLOOR(__time TO ...) in GROUP BY for month/year bucketing")
+    ms = parse_period_ms(e.value)
+    origin = _WEEK_ORIGIN_MS if re.match(r"^P\d+W$", s) else 0
+    return ms, origin
 
 
 def _expr_str(e, table: str, schema: SqlSchema) -> str:
@@ -144,10 +181,46 @@ def _expr_str(e, table: str, schema: SqlSchema) -> str:
         return f"(1 - {s})" if e.negated else s
     if isinstance(e, P.Fn):
         if e.extra is not None:
-            # FLOOR(x TO unit) etc. — plain floor(millis) would be a silent
-            # no-op; only the GROUP BY granularity path understands TO units
+            unit = str(e.extra).upper()
+            x = _expr_str(e.args[0], table, schema)
+            if e.name == "EXTRACT":
+                _check_extract_unit(unit)
+                return f"timestamp_extract({x}, '{unit}')"
+            if e.name in ("FLOOR", "CEIL") and unit in _UNIT_MS:
+                period = _UNIT_MS[unit]
+                origin = _WEEK_ORIGIN_MS if unit == "WEEK" else 0
+                if e.name == "FLOOR":
+                    return f"timestamp_floor({x}, {period}, {origin})"
+                return (f"timestamp_floor(({x}) + {period - 1}, {period}, "
+                        f"{origin})")
+            # calendar (month/year) floors are non-uniform in millis; only
+            # the GROUP BY granularity path understands those
             raise PlannerError(
-                f"{e.name}(... TO {e.extra}) only supported in GROUP BY")
+                f"{e.name}(... TO {e.extra}) not expressible in millis "
+                f"arithmetic (use it in GROUP BY)")
+        if e.name == "TIME_FLOOR":
+            if len(e.args) != 2:
+                # origin/timezone arguments would be silently dropped —
+                # reject rather than return offset buckets
+                raise PlannerError(
+                    "TIME_FLOOR(expr, period) supports exactly 2 arguments")
+            x = _expr_str(e.args[0], table, schema)
+            period, origin = _period_literal_ms(e.args[1])
+            return f"timestamp_floor({x}, {period}, {origin})"
+        if e.name == "TIME_SHIFT" and len(e.args) == 3:
+            x = _expr_str(e.args[0], table, schema)
+            period, _ = _period_literal_ms(e.args[1])
+            n = _expr_str(e.args[2], table, schema)
+            return f"timestamp_shift({x}, {period}, {n})"
+        if e.name == "TIME_EXTRACT" and len(e.args) == 2 \
+                and isinstance(e.args[1], P.Lit):
+            x = _expr_str(e.args[0], table, schema)
+            unit = str(e.args[1].value).upper()
+            _check_extract_unit(unit)
+            return f"timestamp_extract({x}, '{unit}')"
+        if e.name in ("TIMESTAMP_TO_MILLIS", "MILLIS_TO_TIMESTAMP") \
+                and len(e.args) == 1:
+            return _expr_str(e.args[0], table, schema)   # millis both ways
         fn = _SQL_FN_TO_EXPR.get(e.name)
         if fn is not None:
             args = ", ".join(_expr_str(a, table, schema) for a in e.args)
@@ -567,7 +640,14 @@ def _dimension_spec(e, alias: str, table: str, schema: SqlSchema,
         return ExtractionDimensionSpec(
             e.args[0].name, alias,
             RegisteredLookupExtractionFn(str(e.args[1].value)))
-    raise PlannerError(f"cannot group by {e!s}")
+    # anything translatable to an expression groups as a computed
+    # dimension (EXTRACT, TIME_FLOOR, MOD, CASE, arithmetic, ...): the
+    # engine host-evaluates it into a per-segment value dictionary
+    try:
+        expr_s = _expr_str(e, table, schema)
+    except PlannerError as err:
+        raise PlannerError(f"cannot group by {e!s}: {err}") from err
+    return ExpressionDimensionSpec(expr_s, alias, "long")
 
 
 # ---------------------------------------------------------------------------
@@ -761,6 +841,13 @@ def _plan_grouped(sel: P.Select, table: str, schema: SqlSchema,
     order_cols: List[OrderByColumnSpec] = []
     for ob in sel.order_by:
         e = ob.expr
+        if isinstance(e, P.Lit) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            # ordinal: ORDER BY 1 refers to the first projection
+            if not (1 <= e.value <= len(outputs)):
+                raise PlannerError(f"ORDER BY position {e.value} out of "
+                                   f"range")
+            e = P.Col(outputs[e.value - 1].alias)
         fname = None
         numeric = True
         if isinstance(e, P.Col) and e.name in alias_to_field:

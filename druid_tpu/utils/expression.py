@@ -173,6 +173,193 @@ def _fn_if(cond, a, b):
     return a if cond else b
 
 
+_MS_DAY = 86_400_000
+
+
+def _fdiv(a, b):
+    """Floor division that works for np/jnp arrays and python ints."""
+    xp = _xp(a, b)
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        return xp.floor_divide(a, b)
+    return a // b
+
+
+def _civil(t_ms):
+    """(year, month, day, days-since-epoch) from epoch millis — Hinnant's
+    civil-from-days in pure integer arithmetic, so it traces to XLA
+    elementwise ops (no host calendar lookups on the device path)."""
+    days = _fdiv(t_ms, _MS_DAY)
+    z = days + 719468
+    era = _fdiv(z, 146097)
+    doe = z - era * 146097
+    yoe = _fdiv(doe - _fdiv(doe, 1460) + _fdiv(doe, 36524)
+                - _fdiv(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + _fdiv(yoe, 4) - _fdiv(yoe, 100))
+    mp = _fdiv(5 * doy + 2, 153)
+    d = doy - _fdiv(153 * mp + 2, 5) + 1
+    m = mp + _where_num(mp < 10, 3, -9)
+    y = y + _where_num(m <= 2, 1, 0)
+    return y, m, d, days
+
+
+def _days_from_civil(y, m, d):
+    ya = y - _where_num(m <= 2, 1, 0)
+    era = _fdiv(ya, 400)
+    yoe = ya - era * 400
+    doy = _fdiv(153 * (m + _where_num(m > 2, -3, 9)) + 2, 5) + d - 1
+    doe = yoe * 365 + _fdiv(yoe, 4) - _fdiv(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _where_num(cond, a, b):
+    return _fn_if(cond, a, b)
+
+
+#: units _fn_timestamp_extract understands (planners validate against this
+#: so an unsupported unit is a plan-time error, not a runtime one)
+EXTRACT_UNITS = frozenset({
+    "EPOCH", "MILLISECOND", "SECOND", "MINUTE", "HOUR", "DAY", "DOW",
+    "DOY", "MONTH", "QUARTER", "YEAR"})
+
+
+def _fn_timestamp_extract(t, unit):
+    """EXTRACT unit from epoch millis (reference: TimestampExtractExprMacro
+    semantics; DOW ISO 1=Mon..7=Sun)."""
+    u = str(unit).upper()
+    msod = t - _fdiv(t, _MS_DAY) * _MS_DAY
+    if u == "EPOCH":
+        return _fdiv(t, 1000)
+    if u == "MILLISECOND":
+        return msod % 1000
+    if u == "SECOND":
+        return _fdiv(msod, 1000) % 60
+    if u == "MINUTE":
+        return _fdiv(msod, 60_000) % 60
+    if u == "HOUR":
+        return _fdiv(msod, 3_600_000)
+    y, m, d, days = _civil(t)
+    if u == "YEAR":
+        return y
+    if u == "QUARTER":
+        return _fdiv(m + 2, 3)
+    if u == "MONTH":
+        return m
+    if u == "DAY":
+        return d
+    if u == "DOW":
+        return (days + 3) % 7 + 1
+    if u == "DOY":
+        return days - _days_from_civil(y, 1, 0)
+    raise ValueError(f"unknown EXTRACT unit {unit!r}")
+
+
+def _fn_timestamp_floor(t, period_ms, origin=0):
+    return _fdiv(t - origin, period_ms) * period_ms + origin
+
+
+def _fn_greatest(*vals):
+    out = vals[0]
+    for v in vals[1:]:
+        out = _FUNCTIONS["max"](out, v)
+    return out
+
+
+def _fn_least(*vals):
+    out = vals[0]
+    for v in vals[1:]:
+        out = _FUNCTIONS["min"](out, v)
+    return out
+
+
+def _fn_safe_div(a, b):
+    xp = _xp(a, b)
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        return xp.where(b != 0, a / xp.where(b != 0, b, 1), 0.0)
+    return a / b if b else 0.0
+
+
+def _trunc_div_ints(a, b):
+    """Exact truncated integer division (no float64 round-trip — longs
+    above 2^53 must divide exactly)."""
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def _fn_mod(a, b):
+    """Truncated modulo — sign of the DIVIDEND, matching Druid/Calcite
+    (Java %), not python's floored modulo. Exact for integers."""
+    xp = _xp(a, b)
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        import numpy as _np
+        if _np.issubdtype(getattr(a, "dtype", _np.float64), _np.integer) \
+                and _np.issubdtype(getattr(b, "dtype", _np.int64),
+                                   _np.integer):
+            # integer-exact: a - trunc(a/b)*b in pure int arithmetic
+            bb = xp.where(b != 0, b, 1)
+            q = xp.where(b != 0, abs(a) // abs(bb), 0)
+            q = xp.where((a >= 0) == (bb >= 0), q, -q)
+            return a - q * bb
+        return xp.fmod(a, b)
+    if isinstance(a, int) and isinstance(b, int):
+        return a - _trunc_div_ints(a, b) * b if b else a
+    return math.fmod(a, b)
+
+
+def _fn_int_div(a, b):
+    """Druid expression div(): integer (long) division truncated toward
+    zero; division by zero yields 0. Exact for integers (no float64
+    round-trip)."""
+    xp = _xp(a, b)
+    if hasattr(a, "shape") or hasattr(b, "shape"):
+        import numpy as _np
+        if _np.issubdtype(getattr(a, "dtype", _np.float64), _np.integer) \
+                and _np.issubdtype(getattr(b, "dtype", _np.int64),
+                                   _np.integer):
+            bb = xp.where(b != 0, b, 1)
+            q = xp.where(b != 0, abs(a) // abs(bb), 0)
+            return xp.where((a >= 0) == (bb >= 0), q, -q).astype("int64")
+        q = xp.where(b != 0, a / xp.where(b != 0, b, 1), 0)
+        return xp.trunc(q).astype("int64")
+    if not b:
+        return 0
+    if isinstance(a, int) and isinstance(b, int):
+        return _trunc_div_ints(a, b)
+    return int(a / b)
+
+
+def _fn_round(x, n=0):
+    """ROUND half-AWAY-FROM-ZERO with optional decimal places (Druid
+    semantics; numpy/python's default is banker's rounding). Integers with
+    n >= 0 return unchanged — a float64 round-trip would corrupt longs
+    above 2^53."""
+    xp = _xp(x)
+    import numpy as _np
+    n = int(n) if not hasattr(n, "shape") else int(n)
+    scale = 10 ** n if n >= 0 else 0
+    if hasattr(x, "shape"):
+        if _np.issubdtype(getattr(x, "dtype", _np.float64), _np.integer):
+            if n >= 0:
+                return x
+            s = 10 ** (-n)   # exact integer rounding to tens/hundreds/...
+            q = (abs(x) + s // 2) // s * s
+            return xp.where(x >= 0, q, -q).astype(x.dtype)
+        if n < 0:
+            s = 10 ** (-n)
+            return xp.sign(x) * xp.floor(xp.abs(x) / s + 0.5) * s
+        return xp.sign(x) * xp.floor(xp.abs(x) * scale + 0.5) / scale
+    if isinstance(x, int):
+        if n >= 0:
+            return x
+        s = 10 ** (-n)
+        q = (abs(x) + s // 2) // s * s
+        return q if x >= 0 else -q
+    if n < 0:
+        s = 10 ** (-n)
+        return math.copysign(math.floor(abs(x) / s + 0.5), x) * s
+    return math.copysign(math.floor(abs(x) * scale + 0.5), x) / scale
+
+
 _FUNCTIONS: Dict[str, Callable] = {
     "abs": lambda x: _xp(x).abs(x) if hasattr(x, "shape") else abs(x),
     "ceil": lambda x: _xp(x).ceil(x) if hasattr(x, "shape") else math.ceil(x),
@@ -193,6 +380,19 @@ _FUNCTIONS: Dict[str, Callable] = {
     "if": _fn_if,
     "nvl": lambda a, b: b if a is None else a,
     "cast": lambda x, t: x,  # typing handled by output column dtype
+    "round": _fn_round,
+    "sign": lambda x: _xp(x).sign(x) if hasattr(x, "shape")
+        else (0 if x == 0 else (1 if x > 0 else -1)),
+    "trunc": lambda x: _xp(x).trunc(x) if hasattr(x, "shape")
+        else math.trunc(x),
+    "mod": _fn_mod,
+    "greatest": _fn_greatest,
+    "least": _fn_least,
+    "div": _fn_int_div,
+    "safe_divide": _fn_safe_div,
+    "timestamp_floor": _fn_timestamp_floor,
+    "timestamp_shift": lambda t, period_ms, n: t + period_ms * n,
+    "timestamp_extract": _fn_timestamp_extract,
 }
 
 

@@ -129,6 +129,67 @@ def test_bitmap_density_adaptive_and_budgeted():
     assert idx2.bitmap(0).cardinality() + idx2.bitmap(1).cardinality() == n
 
 
+def test_timestamp_extract_matches_datetime():
+    """The device-safe integer calendar math must agree with python's
+    datetime over a wide range (incl. leap years, century boundaries)."""
+    import datetime as dt
+    from druid_tpu.utils.expression import parse_expression
+    rng = np.random.default_rng(9)
+    ts = rng.integers(-5_000_000_000_000, 4_000_000_000_000, 2000)
+    b = {"t": ts}
+    golden = [dt.datetime.fromtimestamp(int(x) / 1000, dt.timezone.utc)
+              for x in ts]
+    for unit, fn in [("YEAR", lambda d: d.year), ("MONTH", lambda d: d.month),
+                     ("DAY", lambda d: d.day), ("HOUR", lambda d: d.hour),
+                     ("MINUTE", lambda d: d.minute),
+                     ("SECOND", lambda d: d.second),
+                     ("DOW", lambda d: d.isoweekday()),
+                     ("DOY", lambda d: d.timetuple().tm_yday),
+                     ("QUARTER", lambda d: (d.month + 2) // 3)]:
+        got = parse_expression(f"timestamp_extract(t, '{unit}')").evaluate(b)
+        want = np.asarray([fn(d) for d in golden])
+        assert np.array_equal(np.asarray(got), want), unit
+
+
+def test_timestamp_floor_shift_and_math_fns():
+    from druid_tpu.utils.expression import parse_expression
+    day = 86_400_000
+    t = np.asarray([3 * day + 5, 3 * day, -day + 1, -1], dtype=np.int64)
+    out = parse_expression(f"timestamp_floor(t, {day})").evaluate({"t": t})
+    assert list(out) == [3 * day, 3 * day, -day, -day]
+    out = parse_expression(
+        f"timestamp_shift(t, {day}, 2)").evaluate({"t": t})
+    assert list(out) == [x + 2 * day for x in t]
+    b = {"x": np.asarray([-2.5, 0.0, 7.0])}
+    assert list(parse_expression("sign(x)").evaluate(b)) == [-1, 0, 1]
+    assert list(parse_expression("greatest(x, 1, 3)").evaluate(b)) == \
+        [3, 3, 7]
+    assert list(parse_expression("least(x, 0)").evaluate(b)) == [-2.5, 0, 0]
+    assert list(parse_expression("safe_divide(x, 0)").evaluate(b)) == \
+        [0, 0, 0]
+    # Druid semantics: MOD keeps the dividend's sign; ROUND is half-away-
+    # from-zero with optional places; div() is truncated long division
+    iv = {"v": np.asarray([-5, 5, -7], dtype=np.int64)}
+    assert list(parse_expression("mod(v, 3)").evaluate(iv)) == [-2, 2, -1]
+    fv = {"f": np.asarray([2.5, -2.5, 2.345])}
+    assert list(parse_expression("round(f)").evaluate(fv)) == [3, -3, 2]
+    assert list(parse_expression("round(f, 2)").evaluate(fv)) == \
+        [2.5, -2.5, 2.35]
+    assert list(parse_expression("div(v, 2)").evaluate(iv)) == [-2, 2, -3]
+    # longs above 2^53 must not round-trip through float64
+    big = {"v": np.asarray([2**60 + 1, -(2**60 + 1)], dtype=np.int64)}
+    assert list(parse_expression("div(v, 1)").evaluate(big)) == \
+        [2**60 + 1, -(2**60 + 1)]
+    assert list(parse_expression("round(v)").evaluate(big)) == \
+        [2**60 + 1, -(2**60 + 1)]
+    assert list(parse_expression("mod(v, 1000)").evaluate(big)) == \
+        [(2**60 + 1) % 1000, -((2**60 + 1) % 1000)]
+    assert parse_expression(f"mod({2**60 + 1}, {2**60})").evaluate({}) == 1
+    # negative places round to tens/hundreds exactly
+    assert list(parse_expression("round(v, -2)").evaluate(
+        {"v": np.asarray([1251, -1250], dtype=np.int64)})) == [1300, -1300]
+
+
 def test_expression_eval():
     e = parse_expression("metA * 2 + 1")
     out = e.evaluate({"metA": np.asarray([1.0, 2.0])})

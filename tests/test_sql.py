@@ -267,10 +267,20 @@ def test_contradictory_time_range_empty(sql):
     assert rows == []
 
 
-def test_floor_to_unit_outside_groupby_rejected(sql):
+def test_floor_to_unit_in_where(sql, frames):
+    """Uniform FLOOR..TO units translate to timestamp_floor millis math in
+    WHERE; calendar units (non-uniform in millis) still reject."""
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM test "
+        "WHERE FLOOR(__time TO DAY) = TIMESTAMP '2026-01-01'")
+    t = _concat(frames, "__time")
+    day0 = (t // 86_400_000) * 86_400_000
+    from druid_tpu.utils.intervals import parse_ts
+    want = int((day0 == parse_ts("2026-01-01")).sum())
+    assert rows[0][0] == want > 0
     with pytest.raises(PlannerError):
         sql.execute("SELECT COUNT(*) FROM test "
-                    "WHERE FLOOR(__time TO DAY) = TIMESTAMP '2026-01-01'")
+                    "WHERE FLOOR(__time TO MONTH) = TIMESTAMP '2026-01-01'")
 
 
 def test_parse_errors():

@@ -149,6 +149,46 @@ CASES = [
      "(WHERE dim1 = 'b') FROM foo", [[3, 325332]], True),
     ("coalesce_fn", "SELECT SUM(COALESCE(l1, 0)) FROM foo",
      [[325352]], True),
+    # -- time/math expression functions ----------------------------------
+    ("where_extract_day",
+     "SELECT COUNT(*) FROM foo WHERE EXTRACT(DAY FROM __time) <= 3",
+     [[3]], True),
+    ("where_extract_dow",
+     # 2026-02-01 is a Sunday (ISO DOW 7); days 2..6 are Mon..Fri
+     "SELECT COUNT(*) FROM foo WHERE EXTRACT(DOW FROM __time) <= 5",
+     [[5]], True),
+    ("extract_month_year_agg",
+     "SELECT SUM(CASE WHEN EXTRACT(MONTH FROM __time) = 2 AND "
+     "EXTRACT(YEAR FROM __time) = 2026 THEN 1 ELSE 0 END) FROM foo",
+     [[6]], True),
+    ("where_time_floor_fn",
+     "SELECT COUNT(*) FROM foo WHERE TIME_FLOOR(__time, 'P1D') = "
+     "TIMESTAMP '2026-02-03 00:00:00'", [[1]], True),
+    ("where_time_shift",
+     "SELECT COUNT(*) FROM foo WHERE TIME_SHIFT(__time, 'P1D', 1) > "
+     "TIMESTAMP '2026-02-05 00:00:00'", [[2]], True),
+    ("mod_round_sign",
+     "SELECT SUM(MOD(l1, 2)), SUM(SIGN(l1)), SUM(ROUND(f1)) FROM foo",
+     [[4, 5, 12.0]], True),
+    ("greatest_least",
+     "SELECT SUM(GREATEST(l1, 5)), SUM(LEAST(l1, 5)) FROM foo",
+     [[325359, 23]], True),
+    ("safe_divide",
+     "SELECT SUM(SAFE_DIVIDE(10.0, l1)) FROM foo",
+     [[10.0 / 7 + 10.0 / 325323 + 0.0 + 10.0 / 3 + 10.0 / 9 + 1.0]], True),
+    ("group_by_extract_dow",
+     "SELECT EXTRACT(DOW FROM __time) dow, COUNT(*) FROM foo "
+     "GROUP BY 1 ORDER BY 1",
+     # Feb 1 2026 = Sunday(7); Feb 2..6 = Mon..Fri (1..5)
+     [[1, 1], [2, 1], [3, 1], [4, 1], [5, 1], [7, 1]], True),
+    ("group_by_mod_expr",
+     "SELECT MOD(l1, 2) parity, COUNT(*), SUM(l1) FROM foo "
+     "GROUP BY 1 ORDER BY 1",
+     [[0, 2, 10], [1, 4, 325342]], True),
+    ("group_by_case_expr",
+     "SELECT CASE WHEN l1 > 5 THEN 'big' ELSE 'small' END sz, COUNT(*) "
+     "FROM foo GROUP BY 1",
+     [["big", 4], ["small", 2]], False),
     # -- approximate -----------------------------------------------------
     ("approx_count_distinct", "SELECT APPROX_COUNT_DISTINCT(dim1) FROM foo",
      [[3]], True),
