@@ -75,6 +75,125 @@ def cmd_server(args) -> int:
         return 0
 
 
+# ---------------------------------------------------------------------------
+# Per-node-type servers (services/src/main/java/org/apache/druid/cli/
+# CliHistorical.java, CliBroker.java, CliCoordinator.java, CliRouter.java) —
+# each runs ONE role so deployments scale roles independently; `server`
+# remains the single-process everything node.
+# ---------------------------------------------------------------------------
+
+def build_historical(name: str, segments_dir=None, port: int = 8083,
+                     tier: str = "_default_tier"):
+    """DataNode + its HTTP query endpoint; optionally preload every
+    persisted segment under segments_dir."""
+    import os
+    from druid_tpu.cluster import DataNode, DataNodeServer, LruCache
+    node = DataNode(name, tier=tier, cache=LruCache())
+    loaded = 0
+    if segments_dir and os.path.isdir(segments_dir):
+        from druid_tpu.storage.format import load_segment
+        for entry in sorted(os.listdir(segments_dir)):
+            d = os.path.join(segments_dir, entry)
+            if os.path.isfile(os.path.join(d, "version.bin")):
+                node.load_segment(load_segment(d))
+                loaded += 1
+    server = DataNodeServer(node, port=port).start()
+    return node, server, loaded
+
+
+def cmd_historical(args) -> int:
+    node, server, loaded = build_historical(
+        args.name, args.segments_dir, args.port, args.tier)
+    print(f"historical [{args.name}] listening on :{server.port} "
+          f"({loaded} segments preloaded)", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+        return 0
+
+
+def build_broker(data_node_urls, port: int = 8082):
+    """Broker over remote data nodes discovered via /status sync."""
+    from druid_tpu.cluster import (Broker, InventoryView, LruCache,
+                                   RemoteDataNodeClient)
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+    from druid_tpu.sql import SqlExecutor
+    view = InventoryView()
+    for i, url in enumerate(data_node_urls):
+        view.register(RemoteDataNodeClient(f"data{i}", url))
+    view.sync_all()
+    broker = Broker(view, cache=LruCache())
+    lifecycle = QueryLifecycle(broker)
+    http = QueryHttpServer(lifecycle, SqlExecutor(broker), port=port)
+    http.start()
+    return view, broker, http
+
+
+def cmd_broker(args) -> int:
+    view, broker, http = build_broker(args.data_node or [], args.port)
+    print(f"broker listening on :{http.port} "
+          f"({len(args.data_node or [])} data node(s))", flush=True)
+    try:
+        while True:
+            view.check_liveness()
+            view.sync_all()
+            time.sleep(args.sync_period)
+    except KeyboardInterrupt:
+        http.stop()
+        return 0
+
+
+def cmd_coordinator(args) -> int:
+    from druid_tpu.cluster import (Coordinator, DynamicConfig, InventoryView,
+                                   MetadataStore, RemoteDataNodeClient)
+    from druid_tpu.storage.deep import LocalDeepStorage
+    metadata = MetadataStore(args.metadata)
+    deep = LocalDeepStorage(args.storage_dir)
+    view = InventoryView()
+    for i, url in enumerate(args.data_node or []):
+        view.register(RemoteDataNodeClient(f"data{i}", url))
+    view.sync_all()
+    coord = Coordinator(metadata, view, deep.pull, DynamicConfig(),
+                        async_loading=True)
+    print(f"coordinator running (period {args.period}s, "
+          f"{len(args.data_node or [])} node(s))", flush=True)
+    try:
+        while True:
+            stats = coord.run_once()
+            if stats.assigned or stats.dropped or stats.nodes_removed:
+                print(f"cycle: assigned={stats.assigned} "
+                      f"dropped={stats.dropped} "
+                      f"dead={stats.nodes_removed}", flush=True)
+            time.sleep(args.period)
+    except KeyboardInterrupt:
+        coord.stop()
+        return 0
+
+
+def cmd_router(args) -> int:
+    from druid_tpu.server.router import RouterHttpServer, TieredBrokerSelector
+    tiers = {}
+    for spec in args.broker or []:
+        tier, _, url = spec.partition("=")
+        if not url:
+            tier, url = "_default", spec
+        tiers.setdefault(tier, []).append(url)
+    if "_default" not in tiers:
+        raise SystemExit("router needs at least one --broker [tier=]URL")
+    selector = TieredBrokerSelector(tiers, default_tier="_default")
+    http = RouterHttpServer(selector, port=args.port).start()
+    print(f"router listening on :{http.port} "
+          f"(tiers: {', '.join(sorted(tiers))})", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        http.stop()
+        return 0
+
+
 def cmd_dump_segment(args) -> int:
     """Segment forensics (cli/DumpSegment.java)."""
     from druid_tpu.storage.format import load_segment, read_segment_meta
@@ -147,6 +266,35 @@ def main(argv=None) -> int:
     s = sub.add_parser("server", help="run the single-process cluster")
     s.add_argument("--config", default=None, help="properties/json file")
     s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("historical", help="run one data-serving node")
+    s.add_argument("--name", default="historical0")
+    s.add_argument("--port", type=int, default=8083)
+    s.add_argument("--tier", default="_default_tier")
+    s.add_argument("--segments-dir", default=None,
+                   help="preload persisted segments from this directory")
+    s.set_defaults(fn=cmd_historical)
+
+    s = sub.add_parser("broker", help="run the scatter-gather broker")
+    s.add_argument("--port", type=int, default=8082)
+    s.add_argument("--data-node", action="append",
+                   help="data node base URL (repeatable)")
+    s.add_argument("--sync-period", type=float, default=10.0)
+    s.set_defaults(fn=cmd_broker)
+
+    s = sub.add_parser("coordinator", help="run the coordinator loop")
+    s.add_argument("--metadata", default=":memory:",
+                   help="sqlite path for the metadata store")
+    s.add_argument("--storage-dir", default="./deep-storage")
+    s.add_argument("--data-node", action="append")
+    s.add_argument("--period", type=float, default=10.0)
+    s.set_defaults(fn=cmd_coordinator)
+
+    s = sub.add_parser("router", help="run the query router")
+    s.add_argument("--port", type=int, default=8888)
+    s.add_argument("--broker", action="append",
+                   help="broker URL or tier=URL (repeatable)")
+    s.set_defaults(fn=cmd_router)
 
     s = sub.add_parser("dump-segment", help="inspect an on-disk segment")
     s.add_argument("directory")

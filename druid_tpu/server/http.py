@@ -55,6 +55,10 @@ class QueryHttpServer:
         self.lifecycle = lifecycle
         self.sql_executor = sql_executor
         self.auth_chain = auth_chain
+        self.avatica = None
+        if sql_executor is not None:
+            from druid_tpu.server.avatica import AvaticaServer
+            self.avatica = AvaticaServer(sql_executor)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,6 +110,27 @@ class QueryHttpServer:
                             return
                         identity = auth
                     payload = self._body()
+                    if self.path.rstrip("/") == "/druid/v2/sql/avatica":
+                        if outer.avatica is None:
+                            self._reply(404, {"error": "SQL not enabled"})
+                            return
+                        authorize = None
+                        if outer.auth_chain is not None:
+                            from druid_tpu.server.security import (
+                                READ, Resource, ResourceAction)
+
+                            def authorize(stmt, params=(), _id=identity):
+                                tables, is_meta = \
+                                    outer.sql_executor.tables_of(stmt,
+                                                                 params)
+                                return is_meta or \
+                                    outer.auth_chain.authorize_all(
+                                        _id, [ResourceAction(
+                                            Resource(t), READ)
+                                            for t in tables])
+                        self._reply(200, outer.avatica.handle(payload,
+                                                              authorize))
+                        return
                     if self.path.rstrip("/") == "/druid/v2/sql":
                         if outer.sql_executor is None:
                             self._reply(404, {"error": "SQL not enabled"})

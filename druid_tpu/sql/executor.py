@@ -23,11 +23,41 @@ class SqlExecutor:
     QueryExecutor (or any object with .run(query) and .datasources /
     .segments_of)."""
 
-    def __init__(self, query_executor):
+    def __init__(self, query_executor, schema_ttl: float = 30.0):
         self.qe = query_executor
+        self.schema_ttl = schema_ttl
+        self._schema_cache = None   # (expiry monotonic, SqlSchema)
 
     # ---- schema discovery (DruidSchema analog) ------------------------
     def schema(self) -> SqlSchema:
+        """TTL-cached: remote-broker discovery costs a segmentMetadata
+        scatter per datasource; the reference's DruidSchema likewise
+        refreshes on a period, not per statement. invalidate_schema()
+        forces the next call to rebuild."""
+        import time
+        cached = self._schema_cache
+        if cached is not None and time.monotonic() < cached[0]:
+            return cached[1]
+        schema = self._build_schema()
+        self._schema_cache = (time.monotonic() + self.schema_ttl, schema)
+        return schema
+
+    def invalidate_schema(self) -> None:
+        self._schema_cache = None
+
+    def _plan(self, sel):
+        """Plan with one invalidate-and-retry on an unknown table — a
+        datasource announced since the last schema refresh must be
+        queryable immediately, not after the TTL."""
+        try:
+            return plan_sql(sel, self.schema())
+        except PlannerError as e:
+            if "unknown table" in str(e) and self._schema_cache is not None:
+                self.invalidate_schema()
+                return plan_sql(sel, self.schema())
+            raise
+
+    def _build_schema(self) -> SqlSchema:
         tables: Dict[str, Dict[str, str]] = {}
         for ds in self.qe.datasources:
             cols: Dict[str, str] = {}
@@ -37,13 +67,36 @@ class SqlExecutor:
                 for m, col in seg.metrics.items():
                     t = col.type.value if hasattr(col.type, "value") else str(col.type)
                     cols.setdefault(m, t)
+            if not cols:
+                # no local segment objects (broker over REMOTE nodes):
+                # discover via a merged segmentMetadata query — exactly the
+                # reference's DruidSchema refresh
+                cols = self._metadata_schema(ds)
             tables[ds] = cols
         return SqlSchema(tables)
+
+    def _metadata_schema(self, datasource: str) -> Dict[str, str]:
+        from druid_tpu.query.model import SegmentMetadataQuery
+        try:
+            rows = self.qe.run(SegmentMetadataQuery.of(
+                datasource, merge=True, analysis_types=()))
+        except Exception:
+            return {}
+        out: Dict[str, str] = {}
+        for analysis in rows:
+            for name, info in (analysis.get("columns") or {}).items():
+                if name == "__time":
+                    continue
+                t = str(info.get("type", "STRING")).lower()
+                out.setdefault(
+                    name, t if t in ("string", "long", "float", "double")
+                    else "string")
+        return out
 
     # ---- entry points --------------------------------------------------
     def explain(self, sql: str, parameters: Sequence[object] = ()) -> dict:
         sel = parse_sql(sql, parameters)
-        planned = plan_sql(sel, self.schema())
+        planned = self._plan(sel)
         if planned.native is None:
             return {"queryType": "metadata", "table": planned.meta_table}
         return planned.native.to_json()
@@ -57,7 +110,7 @@ class SqlExecutor:
             import json as _json
             planned_json = self.explain(_strip_explain(sql), parameters)
             return (["PLAN"], [[_json.dumps(planned_json, sort_keys=True)]])
-        planned = plan_sql(sel, self.schema())
+        planned = self._plan(sel)
         if planned.meta_table is not None:
             return self._run_meta(planned)
         rows = self.qe.run(planned.native)
@@ -69,7 +122,7 @@ class SqlExecutor:
         authorization surface (reference: SqlResource resource-action
         collection before execution)."""
         sel = parse_sql(sql, parameters)
-        planned = plan_sql(sel, self.schema())
+        planned = self._plan(sel)
         if planned.meta_table is not None:
             return [], True
         tables: List[str] = []

@@ -335,7 +335,7 @@ def _fn_round(x, n=0):
     above 2^53."""
     xp = _xp(x)
     import numpy as _np
-    n = int(n) if not hasattr(n, "shape") else int(n)
+    n = int(n)
     scale = 10 ** n if n >= 0 else 0
     if hasattr(x, "shape"):
         if _np.issubdtype(getattr(x, "dtype", _np.float64), _np.integer):

@@ -31,6 +31,12 @@ def parse_ts(value) -> int:
         return int(value.timestamp() * 1000)
     if isinstance(value, str):
         s = value.strip()
+        # eternity bounds round-trip through their own wire tokens —
+        # an unbounded query serialized to a remote node must parse back
+        if s == "-eternity":
+            return ETERNITY_START
+        if s in ("+eternity", "eternity"):
+            return ETERNITY_END
         # Normalize bare date / missing tz
         m = re.match(r"^(\d{4})-(\d{2})-(\d{2})$", s)
         if m:

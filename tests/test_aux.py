@@ -263,6 +263,43 @@ def test_config_env_camelcase(tmp_path):
     assert cfg.get_int("server.dataNodes", 1) == 4
 
 
+def test_cli_node_builders_compose_a_cluster(tmp_path, segment):
+    """historical (preloading persisted segments from disk) + broker
+    (discovering it over /status sync) built exactly as the per-node CLI
+    commands build them, then queried over HTTP."""
+    import json
+    import urllib.request
+    from druid_tpu.cli import build_broker, build_historical
+    from druid_tpu.storage.format import persist_segment
+    seg_dir = tmp_path / "segments" / "s0"
+    persist_segment(segment, str(seg_dir))
+    node, hist_srv, loaded = build_historical(
+        "h0", str(tmp_path / "segments"), port=0)
+    assert loaded == 1
+    view, broker, http = build_broker([hist_srv.url], port=0)
+    try:
+        body = json.dumps({
+            "queryType": "timeseries", "dataSource": "test",
+            "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+            "aggregations": [{"type": "count", "name": "n"}]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/druid/v2", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        rows = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert rows[0]["result"]["n"] == segment.n_rows
+        # SQL rides the same broker
+        sq = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/druid/v2/sql",
+            data=json.dumps({"query":
+                             "SELECT COUNT(*) c FROM test"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        out = json.loads(urllib.request.urlopen(sq, timeout=60).read())
+        assert out[0]["c"] == segment.n_rows
+    finally:
+        http.stop()
+        hist_srv.stop()
+
+
 def test_cli_validate_rejects_garbage(tmp_path, capsys):
     from druid_tpu.cli import main
     d = tmp_path / "bad"
