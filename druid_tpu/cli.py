@@ -131,13 +131,28 @@ def build_broker(data_node_urls, port: int = 8082):
     return view, broker, http
 
 
+def _reregister_missing(view, urls) -> None:
+    """Configured nodes that were dropped by liveness re-register when
+    they come back — a blip must not remove a statically-configured URL
+    until process restart."""
+    from druid_tpu.cluster import RemoteDataNodeClient
+    for i, url in enumerate(urls):
+        name = f"data{i}"
+        if view.node(name) is None:
+            client = RemoteDataNodeClient(name, url)
+            if client.ping():
+                view.register(client)
+
+
 def cmd_broker(args) -> int:
-    view, broker, http = build_broker(args.data_node or [], args.port)
+    urls = args.data_node or []
+    view, broker, http = build_broker(urls, args.port)
     print(f"broker listening on :{http.port} "
-          f"({len(args.data_node or [])} data node(s))", flush=True)
+          f"({len(urls)} data node(s))", flush=True)
     try:
         while True:
-            view.check_liveness()
+            view.check_liveness(failures_required=3)
+            _reregister_missing(view, urls)
             view.sync_all()
             time.sleep(args.sync_period)
     except KeyboardInterrupt:
@@ -162,6 +177,8 @@ def cmd_coordinator(args) -> int:
     try:
         while True:
             stats = coord.run_once()
+            _reregister_missing(view, args.data_node or [])
+            view.sync_all()
             if stats.assigned or stats.dropped or stats.nodes_removed:
                 print(f"cycle: assigned={stats.assigned} "
                       f"dropped={stats.dropped} "

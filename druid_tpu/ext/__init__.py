@@ -16,8 +16,13 @@ from druid_tpu.ext.histogram import (ApproximateHistogramAggregator,
                                      HistogramQuantilePostAgg, HistogramValue)
 from druid_tpu.ext.bloom import (BloomFilterAggregator, BloomFilterValue,
                                  BloomDimFilter)
+from druid_tpu.ext.hllsketch import (HLLSketchBuildAggregator,
+                                     HLLSketchMergeAggregator,
+                                     HLLSketchToEstimatePostAgg)
 
 __all__ = [
+    "HLLSketchBuildAggregator", "HLLSketchMergeAggregator",
+    "HLLSketchToEstimatePostAgg",
     "VarianceAggregator", "StandardDeviationPostAgg",
     "ThetaSketchAggregator", "ThetaSketchValue", "ThetaSketchEstimatePostAgg",
     "ThetaSketchSetOpPostAgg", "QuantilesSketchAggregator", "QuantilePostAgg",

@@ -1,0 +1,80 @@
+"""datasketches HLL sketch module (wire-format parity).
+
+Reference analog: extensions-core/datasketches/src/main/java/org/apache/
+druid/query/aggregation/datasketches/hll/ — HllSketchBuildAggregatorFactory
+("HLLSketchBuild"), HllSketchMergeAggregatorFactory ("HLLSketchMerge"),
+HllSketchToEstimatePostAggregator. The capability (mergeable approximate
+distinct-count state with configurable precision) is served by the same
+device HLL register kernel as hyperUnique (engine/hll.py — scatter-max over
+2^lgK registers); these types provide the datasketches JSON surface so
+reference queries run unmodified.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from druid_tpu.query.aggregators import (HyperUniqueAggregator,
+                                         register_aggregator)
+from druid_tpu.query.postaggs import (PostAggregator, postagg_from_json,
+                                      register_postagg)
+
+
+@dataclass(frozen=True)
+class HLLSketchBuildAggregator(HyperUniqueAggregator):
+    """Build a sketch from a raw column ("HLLSketchBuild"); lgK maps onto
+    the register count exactly like hyperUnique's log2m."""
+
+    def to_json(self):
+        return {"type": "HLLSketchBuild", "name": self.name,
+                "fieldName": self.field, "lgK": self.log2m,
+                "round": self.round}
+
+
+@dataclass(frozen=True)
+class HLLSketchMergeAggregator(HyperUniqueAggregator):
+    """Merge pre-built sketch columns ("HLLSketchMerge") — our HLL metric
+    columns store register arrays, so merge and build share the kernel."""
+
+    def to_json(self):
+        return {"type": "HLLSketchMerge", "name": self.name,
+                "fieldName": self.field, "lgK": self.log2m,
+                "round": self.round}
+
+
+@dataclass(frozen=True)
+class HLLSketchToEstimatePostAgg(PostAggregator):
+    name: str
+    field: PostAggregator = None
+    round: bool = False
+
+    def compute(self, row):
+        v = self.field.compute(row)
+        if isinstance(v, np.ndarray):
+            out = np.asarray([float(x) if x is not None else 0.0
+                              for x in v])
+            return np.round(out) if self.round else out
+        if v is None:
+            return None
+        return round(float(v)) if self.round else float(v)
+
+    def to_json(self):
+        return {"type": "HLLSketchToEstimate", "name": self.name,
+                "field": self.field.to_json(), "round": self.round}
+
+
+def _mk(cls):
+    def from_json(j):
+        return cls(j["name"], j["fieldName"], log2m=int(j.get("lgK", 12)),
+                   round=bool(j.get("round", False)))
+    return from_json
+
+
+register_aggregator("HLLSketchBuild", _mk(HLLSketchBuildAggregator))
+register_aggregator("HLLSketchMerge", _mk(HLLSketchMergeAggregator))
+register_postagg(
+    "HLLSketchToEstimate",
+    lambda j: HLLSketchToEstimatePostAgg(
+        j["name"], postagg_from_json(j["field"]),
+        bool(j.get("round", False))))

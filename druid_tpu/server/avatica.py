@@ -20,6 +20,14 @@ _SQL_TYPE = {"string": ("VARCHAR", 12), "long": ("BIGINT", -5),
              "timestamp": ("TIMESTAMP", 93)}
 
 
+def _ident_key(identity) -> Optional[str]:
+    """Normalize an identity (AuthenticationResult | str | None) to the
+    comparable key connections bind to."""
+    if identity is None:
+        return None
+    return getattr(identity, "identity", str(identity))
+
+
 def _signature(columns: Sequence[str], rows: Sequence[list]) -> dict:
     """Column signature inferred from the result values (the executor
     shapes types; Avatica needs JDBC type codes)."""
@@ -58,8 +66,9 @@ class _Statement:
 
 
 class _Connection:
-    def __init__(self, connection_id: str):
+    def __init__(self, connection_id: str, identity: Optional[str] = None):
         self.id = connection_id
+        self.identity = identity     # bound at open; all requests must match
         self.statements: Dict[int, _Statement] = {}
         self.next_statement = 0
         self.last_used = time.monotonic()
@@ -78,19 +87,30 @@ class AvaticaServer:
         self._lock = threading.Lock()
 
     # ---- dispatch -------------------------------------------------------
-    def handle(self, payload: dict, authorize=None) -> dict:
-        """authorize: optional (sql) -> bool — the same per-table decision
-        the plain SQL resource makes; execution requests run it first."""
+    def handle(self, payload: dict, authorize=None,
+               identity: Optional[str] = None) -> dict:
+        """authorize: optional (sql, params) -> bool — the same per-table
+        decision the plain SQL resource makes; execution requests run it
+        first. identity: the authenticated caller — connections BIND to
+        the identity that opened them, so one user cannot fetch another's
+        buffered rows by guessing a connection id (DruidMeta ties
+        connections to the authenticated user)."""
         req = payload.get("request")
         fn = getattr(self, f"_req_{req}", None)
         if fn is None:
             return self._error(f"unsupported avatica request {req!r}")
+        # request-scoped copy: identity rides the payload (instance state
+        # would race across concurrent handler threads)
+        payload = dict(payload)
+        payload["__identity__"] = _ident_key(identity)
         try:
             if req in ("prepareAndExecute", "execute"):
                 return fn(payload, authorize)
             return fn(payload)
         except KeyError as e:
             return self._error(f"missing field {e}")
+        except PermissionError as e:
+            return self._error(str(e))
         except Exception as e:
             return self._error(f"{type(e).__name__}: {e}")
 
@@ -106,6 +126,9 @@ class AvaticaServer:
             conn = self._conns.get(cid)
             if conn is None:
                 raise ValueError(f"unknown connection {cid}")
+            if conn.identity != payload.get("__identity__"):
+                raise PermissionError(
+                    "connection belongs to another identity")
             conn.last_used = time.monotonic()
             return conn
 
@@ -115,10 +138,17 @@ class AvaticaServer:
         # must not permanently consume a slot (DruidMeta's timeout reaper)
         self.expire_idle()
         cid = payload.get("connectionId") or str(uuid.uuid4())
+        identity = payload.get("__identity__")
         with self._lock:
+            existing = self._conns.get(cid)
+            if existing is not None:
+                if existing.identity != identity:
+                    return self._error(
+                        "connection belongs to another identity")
+                return {"response": "openConnection", "connectionId": cid}
             if len(self._conns) >= self.max_connections:
                 return self._error("too many connections")
-            self._conns.setdefault(cid, _Connection(cid))
+            self._conns[cid] = _Connection(cid, identity)
         return {"response": "openConnection", "connectionId": cid}
 
     def _req_closeConnection(self, payload: dict) -> dict:
@@ -200,7 +230,8 @@ class AvaticaServer:
 
     def _req_execute(self, payload: dict, authorize=None) -> dict:
         handle = payload["statementHandle"]
-        conn = self._conn({"connectionId": handle["connectionId"]})
+        conn = self._conn({**payload,
+                           "connectionId": handle["connectionId"]})
         st = conn.statements.get(handle["id"])
         if st is None or st.sql is None:
             return self._error("statement not prepared")

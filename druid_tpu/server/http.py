@@ -116,41 +116,22 @@ class QueryHttpServer:
                             return
                         authorize = None
                         if outer.auth_chain is not None:
-                            from druid_tpu.server.security import (
-                                READ, Resource, ResourceAction)
-
                             def authorize(stmt, params=(), _id=identity):
-                                tables, is_meta = \
-                                    outer.sql_executor.tables_of(stmt,
-                                                                 params)
-                                return is_meta or \
-                                    outer.auth_chain.authorize_all(
-                                        _id, [ResourceAction(
-                                            Resource(t), READ)
-                                            for t in tables])
-                        self._reply(200, outer.avatica.handle(payload,
-                                                              authorize))
+                                return outer._authorize_sql(_id, stmt,
+                                                            params)
+                        self._reply(200, outer.avatica.handle(
+                            payload, authorize, identity=identity))
                         return
                     if self.path.rstrip("/") == "/druid/v2/sql":
                         if outer.sql_executor is None:
                             self._reply(404, {"error": "SQL not enabled"})
                             return
-                        if outer.auth_chain is not None:
-                            # SQL authorizes over the statement's tables —
-                            # the same per-datasource decision the native
-                            # path makes (SqlResource)
-                            from druid_tpu.server.security import (
-                                READ, Resource, ResourceAction)
-                            tables, is_meta = outer.sql_executor.tables_of(
-                                payload["query"],
-                                payload.get("parameters") or ())
-                            if not is_meta and not \
-                                    outer.auth_chain.authorize_all(
-                                        identity,
-                                        [ResourceAction(Resource(t), READ)
-                                         for t in tables]):
-                                self._reply(403, {"error": "unauthorized"})
-                                return
+                        if outer.auth_chain is not None and not \
+                                outer._authorize_sql(
+                                    identity, payload["query"],
+                                    payload.get("parameters") or ()):
+                            self._reply(403, {"error": "unauthorized"})
+                            return
                         cols, rows = outer.sql_executor.execute(
                             payload["query"],
                             payload.get("parameters") or ())
@@ -202,6 +183,19 @@ class QueryHttpServer:
     def _datasources(self):
         r = self.lifecycle.runner
         return list(getattr(r, "datasources", []) or [])
+
+    def _authorize_sql(self, identity, statement: str,
+                       parameters=()) -> bool:
+        """Per-table READ authorization for a SQL statement — shared by
+        the plain SQL resource and the Avatica endpoint (SqlResource's
+        resource-action collection)."""
+        from druid_tpu.server.security import (READ, Resource,
+                                               ResourceAction)
+        tables, is_meta = self.sql_executor.tables_of(statement, parameters)
+        if is_meta:
+            return True
+        return self.auth_chain.authorize_all(
+            identity, [ResourceAction(Resource(t), READ) for t in tables])
 
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -181,7 +181,8 @@ class RouterHttpServer:
                     pass
 
             def do_POST(self):
-                if self.path.rstrip("/") in ("/druid/v2", "/druid/v2/sql"):
+                if self.path.rstrip("/") in ("/druid/v2", "/druid/v2/sql",
+                                             "/druid/v2/sql/avatica"):
                     self._proxy()
                 else:
                     self._send(404, b'{"error": "unknown path"}')

@@ -23,10 +23,16 @@ class SqlExecutor:
     QueryExecutor (or any object with .run(query) and .datasources /
     .segments_of)."""
 
-    def __init__(self, query_executor, schema_ttl: float = 30.0):
+    def __init__(self, query_executor, schema_ttl: float = 30.0,
+                 min_refresh_interval: float = 1.0):
         self.qe = query_executor
         self.schema_ttl = schema_ttl
+        #: floor between unknown-table-triggered rebuilds — a client
+        #: looping on a typo'd table must not reduce the TTL to zero and
+        #: hammer historicals with segmentMetadata scatters
+        self.min_refresh_interval = min_refresh_interval
         self._schema_cache = None   # (expiry monotonic, SqlSchema)
+        self._last_build = 0.0
 
     # ---- schema discovery (DruidSchema analog) ------------------------
     def schema(self) -> SqlSchema:
@@ -40,6 +46,7 @@ class SqlExecutor:
             return cached[1]
         schema = self._build_schema()
         self._schema_cache = (time.monotonic() + self.schema_ttl, schema)
+        self._last_build = time.monotonic()
         return schema
 
     def invalidate_schema(self) -> None:
@@ -49,10 +56,14 @@ class SqlExecutor:
         """Plan with one invalidate-and-retry on an unknown table — a
         datasource announced since the last schema refresh must be
         queryable immediately, not after the TTL."""
+        import time
         try:
             return plan_sql(sel, self.schema())
         except PlannerError as e:
-            if "unknown table" in str(e) and self._schema_cache is not None:
+            if "unknown table" in str(e) \
+                    and self._schema_cache is not None \
+                    and time.monotonic() - self._last_build \
+                    >= self.min_refresh_interval:
                 self.invalidate_schema()
                 return plan_sql(sel, self.schema())
             raise

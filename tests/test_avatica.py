@@ -149,3 +149,52 @@ def test_avatica_respects_authorization(segments):
         assert denied["response"] == "error"
     finally:
         srv.stop()
+
+
+def test_avatica_connection_bound_to_identity(segments):
+    """bob cannot fetch alice's buffered rows by presenting her
+    connection id (DruidMeta ties connections to the caller)."""
+    import base64
+    from druid_tpu.server import authorizer_for_query
+    from druid_tpu.server.security import (AuthChain,
+                                           BasicHTTPAuthenticator,
+                                           Permission, READ,
+                                           RoleBasedAuthorizer)
+    chain = AuthChain(
+        authenticators=[BasicHTTPAuthenticator(
+            {"alice": "pw", "bob": "pw2"}, authorizer_name="rbac")],
+        authorizers={"rbac": RoleBasedAuthorizer(
+            {"r": [Permission("test", actions=(READ,))]},
+            {"alice": ["r"]})})
+    ex = QueryExecutor(segments)
+    srv = QueryHttpServer(
+        QueryLifecycle(ex, authorizer=authorizer_for_query(chain)),
+        sql_executor=SqlExecutor(ex), auth_chain=chain).start()
+    url = f"http://127.0.0.1:{srv.port}/druid/v2/sql/avatica/"
+
+    def rpc(payload, user, pw):
+        hdr = {"Authorization": "Basic " + base64.b64encode(
+            f"{user}:{pw}".encode()).decode(),
+            "Content-Type": "application/json"}
+        req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                     headers=hdr, method="POST")
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    try:
+        cid = rpc({"request": "openConnection"}, "alice",
+                  "pw")["connectionId"]
+        sid = rpc({"request": "createStatement", "connectionId": cid},
+                  "alice", "pw")["statementId"]
+        ok = rpc({"request": "prepareAndExecute", "connectionId": cid,
+                  "statementId": sid, "sql": "SELECT COUNT(*) FROM test"},
+                 "alice", "pw")
+        assert ok["response"] == "executeResults"
+        # bob presents alice's connection: denied for fetch AND re-open
+        stolen = rpc({"request": "fetch", "connectionId": cid,
+                      "statementId": sid, "offset": 0}, "bob", "pw2")
+        assert stolen["response"] == "error"
+        reopen = rpc({"request": "openConnection", "connectionId": cid},
+                     "bob", "pw2")
+        assert reopen["response"] == "error"
+    finally:
+        srv.stop()

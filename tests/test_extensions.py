@@ -259,3 +259,45 @@ def test_extension_sharded_merge(segments):
     assert remote["v"] == pytest.approx(local["v"], rel=1e-12)
     assert remote["p50"] == local["p50"]
     assert remote["u"] == local["u"]       # exact state merge across nodes
+
+
+def test_hllsketch_build_and_estimate(ex, segment):
+    """datasketches HLLSketch JSON surface (HLLSketchBuild +
+    HLLSketchToEstimate) over the shared HLL register kernel."""
+    frame = rows_as_frame(segment)
+    rows = ex.run_json({
+        "queryType": "timeseries", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+        "aggregations": [{"type": "HLLSketchBuild", "name": "u",
+                          "fieldName": "dimHi", "lgK": 12}],
+        "postAggregations": [{"type": "HLLSketchToEstimate", "name": "est",
+                              "round": True,
+                              "field": {"type": "fieldAccess",
+                                        "fieldName": "u"}}]})
+    exact = len(np.unique(frame["dimHi"]))
+    est = rows[0]["result"]["est"]
+    assert abs(est - exact) / exact < 0.1
+    # merge type parses + rounds
+    from druid_tpu.query.aggregators import agg_from_json as afj
+    m = afj({"type": "HLLSketchMerge", "name": "u", "fieldName": "dimHi",
+             "lgK": 11, "round": True})
+    assert m.log2m == 11 and m.round
+    assert m.to_json()["type"] == "HLLSketchMerge"
+
+
+def test_hllsketch_grouped_matches_hyperunique(ex, segment):
+    got = ex.run_json({
+        "queryType": "groupBy", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+        "dimensions": ["dimA"],
+        "aggregations": [{"type": "HLLSketchBuild", "name": "u",
+                          "fieldName": "dimB", "lgK": 11,
+                          "round": True}]})
+    want = ex.run_json({
+        "queryType": "groupBy", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+        "dimensions": ["dimA"],
+        "aggregations": [{"type": "hyperUnique", "name": "u",
+                          "fieldName": "dimB", "round": True}]})
+    key = lambda rows: {r["event"]["dimA"]: r["event"]["u"] for r in rows}
+    assert key(got) == key(want)
