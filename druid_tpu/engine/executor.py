@@ -145,12 +145,25 @@ def subquery_segment(inner_query: Query, rows) -> Segment:
     interval = Interval(min(iv.start for iv in ivs),
                         max(iv.end for iv in ivs)) if ivs \
         else Interval.eternity()
+    # NUMERIC inner dimensions (expression/numeric dims) materialize as
+    # numeric columns, not stringified dims — the outer query's schema
+    # types them numeric and aggregating str(value) would be silently wrong
+    numeric_dims = set()
+    for d in dim_names:
+        for r in rows:
+            v = r["event"].get(d)
+            if v is None:
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                numeric_dims.add(d)
+            break
     b = SegmentBuilder("__subquery__", interval, version="sub")
     for r in rows:
         event = r["event"]
         dims = {d: (None if event.get(d) is None else str(event.get(d)))
-                for d in dim_names}
+                for d in dim_names if d not in numeric_dims}
         metrics = {k: v for k, v in event.items()
-                   if k not in dims and isinstance(v, (int, float))}
+                   if k not in dims and isinstance(v, (int, float))
+                   and not isinstance(v, bool)}
         b.add_row(int(r["timestamp"]), dims, metrics)
     return b.build()

@@ -152,6 +152,10 @@ class AvaticaServer:
         return {"response": "openConnection", "connectionId": cid}
 
     def _req_closeConnection(self, payload: dict) -> dict:
+        try:
+            self._conn(payload)      # identity must match to close
+        except ValueError:
+            return {"response": "closeConnection"}   # already gone: idempotent
         with self._lock:
             self._conns.pop(payload["connectionId"], None)
         return {"response": "closeConnection"}

@@ -91,15 +91,22 @@ class TieredBrokerSelector:
                     continue
         return self.default_tier
 
-    def pick(self, payload: dict, now_ms: Optional[int] = None):
+    def pick(self, payload: dict, now_ms: Optional[int] = None,
+             affinity_key: Optional[str] = None):
         """(tier, broker target) for one query payload. A selected tier
-        with no brokers falls back to the default tier."""
+        with no brokers falls back to the default tier. affinity_key pins
+        a key to ONE broker in the tier (Avatica connections are broker-
+        local state — the AvaticaConnectionBalancer's job)."""
         tier = self.select_tier(payload, now_ms)
         if not self.tiers.get(tier):
             tier = self.default_tier
         brokers = self.tiers.get(tier)
         if not brokers:
             raise ValueError(f"no brokers in tier {tier!r}")
+        if affinity_key is not None:
+            import hashlib
+            h = int(hashlib.md5(affinity_key.encode()).hexdigest()[:8], 16)
+            return tier, brokers[h % len(brokers)]
         with self._lock:
             i = next(self._rr[tier]) % len(brokers)
         return tier, brokers[i]
@@ -144,8 +151,16 @@ class RouterHttpServer:
                     payload = json.loads(raw or b"{}")
                 except ValueError:
                     payload = {}
+                affinity = None
+                if self.path.rstrip("/").endswith("/avatica"):
+                    # Avatica connections are broker-local state: every
+                    # request of one connection must land on one broker
+                    affinity = payload.get("connectionId") or \
+                        (payload.get("statementHandle") or {}).get(
+                            "connectionId")
                 try:
-                    _, target = outer_selector.pick(payload)
+                    _, target = outer_selector.pick(
+                        payload, affinity_key=affinity)
                 except Exception as e:
                     self._send(500, json.dumps(
                         {"error": str(e)}).encode())

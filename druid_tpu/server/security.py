@@ -197,7 +197,9 @@ def resource_actions_for_query(query) -> List[ResourceAction]:
 
     def add(q):
         for ds in (q.union_datasources or (q.datasource,)):
-            if ds and ds not in seen:
+            # the synthetic nested-query datasource is not a resource;
+            # the INNER query's real tables are what gets authorized
+            if ds and ds != "__subquery__" and ds not in seen:
                 seen.add(ds)
                 out.append(ResourceAction(Resource(ds, DATASOURCE), READ))
         if q.inner_query is not None:

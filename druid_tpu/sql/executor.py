@@ -8,6 +8,8 @@ the INFORMATION_SCHEMA tables.
 """
 from __future__ import annotations
 
+import time
+
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from druid_tpu.query.model import (GroupByQuery, ScanQuery, TimeBoundaryQuery,
@@ -40,7 +42,6 @@ class SqlExecutor:
         scatter per datasource; the reference's DruidSchema likewise
         refreshes on a period, not per statement. invalidate_schema()
         forces the next call to rebuild."""
-        import time
         cached = self._schema_cache
         if cached is not None and time.monotonic() < cached[0]:
             return cached[1]
@@ -56,7 +57,6 @@ class SqlExecutor:
         """Plan with one invalidate-and-retry on an unknown table — a
         datasource announced since the last schema refresh must be
         queryable immediately, not after the TTL."""
-        import time
         try:
             return plan_sql(sel, self.schema())
         except PlannerError as e:
@@ -141,7 +141,9 @@ class SqlExecutor:
         while q is not None:
             tables += list(q.union_datasources or (q.datasource,))
             q = q.inner_query
-        return sorted({t for t in tables if t}), False
+        # the synthetic nested-query datasource is not a real resource
+        return sorted({t for t in tables
+                       if t and t != "__subquery__"}), False
 
     def execute_dicts(self, sql: str, parameters: Sequence[object] = ()
                       ) -> List[dict]:

@@ -133,6 +133,7 @@ class Select:
     items: Tuple[SelectItem, ...]
     table: Optional[str] = None
     schema: Optional[str] = None        # e.g. INFORMATION_SCHEMA
+    subquery: Optional["Select"] = None  # FROM (SELECT ...) [alias]
     where: Optional[object] = None
     group_by: Tuple[object, ...] = ()
     having: Optional[object] = None
@@ -260,7 +261,7 @@ class _P:
         raise SqlParseError(f"expected identifier, got {t.text!r}")
 
     # -- entry
-    def select(self) -> Select:
+    def select(self, top_level: bool = True) -> Select:
         explain = False
         if self.accept_kw("EXPLAIN"):
             self.expect_kw("PLAN")
@@ -272,12 +273,24 @@ class _P:
         while self.accept_op(","):
             items.append(self.select_item())
         table = schema = None
+        subquery = None
         if self.accept_kw("FROM"):
-            name = self.ident()
-            if self.accept_op("."):
-                schema, table = name, self.ident()
+            if self.accept_op("("):
+                # FROM (SELECT ...) [alias] — nested query datasource
+                subquery = self.select(top_level=False)
+                self.expect_op(")")
+                if self.peek().kind in ("id", "qid") or \
+                        (self.peek().kind == "kw"
+                         and self.peek().text == "AS"):
+                    self.accept_kw("AS")
+                    self.ident()   # alias accepted, unused (one subquery)
+                table = "__subquery__"
             else:
-                table = name
+                name = self.ident()
+                if self.accept_op("."):
+                    schema, table = name, self.ident()
+                else:
+                    table = name
         where = self.expr() if self.accept_kw("WHERE") else None
         group_by: List[object] = []
         if self.accept_kw("GROUP"):
@@ -298,11 +311,11 @@ class _P:
         offset = 0
         if self.accept_kw("OFFSET"):
             offset = int(self.next().text)
-        if self.peek().kind != "eof":
+        if top_level and self.peek().kind != "eof":
             raise SqlParseError(f"unexpected trailing {self.peek().text!r}")
-        return Select(tuple(items), table, schema, where, tuple(group_by),
-                      having, tuple(order_by), limit, offset, distinct,
-                      explain)
+        return Select(tuple(items), table, schema, subquery, where,
+                      tuple(group_by), having, tuple(order_by), limit,
+                      offset, distinct, explain)
 
     def select_item(self) -> SelectItem:
         if self.peek().kind == "op" and self.peek().text == "*":

@@ -704,6 +704,8 @@ def plan_sql(sel: P.Select, schema: SqlSchema) -> PlannedQuery:
             return PlannedQuery(None, [], meta_table=sel.table.upper(),
                                 meta_select=sel)
         raise PlannerError(f"unknown schema [{sel.schema}]")
+    if sel.subquery is not None:
+        return _plan_nested(sel, schema)
     if sel.table is None:
         raise PlannerError("SELECT without FROM not supported")
     table = sel.table
@@ -734,6 +736,115 @@ def plan_sql(sel: P.Select, schema: SqlSchema) -> PlannedQuery:
     if not has_agg and not group_by:
         return _plan_scan(sel, table, schema, intervals, flt)
     return _plan_grouped(sel, table, schema, intervals, flt, group_by)
+
+
+def _plan_nested(sel: P.Select, schema: SqlSchema) -> PlannedQuery:
+    """FROM (SELECT ...): plan the inner statement, expose its output
+    aliases as the synthetic __subquery__ table, and nest the natives via
+    Query.inner_query — the executor/broker materialize inner groupBy rows
+    as an in-memory segment (reference: DruidOuterQueryRel +
+    GroupByStrategyV2.processSubqueryResult)."""
+    from dataclasses import replace as _dc_replace
+    inner = plan_sql(sel.subquery, schema)
+    if not isinstance(inner.native, GroupByQuery):
+        raise PlannerError(
+            "FROM (subquery) requires the inner statement to plan as a "
+            "groupBy (add a GROUP BY)")
+    if inner.sort_in_executor or inner.limit_in_executor is not None \
+            or inner.offset_in_executor:
+        raise PlannerError(
+            "inner ORDER BY/LIMIT handled outside the native query is not "
+            "nestable — put the ordering on the outer statement")
+
+    # inner outputs become the outer table's columns, typed from the
+    # inner aggregators (dims → string except expression dims → long)
+    agg_types: Dict[str, str] = {}
+    for a in inner.native.aggregations:
+        t = type(a).__name__
+        agg_types[a.name] = "long" if t in ("CountAggregator",
+                                            "LongSumAggregator",
+                                            "LongMinAggregator",
+                                            "LongMaxAggregator") else "double"
+    for pa in inner.native.post_aggregations:
+        agg_types[pa.name] = "double"
+    expr_dims = {d.output_name for d in inner.native.dimensions
+                 if isinstance(d, ExpressionDimensionSpec)}
+    cols: Dict[str, str] = {}
+    for o in inner.outputs:
+        if o.kind == "time":
+            continue      # outer references __time directly
+        if o.kind == "dim":
+            cols[o.alias] = "long" if o.key in expr_dims or \
+                o.alias in expr_dims else "string"
+        else:
+            cols[o.alias] = agg_types.get(o.key, "double")
+    inner_schema = SqlSchema({"__subquery__": cols})
+
+    # the OUTER statement plans against the synthetic table; the inner's
+    # native output columns are exposed under their SQL aliases, so remap
+    # the inner outputs to emit alias-named event fields (mapped by the
+    # NATIVE output name — projection order can differ from GROUP BY order)
+    outer = plan_sql(_dc_replace(sel, subquery=None), inner_schema)
+    inner_native = inner.native
+    dim_alias_by_key: Dict[str, str] = {}
+    value_renames: Dict[str, str] = {}
+    for o in inner.outputs:
+        ren = dim_alias_by_key if o.kind == "dim" else (
+            value_renames if o.kind == "value" else None)
+        if ren is None:
+            continue
+        if o.key in ren and ren[o.key] != o.alias:
+            # two SQL aliases share one deduped native field; a last-wins
+            # rename would silently drop one column — fail loudly
+            raise PlannerError(
+                f"inner column projected under two aliases "
+                f"({ren[o.key]!r}, {o.alias!r}) — project it once and "
+                f"reference the single alias in the outer statement")
+        ren[o.key] = o.alias
+    value_renames = {k: v for k, v in value_renames.items() if k != v}
+    needs_rename = value_renames or any(
+        dim_alias_by_key.get(d.output_name, d.output_name) != d.output_name
+        for d in inner_native.dimensions)
+    if needs_rename and inner_native.limit_spec is not None:
+        raise PlannerError(
+            "inner ORDER BY/LIMIT references pre-alias field names — put "
+            "the ordering on the outer statement")
+    ren_dims = []
+    for d in inner_native.dimensions:
+        alias = dim_alias_by_key.get(d.output_name, d.output_name)
+        if alias == d.output_name:
+            ren_dims.append(d)
+        elif isinstance(d, ExpressionDimensionSpec):
+            ren_dims.append(_dc_replace(d, output_name=alias))
+        elif isinstance(d, DefaultDimensionSpec):
+            ren_dims.append(DefaultDimensionSpec(d.dimension, alias))
+        else:
+            raise PlannerError(f"cannot alias nested dimension {d!r}")
+    if value_renames:
+        inner_native = _dc_replace(
+            inner_native,
+            aggregations=tuple(
+                _rename_agg(a, value_renames.get(a.name)) for a in
+                inner_native.aggregations),
+            post_aggregations=tuple(
+                _rename_postagg(pa, value_renames.get(pa.name)) for pa in
+                inner_native.post_aggregations))
+    inner_native = _dc_replace(inner_native, dimensions=tuple(ren_dims))
+    outer_native = _dc_replace(outer.native, inner_query=inner_native)
+    return PlannedQuery(outer_native, outer.outputs,
+                        sort_in_executor=outer.sort_in_executor,
+                        limit_in_executor=outer.limit_in_executor,
+                        offset_in_executor=outer.offset_in_executor)
+
+
+def _rename_agg(a, new_name):
+    from dataclasses import replace as _dc_replace
+    return a if new_name is None else _dc_replace(a, name=new_name)
+
+
+def _rename_postagg(pa, new_name):
+    from dataclasses import replace as _dc_replace
+    return pa if new_name is None else _dc_replace(pa, name=new_name)
 
 
 def _alias_of(it: P.SelectItem, i: int) -> str:

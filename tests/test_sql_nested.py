@@ -1,0 +1,141 @@
+"""Nested SQL: FROM (SELECT ...) subqueries planning onto the native
+inner_query mechanism (reference: DruidOuterQueryRel +
+GroupByStrategyV2.processSubqueryResult)."""
+import numpy as np
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.sql import PlannerError, SqlExecutor
+from tests.conftest import rows_as_frame
+
+
+@pytest.fixture(scope="module")
+def sql(segments):
+    return SqlExecutor(QueryExecutor(segments))
+
+
+@pytest.fixture(scope="module")
+def frames(segments):
+    return [rows_as_frame(s) for s in segments]
+
+
+def test_avg_of_grouped_sums(sql, frames):
+    """The canonical nested aggregate: average per-dimA total."""
+    cols, rows = sql.execute(
+        "SELECT AVG(s) a, COUNT(*) n FROM "
+        "(SELECT dimA, SUM(metLong) s FROM test GROUP BY dimA)")
+    sums = {}
+    for f in frames:
+        for d, v in zip(f["dimA"], f["metLong"]):
+            sums[d] = sums.get(d, 0) + int(v)
+    want_avg = sum(sums.values()) / len(sums)
+    assert rows[0][1] == len(sums)
+    assert rows[0][0] == pytest.approx(want_avg, rel=1e-9)
+
+
+def test_regroup_inner_dims(sql, frames):
+    """Outer GROUP BY over a projected inner dimension with aliasing."""
+    cols, rows = sql.execute(
+        "SELECT p, COUNT(*) n, SUM(total) t FROM "
+        "(SELECT SUBSTRING(dimB, 1, 3) p2, dimA p, SUM(metLong) total "
+        " FROM test GROUP BY 1, 2) "
+        "GROUP BY p ORDER BY p")
+    per_a = {}
+    for f in frames:
+        for a, v in zip(f["dimA"], f["metLong"]):
+            per_a[a] = per_a.get(a, 0) + int(v)
+    got = {r[0]: (r[1], r[2]) for r in rows}
+    assert set(got) == set(per_a)
+    for a, (n, t) in got.items():
+        assert t == per_a[a]
+
+
+def test_filter_on_inner_aggregate(sql, frames):
+    """WHERE over the inner's aggregate output (the HAVING-as-outer-filter
+    pattern)."""
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM "
+        "(SELECT dimB, COUNT(*) c FROM test GROUP BY dimB) "
+        "WHERE c > 100")
+    counts = {}
+    for f in frames:
+        for b in f["dimB"]:
+            counts[b] = counts.get(b, 0) + 1
+    want = sum(1 for v in counts.values() if v > 100)
+    assert rows[0][0] == want > 0
+
+
+def test_nested_requires_group_by(sql):
+    with pytest.raises(PlannerError):
+        sql.execute("SELECT COUNT(*) FROM "
+                    "(SELECT __time, dimA FROM test LIMIT 5)")
+
+
+def test_nested_explain_shows_query_datasource(sql):
+    plan = sql.explain(
+        "SELECT AVG(s) FROM "
+        "(SELECT dimA, SUM(metLong) s FROM test GROUP BY dimA)")
+    assert plan["dataSource"]["type"] == "query"
+    assert plan["dataSource"]["query"]["queryType"] == "groupBy"
+
+
+def test_nested_with_alias_and_deeper_nesting(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT MAX(a) FROM "
+        "(SELECT p, AVG(s) a FROM "
+        " (SELECT dimA p, dimB, SUM(metLong) s FROM test GROUP BY 1, 2) t1 "
+        " GROUP BY p) AS t2")
+    per = {}
+    for f in frames:
+        for a, b, v in zip(f["dimA"], f["dimB"], f["metLong"]):
+            per.setdefault(a, {}).setdefault(b, 0)
+            per[a][b] += int(v)
+    want = max(sum(d.values()) / len(d) for d in per.values())
+    assert rows[0][0] == pytest.approx(want, rel=1e-9)
+
+
+def test_nested_numeric_expression_dim_sums_correctly(sql, frames):
+    """Numeric inner dimension outputs materialize as numeric columns —
+    the outer SUM must be arithmetic, not a sum over stringified values."""
+    cols, rows = sql.execute(
+        "SELECT SUM(e) FROM "
+        "(SELECT MOD(metLong, 10) e, dimA FROM test GROUP BY 1, 2)")
+    per = set()
+    for f in frames:
+        for a, v in zip(f["dimA"], f["metLong"]):
+            per.add((int(v) % 10, a))
+    want = sum(e for e, _ in per)
+    assert rows[0][0] == want
+
+
+def test_nested_duplicate_alias_rejected(sql):
+    with pytest.raises(PlannerError, match="two aliases"):
+        sql.execute(
+            "SELECT SUM(a) sa, SUM(b) sb FROM "
+            "(SELECT dimA, SUM(metLong) a, SUM(metLong) b FROM test "
+            " GROUP BY dimA)")
+
+
+def test_nested_authorization_uses_real_tables(segments):
+    from druid_tpu.server.security import (AuthChain, Permission, READ,
+                                           AuthenticationResult,
+                                           RoleBasedAuthorizer,
+                                           authorizer_for_query,
+                                           resource_actions_for_query)
+    sql2 = SqlExecutor(QueryExecutor(segments))
+    tables, is_meta = sql2.tables_of(
+        "SELECT SUM(s) FROM "
+        "(SELECT dimA, SUM(metLong) s FROM test GROUP BY dimA)")
+    assert tables == ["test"]
+    chain = AuthChain(authorizers={"rbac": RoleBasedAuthorizer(
+        {"r": [Permission("test", actions=(READ,))]}, {"alice": ["r"]}),
+        "allowAll": __import__(
+            "druid_tpu.server.security",
+            fromlist=["AllowAllAuthorizer"]).AllowAllAuthorizer()})
+    check = authorizer_for_query(chain)
+    plan = sql2._plan(__import__(
+        "druid_tpu.sql.parser", fromlist=["parse_sql"]).parse_sql(
+        "SELECT SUM(s) FROM "
+        "(SELECT dimA, SUM(metLong) s FROM test GROUP BY dimA)"))
+    alice = AuthenticationResult("alice", "rbac")
+    assert check(alice, plan.native)
