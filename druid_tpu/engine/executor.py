@@ -147,7 +147,10 @@ def subquery_segment(inner_query: Query, rows) -> Segment:
         else Interval.eternity()
     # NUMERIC inner dimensions (expression/numeric dims) materialize as
     # numeric columns, not stringified dims — the outer query's schema
-    # types them numeric and aggregating str(value) would be silently wrong
+    # types them numeric and aggregating str(value) would be silently wrong.
+    # Type is sniffed from the first non-None value; NULLs in a numeric
+    # inner dim become 0 in the outer segment, matching the reference's
+    # default null-handling mode (NullHandling.defaultValue → 0)
     numeric_dims = set()
     for d in dim_names:
         for r in rows:

@@ -192,7 +192,10 @@ class QueryHttpServer:
         from druid_tpu.server.security import (READ, Resource,
                                                ResourceAction)
         tables, is_meta = self.sql_executor.tables_of(statement, parameters)
-        if is_meta:
+        # INFORMATION_SCHEMA itself needs no table grant, but a statement
+        # mixing it with real tables (UNION ALL arm, IN-subquery) must still
+        # pass the real tables' READ checks — is_meta alone is not a bypass
+        if is_meta and not tables:
             return True
         return self.auth_chain.authorize_all(
             identity, [ResourceAction(Resource(t), READ) for t in tables])

@@ -14,10 +14,19 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from druid_tpu.query.model import (GroupByQuery, ScanQuery, TimeBoundaryQuery,
                                    TimeseriesQuery, TopNQuery)
-from druid_tpu.sql.parser import Select, parse_sql
+from druid_tpu.sql import parser as P
+from druid_tpu.sql.parser import Select, Union, parse_sql
 from druid_tpu.sql.planner import (OutputColumn, PlannedQuery, PlannerError,
                                    SqlSchema, plan_sql)
 from druid_tpu.utils.intervals import ts_to_iso
+
+#: materialized IN-subquery row cap
+#: (reference: sql/.../planner/PlannerConfig.java maxSemiJoinRowsInMemory)
+MAX_SEMIJOIN_ROWS = 100_000
+
+#: expression AST node types the semi-join rewriter walks
+_AST_NODES = (P.Fn, P.Bin, P.Un, P.InExpr, P.LikeExpr, P.BetweenExpr,
+              P.IsNullExpr, P.Case, P.Cast, P.SelectItem, P.Lit, P.Col)
 
 
 class SqlExecutor:
@@ -104,46 +113,149 @@ class SqlExecutor:
                     else "string")
         return out
 
+    # ---- IN (SELECT ...) materialization (DruidSemiJoin analog) -------
+    def _expand_select(self, sel: Select, depth: int = 0) -> Select:
+        """Replace every `IN (SELECT ...)` in WHERE/HAVING (and the nested
+        FROM subquery) with the inner query's materialized value list."""
+        def on_in(node):
+            vals, had_null = self._materialize_semijoin(node.subquery, depth)
+            if node.negated and had_null:
+                # three-valued logic: `x NOT IN (..., NULL)` is never true
+                return P.Lit(False, "bool")
+            return P.InExpr(_map_expr(node.operand, on_in), vals,
+                            node.negated)
+
+        return _map_select(
+            sel, on_where=on_in, on_other=_reject_in,
+            on_subselect=lambda s: self._expand_select(s, depth))
+
+    def _materialize_semijoin(self, sub: Select, depth: int
+                              ) -> Tuple[Tuple[P.Lit, ...], bool]:
+        """(literal values, whether the inner result contained NULL)."""
+        if depth >= 3:
+            raise PlannerError("IN subqueries nested too deeply (max 3)")
+        names, rows = self._execute_select(sub, depth + 1)
+        if len(names) != 1:
+            raise PlannerError(
+                f"IN subquery must select exactly one column, got {names}")
+        if len(rows) > MAX_SEMIJOIN_ROWS:
+            raise PlannerError(
+                f"IN subquery returned {len(rows)} rows "
+                f"(max {MAX_SEMIJOIN_ROWS})")
+        vals, had_null = [], False
+        for r in rows:
+            v = r[0]
+            if v is None:
+                had_null = True   # NULL never matches `=`
+                continue
+            t = "string" if isinstance(v, str) else \
+                "double" if isinstance(v, float) else "long"
+            vals.append(P.Lit(v, t))
+        return tuple(vals), had_null
+
     # ---- entry points --------------------------------------------------
     def explain(self, sql: str, parameters: Sequence[object] = ()) -> dict:
-        sel = parse_sql(sql, parameters)
+        stmt = parse_sql(sql, parameters)
+        if isinstance(stmt, Union):
+            return {"queryType": "unionAll",
+                    "arms": [self._explain_select(a) for a in stmt.arms]}
+        return self._explain_select(stmt)
+
+    def _explain_select(self, sel: Select) -> dict:
+        """EXPLAIN never executes IN-subqueries (the reference's explain
+        surface is plan-only): each is planned separately and listed under
+        `semiJoinSubPlans`, with an empty IN standing in on the outer plan."""
+        sub_plans: List[dict] = []
+        sel = self._stub_semijoins(sel, sub_plans)
         planned = self._plan(sel)
         if planned.native is None:
-            return {"queryType": "metadata", "table": planned.meta_table}
-        return planned.native.to_json()
+            out = {"queryType": "metadata", "table": planned.meta_table}
+        else:
+            out = planned.native.to_json()
+        if sub_plans:
+            out = dict(out)
+            out["semiJoinSubPlans"] = sub_plans
+        return out
+
+    def _stub_semijoins(self, sel: Select, sub_plans: List[dict]) -> Select:
+        def on_in(node):
+            sub_plans.append(self._explain_select(node.subquery))
+            return P.InExpr(node.operand, (), node.negated)
+
+        return _map_select(
+            sel, on_where=on_in, on_other=_reject_in,
+            on_subselect=lambda s: self._stub_semijoins(s, sub_plans))
 
     def execute(self, sql: str, parameters: Sequence[object] = ()
                 ) -> Tuple[List[str], List[list]]:
         """Returns (column names, rows as lists) — the SQL resource's
         array-result format."""
-        sel = parse_sql(sql, parameters)
-        if sel.explain:
+        stmt = parse_sql(sql, parameters)
+        if stmt.explain:
             import json as _json
             planned_json = self.explain(_strip_explain(sql), parameters)
             return (["PLAN"], [[_json.dumps(planned_json, sort_keys=True)]])
-        planned = self._plan(sel)
+        if isinstance(stmt, Union):
+            return self._execute_union(stmt)
+        return self._execute_select(stmt, 0)
+
+    def _execute_select(self, sel: Select, depth: int
+                        ) -> Tuple[List[str], List[list]]:
+        planned = self._plan(self._expand_select(sel, depth))
         if planned.meta_table is not None:
             return self._run_meta(planned)
         rows = self.qe.run(planned.native)
         return self._shape(planned, rows)
 
+    def _execute_union(self, un: Union) -> Tuple[List[str], List[list]]:
+        """Arms execute independently and concatenate; union-level ORDER
+        BY/LIMIT apply to the combined rows; column names come from the
+        first arm (reference: DruidUnionRel)."""
+        names: Optional[List[str]] = None
+        rows: List[list] = []
+        for arm in un.arms:
+            cols, arm_rows = self._execute_select(arm, 0)
+            if names is None:
+                names = cols
+            elif len(cols) != len(names):
+                raise PlannerError(
+                    "UNION ALL arms must select the same number of columns "
+                    f"({len(names)} vs {len(cols)})")
+            rows.extend(arm_rows)
+        for oi in reversed(un.order_by):
+            ix = self._union_order_index(oi, names)
+            rows.sort(key=lambda r: _order_key(r[ix]),
+                      reverse=oi.descending)
+        if un.limit is not None or un.offset:
+            rows = rows[un.offset:
+                        un.offset + un.limit if un.limit is not None
+                        else None]
+        return names, rows
+
+    @staticmethod
+    def _union_order_index(oi, names: List[str]) -> int:
+        e = oi.expr
+        if isinstance(e, P.Col) and e.name in names:
+            return names.index(e.name)
+        if isinstance(e, P.Lit) and isinstance(e.value, int) \
+                and 1 <= e.value <= len(names):
+            return e.value - 1
+        raise PlannerError(
+            "UNION ALL ORDER BY must name an output column or ordinal")
+
     def tables_of(self, sql: str, parameters: Sequence[object] = ()
                   ) -> Tuple[List[str], bool]:
         """(datasources a statement reads, is_information_schema) — the
         authorization surface (reference: SqlResource resource-action
-        collection before execution)."""
-        sel = parse_sql(sql, parameters)
-        planned = self._plan(sel)
-        if planned.meta_table is not None:
-            return [], True
-        tables: List[str] = []
-        q = planned.native
-        while q is not None:
-            tables += list(q.union_datasources or (q.datasource,))
-            q = q.inner_query
-        # the synthetic nested-query datasource is not a real resource
-        return sorted({t for t in tables
-                       if t and t != "__subquery__"}), False
+        collection before execution). Purely syntactic: authorization must
+        not execute subqueries."""
+        stmt = parse_sql(sql, parameters)
+        tables: set = set()
+        meta = [False]
+        arms = stmt.arms if isinstance(stmt, Union) else (stmt,)
+        for arm in arms:
+            _collect_tables(arm, tables, meta)
+        return sorted(tables), meta[0]
 
     def execute_dicts(self, sql: str, parameters: Sequence[object] = ()
                       ) -> List[dict]:
@@ -165,6 +277,12 @@ class SqlExecutor:
                                r["result"].get(f) or 0), reverse=desc)
             for r in rows:
                 table.append(_emit(outs, r["result"], r["timestamp"]))
+            if not table and not q.skip_empty_buckets \
+                    and q.granularity.is_all:
+                # scalar aggregate whose time bound pruned every segment:
+                # still one row of aggregate identities, consistent with the
+                # engine's covered-but-empty bucket (COUNT()=0, SUM()=0)
+                table.append(_emit(outs, _empty_agg_row(q), None))
         elif isinstance(q, TopNQuery):
             for r in rows:
                 for entry in r["result"]:
@@ -213,6 +331,126 @@ class SqlExecutor:
             raise PlannerError(
                 f"unknown INFORMATION_SCHEMA table [{planned.meta_table}]")
         return _meta_select(sel, data)
+
+
+def _map_expr(node, on_in):
+    """Bottom-up expression-AST rewrite; `on_in` handles (and replaces)
+    every `IN (SELECT ...)` node. The single walker behind semi-join
+    expansion, EXPLAIN stubbing and table collection."""
+    import dataclasses
+    if isinstance(node, P.InExpr) and node.subquery is not None:
+        return on_in(node)
+    if isinstance(node, _AST_NODES):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, _AST_NODES):
+                nv = _map_expr(v, on_in)
+            elif isinstance(v, tuple):
+                nv = tuple(tuple(_map_expr(y, on_in) for y in x)
+                           if isinstance(x, tuple) else _map_expr(x, on_in)
+                           for x in v)
+                if nv == v:
+                    continue
+            else:
+                continue
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(node, **changes) if changes else node
+    return node
+
+
+def _map_select(sel: Select, on_where, on_other, on_subselect) -> Select:
+    """Map every expression position of ONE Select: `on_where` handles
+    IN-subqueries in WHERE, `on_other` those in select items / GROUP BY /
+    HAVING / ORDER BY, `on_subselect` the nested FROM subquery."""
+    import dataclasses
+    changes = {}
+    if sel.subquery is not None:
+        sub = on_subselect(sel.subquery)
+        if sub is not sel.subquery:
+            changes["subquery"] = sub
+    if sel.where is not None:
+        ne = _map_expr(sel.where, on_where)
+        if ne is not sel.where:
+            changes["where"] = ne
+    if sel.having is not None:
+        ne = _map_expr(sel.having, on_other)
+        if ne is not sel.having:
+            changes["having"] = ne
+    items = tuple(_map_expr(it, on_other) for it in sel.items)
+    if items != sel.items:
+        changes["items"] = items
+    gb = tuple(_map_expr(e, on_other) for e in sel.group_by)
+    if gb != sel.group_by:
+        changes["group_by"] = gb
+    ob = []
+    for o in sel.order_by:
+        ne = _map_expr(o.expr, on_other)
+        ob.append(dataclasses.replace(o, expr=ne) if ne is not o.expr else o)
+    if tuple(ob) != sel.order_by:
+        changes["order_by"] = tuple(ob)
+    return dataclasses.replace(sel, **changes) if changes else sel
+
+
+def _reject_in(node):
+    raise PlannerError(
+        "IN (SELECT ...) is only supported in WHERE — not in select items, "
+        "GROUP BY, HAVING or ORDER BY")
+
+
+def _empty_agg_row(q) -> dict:
+    """Aggregate identities for a zero-row scalar result, matching the
+    engine's empty-bucket defaults (engines.finish_timeseries)."""
+    fields: dict = {}
+    for a in q.aggregations:
+        n = type(a).__name__
+        if "Count" in n or "Sum" in n:
+            fields[a.name] = 0
+        elif "Min" in n:
+            fields[a.name] = float("inf")
+        elif "Max" in n:
+            fields[a.name] = float("-inf")
+        else:
+            fields[a.name] = None
+    for pa in q.post_aggregations:
+        try:
+            fields[pa.name] = pa.compute(fields)
+        except Exception:
+            fields[pa.name] = None
+    return fields
+
+
+def _order_key(v):
+    """Mixed-type sort key for union-level ORDER BY: NULLs first, then
+    numbers, then strings."""
+    if v is None:
+        return (0, 0.0, "")
+    if isinstance(v, bool):
+        return (1, float(v), "")
+    if isinstance(v, (int, float)):
+        return (1, float(v), "")
+    return (2, 0.0, str(v))
+
+
+def _collect_tables(sel: Select, out: set, meta: List[bool]) -> None:
+    """Syntactic datasource collection over FROM, nested FROM subqueries
+    and IN-subqueries in EVERY expression position — the authorization
+    surface must over-collect, never miss a table."""
+    if sel.schema is not None:
+        meta[0] = True
+    elif sel.subquery is None and sel.table:
+        out.add(sel.table)
+
+    def on_in(node):
+        _collect_tables(node.subquery, out, meta)
+        return node
+
+    def recurse(sub):
+        _collect_tables(sub, out, meta)
+        return sub
+
+    _map_select(sel, on_where=on_in, on_other=on_in, on_subselect=recurse)
 
 
 def _strip_explain(sql: str) -> str:

@@ -81,6 +81,10 @@ class InExpr:
     operand: object
     values: Tuple[object, ...]
     negated: bool = False
+    #: `IN (SELECT ...)` semi-join form — the executor materializes the
+    #: inner query's single output column into `values` before planning
+    #: (reference: sql/.../calcite/rel/DruidSemiJoin.java)
+    subquery: Optional["Select"] = None
 
 
 @dataclass(frozen=True)
@@ -144,6 +148,19 @@ class Select:
     explain: bool = False
 
 
+@dataclass(frozen=True)
+class Union:
+    """`SELECT ... UNION ALL SELECT ... [ORDER BY] [LIMIT] [OFFSET]` — arms
+    execute independently and concatenate; ORDER BY/LIMIT bind to the whole
+    union (reference: sql/.../calcite/rel/DruidUnionRel.java). Column names
+    come from the first arm."""
+    arms: Tuple[Select, ...]
+    order_by: Tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+    explain: bool = False
+
+
 class SqlParseError(ValueError):
     pass
 
@@ -168,7 +185,7 @@ _KEYWORDS = {
     "IS", "NULL", "TRUE", "FALSE", "CASE", "WHEN", "THEN", "ELSE", "END",
     "CAST", "EXTRACT", "ASC", "DESC", "FILTER", "TIMESTAMP", "DATE",
     "INTERVAL", "TO", "FOR", "EXPLAIN", "PLAN", "SUBSTRING", "TRIM",
-    "LEADING", "TRAILING", "BOTH",
+    "LEADING", "TRAILING", "BOTH", "UNION", "ALL",
 }
 
 
@@ -261,7 +278,52 @@ class _P:
         raise SqlParseError(f"expected identifier, got {t.text!r}")
 
     # -- entry
-    def select(self, top_level: bool = True) -> Select:
+    def statement(self):
+        """Top-level: a Select or a `UNION ALL` chain (Union)."""
+        first = self.select(top_level=False)
+        if not self.accept_kw("UNION"):
+            if self.peek().kind != "eof":
+                raise SqlParseError(
+                    f"unexpected trailing {self.peek().text!r}")
+            return first
+        if first.order_by or first.limit is not None or first.offset:
+            raise SqlParseError(
+                "ORDER BY/LIMIT/OFFSET before UNION ALL bind to the whole "
+                "union — move them after the last arm")
+        self.expect_kw("ALL")
+        arms = [first, self.select(top_level=False, allow_order=False)]
+        while self.accept_kw("UNION"):
+            self.expect_kw("ALL")
+            arms.append(self.select(top_level=False, allow_order=False))
+        order_by, limit, offset = self._order_limit_offset()
+        if self.peek().kind != "eof":
+            raise SqlParseError(f"unexpected trailing {self.peek().text!r}")
+        return Union(tuple(arms), tuple(order_by), limit, offset,
+                     first.explain)
+
+    def _order_limit_offset(self):
+        order_by: List[OrderItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self.order_item())
+            while self.accept_op(","):
+                order_by.append(self.order_item())
+        limit = None
+        if self.accept_kw("LIMIT"):
+            t = self.next()
+            if t.kind != "num":
+                raise SqlParseError(f"LIMIT expects a number, got {t.text!r}")
+            limit = int(t.text)
+        offset = 0
+        if self.accept_kw("OFFSET"):
+            t = self.next()
+            if t.kind != "num":
+                raise SqlParseError(f"OFFSET expects a number, got {t.text!r}")
+            offset = int(t.text)
+        return order_by, limit, offset
+
+    def select(self, top_level: bool = True,
+               allow_order: bool = True) -> Select:
         explain = False
         if self.accept_kw("EXPLAIN"):
             self.expect_kw("PLAN")
@@ -299,18 +361,10 @@ class _P:
             while self.accept_op(","):
                 group_by.append(self.expr())
         having = self.expr() if self.accept_kw("HAVING") else None
-        order_by: List[OrderItem] = []
-        if self.accept_kw("ORDER"):
-            self.expect_kw("BY")
-            order_by.append(self.order_item())
-            while self.accept_op(","):
-                order_by.append(self.order_item())
-        limit = None
-        if self.accept_kw("LIMIT"):
-            limit = int(self.next().text)
-        offset = 0
-        if self.accept_kw("OFFSET"):
-            offset = int(self.next().text)
+        if allow_order:
+            order_by, limit, offset = self._order_limit_offset()
+        else:
+            order_by, limit, offset = [], None, 0
         if top_level and self.peek().kind != "eof":
             raise SqlParseError(f"unexpected trailing {self.peek().text!r}")
         return Select(tuple(items), table, schema, subquery, where,
@@ -374,6 +428,10 @@ class _P:
         neg = bool(self.accept_kw("NOT"))
         if self.accept_kw("IN"):
             self.expect_op("(")
+            if self.peek().kind == "kw" and self.peek().text == "SELECT":
+                sub = self.select(top_level=False)
+                self.expect_op(")")
+                return InExpr(left, (), neg, sub)
             vals = [self.expr()]
             while self.accept_op(","):
                 vals.append(self.expr())
@@ -559,5 +617,6 @@ class _P:
         return Fn(name, args, distinct, flt, extra)
 
 
-def parse_sql(sql: str, parameters: Sequence[object] = ()) -> Select:
-    return _P(_tokenize(sql), parameters).select()
+def parse_sql(sql: str, parameters: Sequence[object] = ()):
+    """Parse one statement → Select, or Union for `UNION ALL` chains."""
+    return _P(_tokenize(sql), parameters).statement()

@@ -346,6 +346,9 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         flt = F.SelectorFilter(e.operand.name, None)
         return F.NotFilter(flt) if e.negated else flt
     if isinstance(e, P.InExpr):
+        if e.subquery is not None:
+            raise PlannerError(
+                "IN (SELECT ...) must be materialized by the SQL executor")
         if isinstance(e.operand, P.Col):
             vals = tuple(_lit_str(v) for v in e.values)
             flt = F.InFilter(e.operand.name, vals)
@@ -1000,10 +1003,14 @@ def _plan_grouped(sel: P.Select, table: str, schema: SqlSchema,
         # rows in the executor — timeseries results are per-bucket
         sort_exec = [(o.dimension, o.direction == "descending")
                      for o in order_cols if o.dimension != "__timestamp"]
+        # scalar aggregates (granularity 'all') must emit their one row even
+        # when nothing matches — SELECT COUNT(*) WHERE <false> is 0, not
+        # empty; time-floored buckets skip empties like the reference's
+        # Calcite-planned timeseries
         q = TimeseriesQuery.of(
             table, intervals, builder.aggs, granularity=granularity,
             filter=flt, post_aggregations=tuple(builder.postaggs),
-            descending=descending, skip_empty_buckets=True,
+            descending=descending, skip_empty_buckets=(granularity != "all"),
             virtual_columns=vcols)
         return PlannedQuery(q, outputs,
                             sort_in_executor=sort_exec,

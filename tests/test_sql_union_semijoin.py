@@ -1,0 +1,229 @@
+"""SQL UNION ALL chains and IN (SELECT ...) semi-joins.
+
+Reference: sql/src/main/java/org/apache/druid/sql/calcite/rel/
+DruidUnionRel.java (arms execute independently, results concatenate) and
+DruidSemiJoin.java (inner query materialized into a filter on the outer,
+capped by PlannerConfig.maxSemiJoinRowsInMemory).
+"""
+import pytest
+
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.sql import PlannerError, SqlExecutor
+from tests.conftest import rows_as_frame
+
+
+@pytest.fixture(scope="module")
+def sql(segments):
+    return SqlExecutor(QueryExecutor(segments))
+
+
+@pytest.fixture(scope="module")
+def frames(segments):
+    return [rows_as_frame(s) for s in segments]
+
+
+# ---------------------------------------------------------------------------
+# UNION ALL
+# ---------------------------------------------------------------------------
+
+def test_union_all_concatenates(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT dimA, COUNT(*) n FROM test GROUP BY dimA "
+        "UNION ALL "
+        "SELECT dimB, COUNT(*) n FROM test GROUP BY dimB")
+    n_a = len({v for f in frames for v in f["dimA"]})
+    n_b = len({v for f in frames for v in f["dimB"]})
+    assert cols == ["dimA", "n"]          # names come from the first arm
+    assert len(rows) == n_a + n_b
+    total = sum(len(f["dimA"]) for f in frames)
+    assert sum(r[1] for r in rows) == 2 * total
+
+
+def test_union_order_and_limit_bind_to_whole_union(sql):
+    cols, rows = sql.execute(
+        "SELECT dimA v, SUM(metLong) s FROM test GROUP BY dimA "
+        "UNION ALL "
+        "SELECT dimB v, SUM(metLong) s FROM test GROUP BY dimB "
+        "ORDER BY s DESC LIMIT 5")
+    assert len(rows) == 5
+    assert [r[1] for r in rows] == sorted((r[1] for r in rows), reverse=True)
+
+
+def test_union_order_by_ordinal_offset(sql):
+    cols, all_rows = sql.execute(
+        "SELECT dimA FROM test GROUP BY dimA "
+        "UNION ALL SELECT dimB FROM test GROUP BY dimB ORDER BY 1")
+    cols, page = sql.execute(
+        "SELECT dimA FROM test GROUP BY dimA "
+        "UNION ALL SELECT dimB FROM test GROUP BY dimB "
+        "ORDER BY 1 LIMIT 3 OFFSET 2")
+    assert page == all_rows[2:5]
+
+
+def test_union_three_arms_scalar(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM test UNION ALL "
+        "SELECT COUNT(*) FROM test UNION ALL SELECT COUNT(*) FROM test")
+    total = sum(len(f["dimA"]) for f in frames)
+    assert [r[0] for r in rows] == [total, total, total]
+
+
+def test_union_arity_mismatch_rejected(sql):
+    with pytest.raises(PlannerError, match="same number of columns"):
+        sql.execute("SELECT dimA, COUNT(*) FROM test GROUP BY dimA "
+                    "UNION ALL SELECT dimB FROM test GROUP BY dimB")
+
+
+def test_union_arm_order_by_rejected(sql):
+    from druid_tpu.sql.parser import SqlParseError
+    with pytest.raises(SqlParseError, match="UNION"):
+        sql.execute("SELECT dimA FROM test GROUP BY dimA ORDER BY dimA "
+                    "UNION ALL SELECT dimB FROM test GROUP BY dimB")
+
+
+def test_union_explain_lists_arms(sql):
+    plan = sql.explain("SELECT COUNT(*) FROM test "
+                       "UNION ALL SELECT COUNT(*) FROM test")
+    assert plan["queryType"] == "unionAll"
+    assert len(plan["arms"]) == 2
+    assert all(a["queryType"] == "timeseries" for a in plan["arms"])
+
+
+# ---------------------------------------------------------------------------
+# IN (SELECT ...) semi-joins
+# ---------------------------------------------------------------------------
+
+def top_dims(frames, dim, metric, k):
+    sums = {}
+    for f in frames:
+        for d, v in zip(f[dim], f[metric]):
+            sums[d] = sums.get(d, 0) + int(v)
+    return [d for d, _ in
+            sorted(sums.items(), key=lambda kv: -kv[1])[:k]]
+
+
+def test_in_subquery_filters_outer(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT dimA, COUNT(*) n FROM test WHERE dimA IN "
+        "(SELECT dimA FROM test GROUP BY dimA ORDER BY SUM(metLong) DESC "
+        "LIMIT 2) GROUP BY dimA ORDER BY dimA")
+    want = sorted(top_dims(frames, "dimA", "metLong", 2))
+    assert [r[0] for r in rows] == want
+
+
+def test_not_in_subquery(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT COUNT(DISTINCT dimA) FROM test WHERE dimA NOT IN "
+        "(SELECT dimA FROM test GROUP BY dimA ORDER BY SUM(metLong) DESC "
+        "LIMIT 2)")
+    n_a = len({v for f in frames for v in f["dimA"]})
+    assert rows[0][0] == n_a - 2
+
+
+def test_in_subquery_composes_with_other_predicates(sql, frames):
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM test WHERE metLong > 3 AND dimA IN "
+        "(SELECT dimA FROM test GROUP BY dimA ORDER BY SUM(metLong) DESC "
+        "LIMIT 2)")
+    top = set(top_dims(frames, "dimA", "metLong", 2))
+    want = sum(1 for f in frames
+               for a, v in zip(f["dimA"], f["metLong"])
+               if a in top and int(v) > 3)
+    assert rows[0][0] == want
+
+
+def test_in_subquery_must_be_single_column(sql):
+    with pytest.raises(PlannerError, match="exactly one column"):
+        sql.execute("SELECT COUNT(*) FROM test WHERE dimA IN "
+                    "(SELECT dimA, dimB FROM test GROUP BY dimA, dimB)")
+
+
+def test_empty_in_subquery_matches_nothing(sql):
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM test WHERE dimA IN "
+        "(SELECT dimA FROM test WHERE dimA = 'no_such_value' "
+        "GROUP BY dimA)")
+    assert rows[0][0] == 0
+
+
+def test_not_in_subquery_with_null_matches_nothing(sql, monkeypatch):
+    """Three-valued logic: `x NOT IN (..., NULL)` is never true, so a NULL
+    in the materialized inner result must empty the outer result."""
+    real = SqlExecutor._execute_select
+
+    def fake(self, sel, depth):
+        names, rows = real(self, sel, depth)
+        if depth > 0:
+            rows = rows + [[None]]
+        return names, rows
+
+    monkeypatch.setattr(SqlExecutor, "_execute_select", fake)
+    cols, rows = sql.execute(
+        "SELECT COUNT(*) FROM test WHERE dimA NOT IN "
+        "(SELECT dimA FROM test GROUP BY dimA ORDER BY SUM(metLong) DESC "
+        "LIMIT 2)")
+    assert rows[0][0] == 0
+
+
+def test_explain_does_not_execute_semijoin(sql, monkeypatch):
+    """EXPLAIN is plan-only: inner SELECTs are planned, never run."""
+    def boom(self, sub, depth):
+        raise AssertionError("explain executed a subquery")
+
+    monkeypatch.setattr(SqlExecutor, "_materialize_semijoin", boom)
+    plan = sql.explain(
+        "SELECT COUNT(*) FROM test WHERE dimA IN "
+        "(SELECT dimA FROM test GROUP BY dimA)")
+    assert plan["queryType"] == "timeseries"
+    assert len(plan["semiJoinSubPlans"]) == 1
+    assert plan["semiJoinSubPlans"][0]["queryType"] == "groupBy"
+
+
+def test_mixed_meta_statement_still_authorizes_real_tables(segments):
+    """A statement mixing INFORMATION_SCHEMA with a real table must not
+    bypass the real table's READ check (is_meta alone is not a grant)."""
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.security import (AuthChain, Permission, READ,
+                                           AuthenticationResult,
+                                           RoleBasedAuthorizer)
+    qe = QueryExecutor(segments)
+    server = QueryHttpServer.__new__(QueryHttpServer)
+    server.sql_executor = SqlExecutor(qe)
+    server.auth_chain = AuthChain(authorizers={"rbac": RoleBasedAuthorizer(
+        {"meta_only": [Permission("INFORMATION_SCHEMA", actions=(READ,))]},
+        {"bob": ["meta_only"]})})
+    bob = AuthenticationResult("bob", "rbac")
+    assert server._authorize_sql(
+        bob, "SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
+    assert not server._authorize_sql(
+        bob, "SELECT dimA FROM test UNION ALL "
+             "SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
+    assert not server._authorize_sql(
+        bob, "SELECT COUNT(*) FROM test WHERE dimA IN "
+             "(SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES)")
+
+
+def test_in_subquery_outside_where_rejected_before_execution(sql,
+                                                             monkeypatch):
+    """IN (SELECT ...) outside WHERE raises cleanly WITHOUT running the
+    inner query."""
+    def boom(self, sub, depth):
+        raise AssertionError("rejected position executed its subquery")
+
+    monkeypatch.setattr(SqlExecutor, "_materialize_semijoin", boom)
+    with pytest.raises(PlannerError, match="only supported in WHERE"):
+        sql.execute("SELECT dimA, COUNT(*) FROM test GROUP BY dimA "
+                    "HAVING COUNT(*) IN (SELECT metLong FROM test LIMIT 1)")
+
+
+def test_tables_of_sees_subquery_and_union_tables(sql):
+    tables, is_meta = sql.tables_of(
+        "SELECT COUNT(*) FROM test WHERE dimA IN "
+        "(SELECT dimA FROM test GROUP BY dimA)")
+    assert tables == ["test"]
+    assert not is_meta
+    tables, is_meta = sql.tables_of(
+        "SELECT dimA FROM test UNION ALL "
+        "SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
+    assert tables == ["test"]
+    assert is_meta
