@@ -1,5 +1,7 @@
 from druid_tpu.cluster.broker import Broker, MissingSegmentsError
-from druid_tpu.cluster.cache import CacheConfig, LruCache
+from druid_tpu.cluster.cache import (Cache, CacheConfig, HybridCache,
+                                     LruCache, RemoteCacheClient,
+                                     RemoteCacheServer)
 from druid_tpu.cluster.coordinator import (Coordinator, DynamicConfig,
                                            ForeverDropRule, ForeverLoadRule,
                                            IntervalDropRule, IntervalLoadRule,
@@ -27,6 +29,7 @@ __all__ = [
     "TimelineObjectHolder", "VersionedIntervalTimeline",
     "MetadataStore", "SegmentDescriptor", "DataNode", "InventoryView",
     "descriptor_for", "Broker", "MissingSegmentsError", "LruCache",
+    "Cache", "HybridCache", "RemoteCacheClient", "RemoteCacheServer",
     "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",
     "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
     "IntervalDropRule", "rule_from_json", "DataNodeServer",

@@ -29,8 +29,25 @@ class CacheStats:
         self.puts = 0
 
 
-class LruCache:
-    """Thread-safe LRU with entry-count bound (Cache SPI analog)."""
+class Cache:
+    """Pluggable cache SPI (reference: client/cache/Cache.java — local
+    Caffeine, memcached, hybrid impls chosen by config)."""
+
+    def get(self, namespace: str, key: str):
+        raise NotImplementedError
+
+    def put(self, namespace: str, key: str, value) -> None:
+        raise NotImplementedError
+
+    def invalidate_namespace(self, namespace: str) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LruCache(Cache):
+    """Thread-safe LRU with entry-count bound (the CaffeineCache role)."""
 
     def __init__(self, max_entries: int = 10_000):
         self.max_entries = max_entries
@@ -68,6 +85,179 @@ class LruCache:
     def __len__(self):
         with self._lock:
             return len(self._data)
+
+
+class HybridCache(Cache):
+    """L1 local + L2 remote with L1 population on L2 hits (reference:
+    client/cache/HybridCache.java — Caffeine in front of memcached)."""
+
+    def __init__(self, l1: Cache, l2: Cache, populate_l1: bool = True):
+        self.l1 = l1
+        self.l2 = l2
+        self.populate_l1 = populate_l1
+        self.stats = CacheStats()
+
+    def get(self, namespace, key):
+        v = self.l1.get(namespace, key)
+        if v is None:
+            v = self.l2.get(namespace, key)
+            if v is not None and self.populate_l1:
+                self.l1.put(namespace, key, v)
+        if v is None:
+            self.stats.misses += 1
+        else:
+            self.stats.hits += 1
+        return v
+
+    def put(self, namespace, key, value):
+        self.l1.put(namespace, key, value)
+        self.l2.put(namespace, key, value)
+        self.stats.puts += 1
+
+    def invalidate_namespace(self, namespace):
+        n = self.l1.invalidate_namespace(namespace)
+        return max(n, self.l2.invalidate_namespace(namespace))
+
+    def close(self):
+        self.l1.close()
+        self.l2.close()
+
+
+class RemoteCacheServer:
+    """Shared cache node: the memcached role. Length-prefixed pickle frames
+    over TCP — acceptable only on a trusted intra-cluster link, exactly
+    like memcached's own transcoded object protocol."""
+
+    def __init__(self, max_entries: int = 100_000, port: int = 0,
+                 host: str = "127.0.0.1"):
+        import socketserver
+
+        store = LruCache(max_entries)
+        self.store = store
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_frame(self.request)
+                        if req is None:
+                            return
+                        op = req.get("op")
+                        if op == "get":
+                            out = {"value": store.get(req["ns"], req["key"])}
+                        elif op == "put":
+                            store.put(req["ns"], req["key"], req["value"])
+                            out = {"ok": True}
+                        elif op == "invalidate":
+                            out = {"n": store.invalidate_namespace(req["ns"])}
+                        else:
+                            out = {"error": f"bad op {op!r}"}
+                        _send_frame(self.request, out)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteCacheClient(Cache):
+    """Cache over a RemoteCacheServer. Degrades like memcached: any
+    connection failure is a miss / dropped put, never a query failure."""
+
+    def __init__(self, host: str, port: int, timeout: float = 2.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.stats = CacheStats()
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict):
+        import socket
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        (self.host, self.port), timeout=self.timeout)
+                _send_frame(self._sock, req)
+                return _recv_frame(self._sock)
+            except (ConnectionError, OSError):
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                return None
+
+    def get(self, namespace, key):
+        out = self._call({"op": "get", "ns": namespace, "key": key})
+        v = out.get("value") if out else None
+        if v is None:
+            self.stats.misses += 1
+        else:
+            self.stats.hits += 1
+        return v
+
+    def put(self, namespace, key, value):
+        self._call({"op": "put", "ns": namespace, "key": key,
+                    "value": value})
+        self.stats.puts += 1
+
+    def invalidate_namespace(self, namespace):
+        out = self._call({"op": "invalidate", "ns": namespace})
+        return out.get("n", 0) if out else 0
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+
+def _send_frame(sock, obj) -> None:
+    import pickle
+    import struct
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_frame(sock):
+    import pickle
+    import struct
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack(">I", hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
 
 
 class CacheConfig:

@@ -188,6 +188,16 @@ class MetadataStore:
                                (sid,)).rowcount
             return n
 
+    def update_segment_payload(self, descriptor: SegmentDescriptor) -> bool:
+        """Rewrite a segment's stored payload in place — the metadata step
+        of archive/move/restore, which changes only the loadSpec."""
+        with self._lock, self._conn as c:
+            n = c.execute(
+                "UPDATE segments SET payload = ? WHERE id = ?",
+                (json.dumps(descriptor.to_json(), sort_keys=True),
+                 descriptor.id)).rowcount
+            return n > 0
+
     def delete_segments(self, segment_ids: Sequence[str]) -> int:
         """Permanent removal (the kill-task step after mark_unused)."""
         with self._lock, self._conn as c:

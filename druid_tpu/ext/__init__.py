@@ -19,6 +19,7 @@ from druid_tpu.ext.bloom import (BloomFilterAggregator, BloomFilterValue,
 from druid_tpu.ext.hllsketch import (HLLSketchBuildAggregator,
                                      HLLSketchMergeAggregator,
                                      HLLSketchToEstimatePostAgg)
+from druid_tpu.ext.protobuf_parser import ProtobufInputRowParser
 
 __all__ = [
     "HLLSketchBuildAggregator", "HLLSketchMergeAggregator",
@@ -28,5 +29,6 @@ __all__ = [
     "ThetaSketchSetOpPostAgg", "QuantilesSketchAggregator", "QuantilePostAgg",
     "QuantilesPostAgg", "ApproximateHistogramAggregator", "HistogramValue",
     "HistogramQuantilePostAgg", "BloomFilterAggregator", "BloomFilterValue",
+    "ProtobufInputRowParser",
     "BloomDimFilter",
 ]

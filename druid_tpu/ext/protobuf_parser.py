@@ -1,0 +1,99 @@
+"""Protobuf input rows: decode serialized messages via a compiled
+FileDescriptorSet.
+
+Reference analog: extensions-core/protobuf-extensions
+(ProtobufInputRowParser.java — loads a `.desc` descriptor file produced by
+`protoc --descriptor_set_out`, resolves the message type, and converts each
+binary record to a flat row through the proto3 JSON mapping).
+
+Registers parser type "protobuf" with the core InputRowParser registry, so
+task specs may say `"parser": {"type": "protobuf", "descriptor": ...,
+"protoMessageType": ..., "parseSpec": {...}}` exactly like the reference.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from druid_tpu.ingest.input import (DimensionsSpec, InputRowParser,
+                                    TimestampSpec)
+
+
+class ProtobufInputRowParser(InputRowParser):
+    """Binary protobuf records → dict rows (proto3 JSON field mapping,
+    original field names). Nested messages flatten into dotted keys so
+    `a.b` addresses them as dimension/metric columns."""
+
+    def __init__(self, descriptor_bytes: bytes, message_type: str,
+                 timestamp_spec: TimestampSpec,
+                 dimensions_spec: Optional[DimensionsSpec] = None,
+                 flatten_delimiter: str = "."):
+        super().__init__(timestamp_spec,
+                         dimensions_spec or DimensionsSpec())
+        from google.protobuf import descriptor_pb2, descriptor_pool
+        from google.protobuf import message_factory
+        self.descriptor_bytes = descriptor_bytes
+        self.message_type = message_type
+        self.flatten_delimiter = flatten_delimiter
+        fds = descriptor_pb2.FileDescriptorSet.FromString(descriptor_bytes)
+        pool = descriptor_pool.DescriptorPool()
+        for f in fds.file:
+            pool.Add(f)
+        desc = pool.FindMessageTypeByName(message_type)
+        self._msg_cls = message_factory.GetMessageClass(desc)
+
+    def _decode(self, record) -> Optional[dict]:
+        from google.protobuf import json_format
+        if isinstance(record, dict):
+            return record        # already decoded (e.g. replayed rows)
+        msg = self._msg_cls()
+        msg.ParseFromString(record)
+        # default-valued proto3 fields must still become row values (a
+        # clicks=0 metric is data, not absence) — kwarg renamed in
+        # protobuf 5
+        try:
+            d = json_format.MessageToDict(
+                msg, preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True)
+        except TypeError:
+            d = json_format.MessageToDict(
+                msg, preserving_proto_field_name=True,
+                including_default_value_fields=True)
+        return self._flatten(d)
+
+    def _flatten(self, d: dict, prefix: str = "") -> dict:
+        out = {}
+        for k, v in d.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(self._flatten(
+                    v, prefix=f"{key}{self.flatten_delimiter}"))
+            else:
+                out[key] = v
+        return out
+
+    @staticmethod
+    def from_json_spec(j: dict) -> "ProtobufInputRowParser":
+        ps = j.get("parseSpec", {})
+        desc = j.get("descriptor", "")
+        if isinstance(desc, str):
+            desc_bytes = base64.b64decode(desc)
+        else:
+            desc_bytes = bytes(desc)
+        return ProtobufInputRowParser(
+            desc_bytes, j["protoMessageType"],
+            TimestampSpec.from_json(ps.get("timestampSpec")),
+            DimensionsSpec.from_json(ps.get("dimensionsSpec")))
+
+    def to_json(self) -> dict:
+        return {"type": "protobuf",
+                "descriptor":
+                    base64.b64encode(self.descriptor_bytes).decode("ascii"),
+                "protoMessageType": self.message_type,
+                "parseSpec": {
+                    "timestampSpec": self.timestamp_spec.to_json(),
+                    "dimensionsSpec": self.dimensions_spec.to_json()}}
+
+
+InputRowParser.register_type("protobuf",
+                             ProtobufInputRowParser.from_json_spec)

@@ -1,6 +1,7 @@
 from druid_tpu.indexing.locks import LockType, TaskLock, TaskLockbox
-from druid_tpu.indexing.task import (CompactionTask, IndexTask, KillTask,
-                                     ParallelIndexTask, Task, TaskStatus,
+from druid_tpu.indexing.task import (ArchiveTask, CompactionTask, IndexTask,
+                                     KillTask, MoveTask, ParallelIndexTask,
+                                     RestoreTask, Task, TaskStatus,
                                      task_from_json)
 from druid_tpu.indexing.overlord import Overlord, TaskToolbox
 from druid_tpu.indexing.forking import ForkingTaskRunner, TaskActionServer
@@ -10,7 +11,8 @@ from druid_tpu.indexing.autoscaling import (PendingTaskProvisioningStrategy,
 
 __all__ = [
     "TaskLockbox", "TaskLock", "LockType", "Task", "TaskStatus", "IndexTask",
-    "CompactionTask", "KillTask", "task_from_json", "Overlord", "TaskToolbox",
+    "CompactionTask", "KillTask", "MoveTask", "ArchiveTask", "RestoreTask",
+    "task_from_json", "Overlord", "TaskToolbox",
     "ForkingTaskRunner", "TaskActionServer", "ParallelIndexTask",
     "PendingTaskProvisioningStrategy", "ProvisioningConfig",
     "ScalingMonitor", "WorkerInfo",

@@ -404,6 +404,97 @@ class KillTask(Task):
         return TaskStatus.success(self.id)
 
 
+class MoveTask(Task):
+    """Relocate UNUSED segments' deep-storage files to a target location
+    and rewrite their loadSpecs (reference MoveTask: unused data migrates
+    to cheaper storage without leaving the metadata catalog)."""
+    task_type = "move"
+    priority = 0
+
+    def __init__(self, datasource: str, interval: Interval, target: str,
+                 task_id: Optional[str] = None):
+        super().__init__(task_id, datasource)
+        self.interval = interval
+        self.target = target
+
+    def to_json(self) -> dict:
+        return {"type": self.task_type, "id": self.id,
+                "dataSource": self.datasource,
+                "interval": str(self.interval), "target": self.target}
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        # exclusive lock: a concurrent kill/restore over the same interval
+        # must not interleave with the file moves
+        lock = toolbox.lock(self, [self.interval])
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
+        missing = []
+        for d in toolbox.metadata.unused_segments(self.datasource,
+                                                  self.interval):
+            nd = toolbox.deep_storage.move(d, self.target)
+            if nd is None:
+                missing.append(d.id)
+            else:
+                toolbox.metadata.update_segment_payload(nd)
+        if missing:
+            # a green move over unpullable segments would hide data loss
+            return TaskStatus.failure(
+                self.id, f"segments missing from deep storage: {missing}")
+        return TaskStatus.success(self.id)
+
+
+class ArchiveTask(MoveTask):
+    """MoveTask specialization targeting the configured archive location
+    (reference ArchiveTask / DataSegmentArchiver)."""
+    task_type = "archive"
+    ARCHIVE_LOCATION = "archive"
+
+    def __init__(self, datasource: str, interval: Interval,
+                 task_id: Optional[str] = None):
+        super().__init__(datasource, interval, self.ARCHIVE_LOCATION,
+                         task_id)
+
+    def to_json(self) -> dict:
+        return {"type": "archive", "id": self.id,
+                "dataSource": self.datasource,
+                "interval": str(self.interval)}
+
+
+class RestoreTask(Task):
+    """Bring archived (unused) segments back: move files to the base
+    location and mark the segments used so load rules serve them again
+    (reference RestoreTask)."""
+    task_type = "restore"
+    priority = 0
+
+    def __init__(self, datasource: str, interval: Interval,
+                 task_id: Optional[str] = None):
+        super().__init__(task_id, datasource)
+        self.interval = interval
+
+    def to_json(self) -> dict:
+        return {"type": "restore", "id": self.id,
+                "dataSource": self.datasource,
+                "interval": str(self.interval)}
+
+    def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        from druid_tpu.storage.deep import DeepStorage
+        lock = toolbox.lock(self, [self.interval])
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
+        restored = []
+        for d in toolbox.metadata.unused_segments(self.datasource,
+                                                  self.interval):
+            nd = toolbox.deep_storage.move(d, DeepStorage.BASE_LOCATION)
+            if nd is None:
+                return TaskStatus.failure(
+                    self.id, f"segment {d.id} missing from deep storage")
+            toolbox.metadata.update_segment_payload(nd)
+            restored.append(nd.id)
+        toolbox.metadata.mark_used(restored)
+        return TaskStatus.success(self.id)
+
+
 def task_from_json(j: dict) -> Task:
     t = j["type"]
     if t == "index_parallel":
@@ -454,4 +545,13 @@ def task_from_json(j: dict) -> Task:
     if t == "kill":
         return KillTask(j["dataSource"], Interval.parse(j["interval"]),
                         task_id=j.get("id"))
+    if t == "move":
+        return MoveTask(j["dataSource"], Interval.parse(j["interval"]),
+                        j["target"], task_id=j.get("id"))
+    if t == "archive":
+        return ArchiveTask(j["dataSource"], Interval.parse(j["interval"]),
+                           task_id=j.get("id"))
+    if t == "restore":
+        return RestoreTask(j["dataSource"], Interval.parse(j["interval"]),
+                           task_id=j.get("id"))
     raise ValueError(f"unknown task type {t!r}")

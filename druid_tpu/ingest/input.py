@@ -16,7 +16,8 @@ import json
 import re
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence)
 
 import numpy as np
 
@@ -137,8 +138,28 @@ class InputRowParser:
         self.list_delimiter = list_delimiter
         self.pattern = re.compile(pattern) if pattern else None
 
+    #: extension parser types: "type" → constructor(json) (the reference's
+    #: InputRowParser @JsonSubTypes registry, extended by DruidModules)
+    _PARSER_TYPES: Dict[str, "Callable[[dict], InputRowParser]"] = {}
+
+    @classmethod
+    def register_type(cls, name: str, ctor) -> None:
+        cls._PARSER_TYPES[name] = ctor
+
     @staticmethod
     def from_json(j: dict) -> "InputRowParser":
+        t = j.get("type")
+        if t and t not in ("string", "map", "hadoopyString"):
+            ctor = InputRowParser._PARSER_TYPES.get(t)
+            if ctor is None:
+                # a forked peon deserializing a task spec may not have
+                # imported the extension modules yet — registering them
+                # here beats silently JSON-parsing binary records
+                import druid_tpu.ext  # noqa: F401
+                ctor = InputRowParser._PARSER_TYPES.get(t)
+            if ctor is None:
+                raise ValueError(f"unknown parser type {t!r}")
+            return ctor(j)
         ps = j.get("parseSpec", j)
         fmt = ps.get("format", "json")
         return InputRowParser(

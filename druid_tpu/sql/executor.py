@@ -400,19 +400,16 @@ def _reject_in(node):
 
 
 def _empty_agg_row(q) -> dict:
-    """Aggregate identities for a zero-row scalar result, matching the
-    engine's empty-bucket defaults (engines.finish_timeseries)."""
-    fields: dict = {}
-    for a in q.aggregations:
-        n = type(a).__name__
-        if "Count" in n or "Sum" in n:
-            fields[a.name] = 0
-        elif "Min" in n:
-            fields[a.name] = float("inf")
-        elif "Max" in n:
-            fields[a.name] = float("-inf")
-        else:
-            fields[a.name] = None
+    """Aggregate identities for a zero-row scalar result — the SAME
+    kernel empty states the engine emits for a covered-but-empty bucket
+    (engines.finish_timeseries empty_defaults), so both zero-row paths
+    agree for every aggregator type."""
+    from druid_tpu.cluster.wire import rebuild_kernels
+    kernels = rebuild_kernels([a.to_json() for a in q.aggregations])
+    fields = {}
+    for k in kernels:
+        v = k.finalize_array(k.empty_state(1))[0]
+        fields[k.spec.name] = v.item() if hasattr(v, "item") else v
     for pa in q.post_aggregations:
         try:
             fields[pa.name] = pa.compute(fields)

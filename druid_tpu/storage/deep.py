@@ -29,6 +29,17 @@ class DeepStorage:
         """Delete the stored segment file (KillTask's storage step)."""
         raise NotImplementedError
 
+    #: the live storage location segments restore back into
+    BASE_LOCATION = "base"
+
+    def move(self, descriptor: SegmentDescriptor,
+             location: str) -> Optional[SegmentDescriptor]:
+        """Relocate the stored files to a named location ("archive", a
+        custom target, or BASE_LOCATION to restore) and return the
+        descriptor with its loadSpec updated, or None if the segment is
+        absent (reference: DataSegmentArchiver / MoveTask's storage step)."""
+        raise NotImplementedError
+
 
 class InMemoryDeepStorage(DeepStorage):
     """Test/local double — the role S3 plays in production."""
@@ -53,6 +64,19 @@ class InMemoryDeepStorage(DeepStorage):
     def kill(self, descriptor):
         with self._lock:
             return self._store.pop(descriptor.id, None) is not None
+
+    def move(self, descriptor, location):
+        # one shared dict: a move only re-tags the loadSpec location
+        with self._lock:
+            if descriptor.id not in self._store:
+                return None
+        spec = {"type": "memory", "key": descriptor.id}
+        if location != self.BASE_LOCATION:
+            spec["location"] = location
+        return SegmentDescriptor(
+            descriptor.datasource, descriptor.interval, descriptor.version,
+            descriptor.partition, descriptor.shard_spec,
+            descriptor.size_bytes, descriptor.num_rows, spec)
 
 
 class LocalDeepStorage(DeepStorage):
@@ -91,3 +115,30 @@ class LocalDeepStorage(DeepStorage):
             shutil.rmtree(d)
             return True
         return False
+
+    def move(self, descriptor, location):
+        src = (descriptor.load_spec or {}).get("path") or \
+            self._dir(descriptor)
+        if location == self.BASE_LOCATION:
+            dst = self._dir(descriptor)
+        else:
+            root = location if os.path.isabs(location) \
+                else f"{self.base_dir.rstrip(os.sep)}_{location}"
+            dst = os.path.join(root, descriptor.datasource,
+                               os.path.basename(src.rstrip(os.sep)))
+        if not os.path.isdir(src):
+            # crash-idempotency: a prior run may have moved the files and
+            # died before the metadata update — finding them already at
+            # the destination completes that move instead of stranding it
+            if not os.path.isdir(dst):
+                return None
+        elif os.path.abspath(src) != os.path.abspath(dst):
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            if os.path.isdir(dst):
+                shutil.rmtree(dst)   # re-run of a partially-copied move
+            shutil.move(src, dst)
+        return SegmentDescriptor(
+            descriptor.datasource, descriptor.interval, descriptor.version,
+            descriptor.partition, descriptor.shard_spec,
+            descriptor.size_bytes, descriptor.num_rows,
+            {"type": "local", "path": dst})

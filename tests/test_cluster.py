@@ -126,6 +126,45 @@ def test_result_level_cache(cluster, segments):
     assert broker.cache.stats.hits == hits_before + 1
 
 
+def test_hybrid_remote_cache_through_broker(segments):
+    """A broker on a hybrid cache (local L1 + remote memcached-analog L2)
+    serves repeat queries from cache; a second broker sharing only the
+    remote tier hits it too; a dead remote degrades to misses, never
+    errors (reference: HybridCache + MemcachedCache)."""
+    from druid_tpu.cluster import (HybridCache, RemoteCacheClient,
+                                   RemoteCacheServer)
+    server = RemoteCacheServer().start()
+    try:
+        view = InventoryView()
+        node = DataNode("n0")
+        view.register(node)
+        for s in segments:
+            node.load_segment(s)
+            view.announce(node.name, descriptor_for(s))
+        mk = lambda: HybridCache(
+            LruCache(), RemoteCacheClient("127.0.0.1", server.port))
+        b1 = Broker(view, cache=mk())
+        b2 = Broker(view, cache=mk())
+        q = TopNQuery.of("test", [WEEK], "dimA", "ls", 5, AGGS)
+        first = b1.run(q)
+        assert b1.cache.stats.misses >= 1
+        assert b1.run(q) == first
+        assert b1.cache.stats.hits >= 1
+        # b2 shares only the remote tier → its first run is an L2 hit
+        assert b2.run(q) == first
+        assert b2.cache.l2.stats.hits >= 1
+        # and the L2 hit populated b2's L1
+        assert b2.cache.l1.stats.puts >= 1
+    finally:
+        server.stop()
+    # dead remote: misses, not errors
+    dead = HybridCache(LruCache(),
+                       RemoteCacheClient("127.0.0.1", server.port))
+    b3 = Broker(view, cache=dead)
+    assert b3.run(q) == first
+    assert b3.run(q) == first    # L1 still works
+
+
 def test_segment_level_cache(cluster, segments):
     view, nodes, broker = cluster
     broker.cache_config = CacheConfig(use_result_cache=False,

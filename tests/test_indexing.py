@@ -1,5 +1,7 @@
 """Indexing service tests: batch index, compaction, kill, locks
 (reference: IndexTaskTest, CompactionTaskTest, TaskLockbox tests)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -148,6 +150,87 @@ def test_kill_task():
     assert ov.run_task(KillTask("k_ds", WEEK)).state == "SUCCESS"
     assert md.used_segments("k_ds") == []
     assert ov.deep_storage.pull(desc) is None
+
+
+def test_archive_move_restore_lifecycle(tmp_path):
+    """Unused segments archive to a second location, restore back to base,
+    and serve again — files follow, loadSpecs track them
+    (reference ArchiveTask / MoveTask / RestoreTask)."""
+    from druid_tpu.indexing import ArchiveTask, MoveTask, RestoreTask
+    md = MetadataStore()
+    deep = LocalDeepStorage(str(tmp_path / "base"))
+    ov = Overlord(md, deep)
+    ov.run_task(IndexTask("a_ds", InlineFirehose(_records(200, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    desc = md.used_segments("a_ds")[0]
+    live_path = desc.load_spec["path"]
+    n_rows = deep.pull(desc).n_rows
+
+    # archive is a no-op while the segment is still used
+    assert ov.run_task(ArchiveTask("a_ds", WEEK)).state == "SUCCESS"
+    assert md.used_segments("a_ds")[0].load_spec["path"] == live_path
+
+    md.mark_unused([desc.id])
+    assert ov.run_task(ArchiveTask("a_ds", WEEK)).state == "SUCCESS"
+    archived = md.unused_segments("a_ds")[0]
+    assert "base_archive" in archived.load_spec["path"]
+    assert not os.path.isdir(live_path)
+    assert os.path.isdir(archived.load_spec["path"])
+
+    # move to an explicit third location
+    cold = str(tmp_path / "cold")
+    assert ov.run_task(MoveTask("a_ds", WEEK, cold)).state == "SUCCESS"
+    moved = md.unused_segments("a_ds")[0]
+    assert moved.load_spec["path"].startswith(cold)
+
+    # restore: files return to base, segment is used again and pullable
+    assert ov.run_task(RestoreTask("a_ds", WEEK)).state == "SUCCESS"
+    assert md.unused_segments("a_ds") == []
+    restored = md.used_segments("a_ds")[0]
+    assert restored.load_spec["path"] == live_path
+    assert deep.pull(restored).n_rows == n_rows
+
+
+def test_archive_crash_idempotent_rerun(tmp_path):
+    """Files moved but metadata not yet updated (crash window): re-running
+    the archive completes the move instead of stranding the segment; a
+    genuinely missing segment fails loudly instead of green-skipping."""
+    import shutil
+    from druid_tpu.indexing import ArchiveTask, MoveTask
+    md = MetadataStore()
+    deep = LocalDeepStorage(str(tmp_path / "base"))
+    ov = Overlord(md, deep)
+    ov.run_task(IndexTask("c_ds", InlineFirehose(_records(100, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    desc = md.used_segments("c_ds")[0]
+    md.mark_unused([desc.id])
+    # simulate the crashed first run: files at the archive destination,
+    # metadata still pointing at base
+    src = desc.load_spec["path"]
+    dst = src.replace(str(tmp_path / "base"), str(tmp_path / "base_archive"))
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.move(src, dst)
+    assert ov.run_task(ArchiveTask("c_ds", WEEK)).state == "SUCCESS"
+    healed = md.unused_segments("c_ds")[0]
+    assert healed.load_spec["path"] == dst
+    assert deep.pull(healed).n_rows == 100
+    # genuinely gone → FAILED, not silent success
+    shutil.rmtree(dst)
+    st = ov.run_task(MoveTask("c_ds", WEEK, str(tmp_path / "cold")))
+    assert st.state == "FAILED" and "missing" in st.error
+
+
+def test_task_json_roundtrip_move_archive_restore():
+    from druid_tpu.indexing import ArchiveTask, MoveTask, RestoreTask
+    from druid_tpu.indexing.task import task_from_json
+    for t in (MoveTask("ds", WEEK, "cold"), ArchiveTask("ds", WEEK),
+              RestoreTask("ds", WEEK)):
+        rt = task_from_json(t.to_json())
+        assert type(rt) is type(t)
+        assert rt.id == t.id and rt.datasource == "ds"
+        assert str(rt.interval) == str(WEEK)
+    assert task_from_json(MoveTask("ds", WEEK, "cold").to_json()).target \
+        == "cold"
 
 
 def test_lockbox_priority_revocation():

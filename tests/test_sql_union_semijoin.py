@@ -227,3 +227,14 @@ def test_tables_of_sees_subquery_and_union_tables(sql):
         "SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
     assert tables == ["test"]
     assert is_meta
+
+
+def test_zero_row_scalar_paths_agree(sql):
+    """The synthesized identity row (time bound prunes all segments) must
+    match the engine's covered-but-empty row (filter matches nothing) for
+    EVERY aggregator type, including approximate ones."""
+    q1 = ("SELECT COUNT(*) c, SUM(metLong) s, MAX(metFloat) mx, "
+          "APPROX_COUNT_DISTINCT(dimA) u FROM test WHERE ")
+    _, pruned = sql.execute(q1 + "__time >= TIMESTAMP '3000-01-01'")
+    _, nomatch = sql.execute(q1 + "dimA = 'no_such_value'")
+    assert pruned == nomatch
