@@ -46,6 +46,29 @@ class MissingSegmentsError(RuntimeError):
         self.segment_ids = sorted(segment_ids)
 
 
+def _slice_scan_batches(batches, skip: int, remaining):
+    """Apply a global offset/limit across scan batches. Returns
+    (sliced batches, skip left, remaining left) so streaming callers can
+    carry the counters across waves; `remaining` None means unlimited."""
+    out = []
+    for b in batches:
+        ev = b["events"]
+        if skip:
+            if skip >= len(ev):
+                skip -= len(ev)
+                continue
+            ev = ev[skip:]
+            skip = 0
+        if remaining is not None:
+            ev = ev[:remaining]
+            remaining -= len(ev)
+        if ev:
+            out.append({**b, "events": ev})
+        if remaining is not None and remaining <= 0:
+            break
+    return out, skip, remaining
+
+
 def _filter_domain(flt) -> Dict[str, List[Optional[str]]]:
     """Extract dim → candidate-values constraints for shard pruning
     (the broker's hash-pruning of secondary partitions)."""
@@ -132,6 +155,40 @@ class Broker:
                 return self._run_rows(query, segments)
             return self._run_aggregate(query, segments)
         return self._run_rows(query, segments)
+
+    def run_streaming(self, query: Query):
+        """Streaming scan through the scatter path: segments are queried
+        in per-segment waves (time-ordered when the scan is ordered) and
+        each wave's batches yield before the next segment is touched, so
+        a satisfied limit stops the scatter early and rows reach the
+        caller incrementally. Non-scan queries are merge-shaped: they
+        fall back to the materialized run()."""
+        if not isinstance(query, ScanQuery) or query.inner_query is not None:
+            yield from self.run(query)
+            return
+        from druid_tpu.engine.executor import apply_interval_chunking
+        query = apply_interval_chunking(query)
+        segments = self._segments_to_query(query)
+        if not segments:
+            return
+        if query.order != "none":
+            segments.sort(key=lambda d: d.interval.start,
+                          reverse=(query.order == "descending"))
+        skip = query.offset
+        remaining = query.limit
+        for d in segments:
+            if remaining is not None and remaining <= 0:
+                return
+            # per-node offsets don't compose globally: request raw rows
+            # (bounded by what could still be needed) and slice here
+            want = None if remaining is None else remaining + skip
+            sub = replace(query, limit=want, offset=0)
+            batches = self._merge_rows(
+                replace(sub, limit=None, offset=0),
+                self._scatter(sub, [d], rows_mode=True), [d])
+            sliced, skip, remaining = _slice_scan_batches(
+                batches, skip, remaining)
+            yield from sliced
 
     def _segments_to_query(self, query: Query) -> List[SegmentDescriptor]:
         """Timeline lookup + shard pruning (computeSegmentsToQuery)."""
@@ -340,24 +397,8 @@ class Broker:
                 batches.sort(key=lambda b: iv_of.get(b["segmentId"], 0),
                              reverse=(query.order == "descending"))
             if query.limit is not None or query.offset:
-                out, skip = [], query.offset
-                remaining = query.limit
-                for b in batches:
-                    ev = b["events"]
-                    if skip:
-                        if skip >= len(ev):
-                            skip -= len(ev)
-                            continue
-                        ev = ev[skip:]
-                        skip = 0
-                    if remaining is not None:
-                        ev = ev[:remaining]
-                        remaining -= len(ev)
-                    if ev:
-                        out.append({**b, "events": ev})
-                    if remaining is not None and remaining <= 0:
-                        break
-                batches = out
+                batches, _, _ = _slice_scan_batches(
+                    batches, query.offset, query.limit)
             return batches
         if isinstance(query, TimeBoundaryQuery):
             mn, mx = None, None

@@ -599,7 +599,11 @@ def _decode_rows(segment: Segment, row_ids: np.ndarray,
     return out
 
 
-def run_scan(query: ScanQuery, segments: Sequence[Segment]) -> List[dict]:
+def iter_scan(query: ScanQuery, segments: Sequence[Segment]):
+    """Lazy scan: yields one ScanResultValue batch at a time, a segment is
+    only filtered/decoded when its batch is pulled, and `batch_size`
+    bounds events per batch — the Sequence-analog streaming surface
+    (reference: ScanQueryEngine returning a BaseSequence of batches)."""
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
     if query.order == "descending":
@@ -608,10 +612,10 @@ def run_scan(query: ScanQuery, segments: Sequence[Segment]) -> List[dict]:
         segs = sorted(segs, key=lambda s: s.min_time)
     remaining = query.limit if query.limit is not None else None
     to_skip = query.offset
-    results = []
+    batch = max(int(query.batch_size), 1)
     for s in segs:
         if remaining is not None and remaining <= 0:
-            break
+            return
         row_ids = _masked_row_ids(s, query)
         if query.order == "descending":
             row_ids = row_ids[::-1]
@@ -626,11 +630,15 @@ def run_scan(query: ScanQuery, segments: Sequence[Segment]) -> List[dict]:
             remaining -= len(row_ids)
         columns = list(query.columns) or (
             ["__time"] + list(s.dims.keys()) + list(s.metrics.keys()))
-        events = _decode_rows(s, row_ids, columns)
-        if events:
-            results.append({"segmentId": str(s.id), "columns": columns,
-                            "events": events})
-    return results
+        for i in range(0, len(row_ids), batch):
+            events = _decode_rows(s, row_ids[i:i + batch], columns)
+            if events:
+                yield {"segmentId": str(s.id), "columns": columns,
+                       "events": events}
+
+
+def run_scan(query: ScanQuery, segments: Sequence[Segment]) -> List[dict]:
+    return list(iter_scan(query, segments))
 
 
 def run_select(query: SelectQuery, segments: Sequence[Segment]) -> List[dict]:

@@ -101,6 +101,28 @@ class QueryExecutor:
                 return self._dispatch(query, segs)
         return self._dispatch(query, segs)
 
+    def run_streaming(self, query: Query,
+                      segments: Optional[Sequence[Segment]] = None):
+        """Iterator of result batches. Scan queries stream lazily — a
+        segment is only scanned when its batch is pulled, so limits
+        short-circuit and callers (HTTP chunked responses) emit rows
+        before the scan finishes. Other query types are aggregates whose
+        results only exist after the merge: they yield their (already
+        computed) rows one batch at a time (reference: every QueryRunner
+        returns a lazy Sequence; scan is the type where laziness pays)."""
+        if isinstance(query, ScanQuery) and query.inner_query is None:
+            query = apply_interval_chunking(query)
+            if segments is not None:
+                segs = list(segments)
+            elif query.union_datasources:
+                segs = []
+                for d in query.union_datasources:
+                    segs.extend(self._by_ds.get(d, []))
+            else:
+                segs = self._by_ds.get(query.datasource, [])
+            return engines.iter_scan(query, segs)
+        return iter(self.run(query, segments))
+
     def _dispatch(self, query: Query, segs: List[Segment]):
         if isinstance(query, (TimeseriesQuery, TopNQuery, GroupByQuery)) \
                 and query.context_map.get("bySegment"):

@@ -396,6 +396,12 @@ class KillTask(Task):
                 "interval": str(self.interval)}
 
     def run(self, toolbox: "TaskToolbox") -> TaskStatus:
+        # exclusive lock: without it a concurrent move/restore over the
+        # same interval interleaves with the deletes (kill misses the
+        # moved files, then deletes their metadata rows — orphaned files)
+        lock = toolbox.lock(self, [self.interval])
+        if lock is None:
+            return TaskStatus.failure(self.id, "could not acquire lock")
         descs = toolbox.metadata.unused_segments(self.datasource,
                                                  self.interval)
         for d in descs:
@@ -432,10 +438,11 @@ class MoveTask(Task):
         for d in toolbox.metadata.unused_segments(self.datasource,
                                                   self.interval):
             nd = toolbox.deep_storage.move(d, self.target)
-            if nd is None:
+            if nd is None or \
+                    not toolbox.metadata.update_segment_payload(nd):
+                # files absent, or the metadata row vanished underneath
+                # (concurrent kill) leaving the moved files orphaned
                 missing.append(d.id)
-            else:
-                toolbox.metadata.update_segment_payload(nd)
         if missing:
             # a green move over unpullable segments would hide data loss
             return TaskStatus.failure(

@@ -774,7 +774,8 @@ class ScanQuery(Query):
     def to_json(self):
         j = self.base_json()
         j.update(columns=list(self.columns), limit=self.limit,
-                 offset=self.offset, order=self.order)
+                 offset=self.offset, order=self.order,
+                 batchSize=self.batch_size)
         return j
 
 
@@ -988,10 +989,14 @@ def _query_body_from_json(j: dict, ds: str) -> Query:
             subtotals=j.get("subtotalsSpec") or (), virtual_columns=vcs, **common)
     if t == "scan":
         common.pop("granularity")
-        return ScanQuery.of(ds, columns=j.get("columns", ()),
-                            limit=j.get("limit"), offset=j.get("offset", 0),
-                            order=j.get("order", "none"), virtual_columns=vcs,
-                            **common)
+        q = ScanQuery.of(ds, columns=j.get("columns", ()),
+                         limit=j.get("limit"), offset=j.get("offset", 0),
+                         order=j.get("order", "none"), virtual_columns=vcs,
+                         **common)
+        if j.get("batchSize"):
+            from dataclasses import replace
+            q = replace(q, batch_size=int(j["batchSize"]))
+        return q
     if t == "select":
         ps = j.get("pagingSpec", {})
         return SelectQuery.of(ds, dimensions=j.get("dimensions", ()),

@@ -62,6 +62,10 @@ class QueryHttpServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked streaming requires 1.1; every non-streaming reply
+            # sends Content-Length so keep-alive works unchanged
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):   # quiet
                 pass
 
@@ -142,6 +146,11 @@ class QueryHttpServer:
                             self._reply(200, [dict(zip(cols, r))
                                               for r in rows])
                     elif self.path.rstrip("/") == "/druid/v2":
+                        if payload.get("queryType") == "scan" and \
+                                "application/x-ndjson" in (
+                                    self.headers.get("Accept") or ""):
+                            self._stream_scan(payload, identity)
+                            return
                         rows = outer.lifecycle.run_json(
                             payload, identity=identity)
                         self._reply(200, rows)
@@ -161,6 +170,51 @@ class QueryHttpServer:
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
                 except Exception as e:
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _stream_scan(self, payload: dict, identity) -> None:
+                """Chunked NDJSON scan results: one batch per line, written
+                as the engine produces it — rows reach the client before
+                the scan finishes (the Sequence-streaming surface of
+                QueryResource). A failure after the first chunk can only
+                truncate: the missing terminal chunk tells the client."""
+                from druid_tpu.query.model import query_from_json
+                try:
+                    query = query_from_json(payload)
+                except (ValueError, KeyError, TypeError):
+                    # malformed queries count as failures here too, like
+                    # run_json's resource-layer accounting
+                    if outer.lifecycle.on_result:
+                        outer.lifecycle.on_result(False)
+                    raise
+                gen = outer.lifecycle.run_streaming(query,
+                                                    identity=identity)
+                # pull the first batch BEFORE sending headers so pre-stream
+                # failures (auth, planning) take the normal error path
+                first = next(gen, None)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(b: dict) -> None:
+                    line = json.dumps(
+                        b, default=_json_value).encode() + b"\n"
+                    self.wfile.write(f"{len(line):X}\r\n".encode()
+                                     + line + b"\r\n")
+
+                try:
+                    if first is not None:
+                        chunk(first)
+                    for batch in gen:
+                        chunk(batch)
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:
+                    # client gone: close the generator NOW so the
+                    # lifecycle's abandoned-stream accounting fires
+                    # deterministically, then drop the connection (the
+                    # missing terminal chunk marks truncation)
+                    gen.close()
+                    self.close_connection = True
 
             def do_DELETE(self):
                 # DELETE /druid/v2/{id} — QueryResource.cancelQuery:

@@ -111,6 +111,57 @@ class QueryLifecycle:
             self.on_result(True)
         return rows
 
+    def run_streaming(self, query: Query, identity: Optional[str] = None):
+        """Streaming variant: authorize up front, yield result batches as
+        the runner produces them, emit the request log/metrics when the
+        stream completes, fails, OR is abandoned (client disconnect →
+        GeneratorExit). Falls back to the materialized path for runners
+        without run_streaming."""
+        runner_stream = getattr(self.runner, "run_streaming", None)
+        if runner_stream is None:
+            yield from self.run(query, identity)
+            return
+        qid = query.context_map.get("queryId") or str(uuid.uuid4())
+        if self.authorizer is not None \
+                and not self.authorizer(identity, query):
+            self._log(query, qid, 0.0, False, error="unauthorized")
+            raise Unauthorized(f"identity {identity!r} denied on "
+                               f"[{query.datasource}]")
+        if qid != query.context_map.get("queryId"):
+            # stamp the id so the scatter's cancel token and DELETE
+            # /druid/v2/{id} act on THIS execution, exactly like run()
+            from dataclasses import replace
+            query = replace(query, context=tuple(sorted(
+                {**query.context_map, "queryId": qid}.items())))
+        if self.query_manager is not None:
+            self.query_manager.register(qid)
+        t0 = time.monotonic()
+        n = 0
+        try:
+            for batch in runner_stream(query):
+                n += _count_rows([batch])
+                yield batch
+            self._log(query, qid, (time.monotonic() - t0) * 1000, True,
+                      n_rows=n)
+            if self.on_result:
+                self.on_result(True)
+        except GeneratorExit:
+            # consumer walked away mid-stream — the query still happened
+            self._log(query, qid, (time.monotonic() - t0) * 1000, False,
+                      error="stream abandoned", n_rows=n)
+            if self.on_result:
+                self.on_result(False)
+            raise
+        except Exception as e:
+            self._log(query, qid, (time.monotonic() - t0) * 1000, False,
+                      error=str(e))
+            if self.on_result:
+                self.on_result(False)
+            raise
+        finally:
+            if self.query_manager is not None:
+                self.query_manager.unregister(qid)
+
     def _log(self, query: Query, qid: str, ms: float, ok: bool,
              error: Optional[str] = None, n_rows: int = 0) -> None:
         if self.emitter is not None:

@@ -422,3 +422,21 @@ def test_task_from_json():
     t2 = task_from_json({"type": "kill", "dataSource": "x",
                          "interval": str(WEEK)})
     assert isinstance(t2, KillTask)
+
+
+def test_kill_task_takes_interval_lock():
+    """KillTask must exclude concurrent move/restore over the interval
+    (without the lock a kill interleaving with a move orphans the moved
+    files)."""
+    md, ov = _overlord()
+    ov.run_task(IndexTask("kl_ds", InlineFirehose(_records(50, days=1)),
+                          None, SPECS, segment_granularity="day"))
+    desc = md.used_segments("kl_ds")[0]
+    md.mark_unused([desc.id])
+    blocker = ov.lockbox.acquire("someone_else", "kl_ds", WEEK, priority=99)
+    assert blocker is not None
+    st = ov.run_task(KillTask("kl_ds", WEEK))
+    assert st.state == "FAILED" and "lock" in st.error
+    ov.lockbox.release_all("someone_else")
+    assert ov.run_task(KillTask("kl_ds", WEEK)).state == "SUCCESS"
+    assert md.unused_segments("kl_ds") == []

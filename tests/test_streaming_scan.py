@@ -1,0 +1,188 @@
+"""Incremental scan streaming: lazy engine batches, broker early
+termination, chunked NDJSON over HTTP.
+
+Reference: the Sequence result pipeline (java-util/.../guava/Sequence.java)
+— every QueryRunner returns a lazy stream; ScanQueryEngine yields
+ScanResultValue batches of `batchSize` events and QueryResource writes
+them to the response as they arrive.
+"""
+import json
+import urllib.request
+
+import pytest
+
+from druid_tpu.cluster import (Broker, DataNode, InventoryView,
+                               descriptor_for)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.model import ScanQuery, query_from_json
+from druid_tpu.utils.intervals import Interval
+
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+
+
+def test_iter_scan_is_lazy(segments, monkeypatch):
+    """Pulling the first batch must not decode later segments."""
+    from druid_tpu.engine import engines
+    decoded = []
+    real = engines._decode_rows
+
+    def spy(seg, row_ids, columns):
+        decoded.append(str(seg.id))
+        return real(seg, row_ids, columns)
+
+    monkeypatch.setattr(engines, "_decode_rows", spy)
+    ex = QueryExecutor(segments)
+    q = ScanQuery.of("test", [WEEK], columns=("dimA", "metLong"),
+                     order="ascending")
+    gen = ex.run_streaming(q)
+    next(gen)
+    assert len(set(decoded)) == 1
+    assert len(segments) > 1
+
+
+def test_batch_size_bounds_events(segments):
+    ex = QueryExecutor(segments)
+    q = ScanQuery.of("test", [WEEK], columns=("dimA",))
+    q = q.__class__(**{**q.__dict__, "batch_size": 100})
+    batches = list(ex.run_streaming(q))
+    assert all(len(b["events"]) <= 100 for b in batches)
+    total_small = sum(len(b["events"]) for b in batches)
+    total_default = sum(
+        len(b["events"]) for b in
+        ex.run(ScanQuery.of("test", [WEEK], columns=("dimA",))))
+    assert total_small == total_default
+
+
+def test_streaming_matches_materialized(segments):
+    ex = QueryExecutor(segments)
+    q = ScanQuery.of("test", [WEEK], columns=("dimA", "metLong"),
+                     order="ascending", limit=500, offset=37)
+    streamed = [e for b in ex.run_streaming(q) for e in b["events"]]
+    materialized = [e for b in ex.run(q) for e in b["events"]]
+    assert streamed == materialized
+
+
+def test_scan_batchsize_wire_roundtrip():
+    q = query_from_json({
+        "queryType": "scan", "dataSource": "x",
+        "intervals": [str(WEEK)], "batchSize": 777})
+    assert q.batch_size == 777
+    assert query_from_json(q.to_json()).batch_size == 777
+
+
+@pytest.fixture()
+def scan_cluster(segments):
+    view = InventoryView()
+    nodes = [DataNode(f"node{i}") for i in range(2)]
+    for n in nodes:
+        view.register(n)
+    half = len(segments) // 2 or 1
+    for i, s in enumerate(segments):
+        node = nodes[0] if i < half else nodes[1]
+        node.load_segment(s)
+        view.announce(node.name, descriptor_for(s))
+    return view, nodes, Broker(view)
+
+
+def test_broker_streaming_limit_short_circuits(scan_cluster, segments,
+                                               monkeypatch):
+    """A satisfied limit stops the scatter: later segments are never
+    queried."""
+    view, nodes, broker = scan_cluster
+    scattered = []
+    real = Broker._scatter
+
+    def spy(self, query, segs, rows_mode):
+        scattered.extend(d.id for d in segs)
+        return real(self, query, segs, rows_mode)
+
+    monkeypatch.setattr(Broker, "_scatter", spy)
+    q = ScanQuery.of("test", [WEEK], columns=("dimA",),
+                     order="ascending", limit=10)
+    rows = [e for b in broker.run_streaming(q) for e in b["events"]]
+    assert len(rows) == 10
+    assert len(scattered) == 1          # first segment satisfied the limit
+    # and the streamed rows equal the materialized broker run
+    want = [e for b in broker.run(q) for e in b["events"]]
+    assert rows == want
+
+
+def test_broker_streaming_full_equality(scan_cluster):
+    _, _, broker = scan_cluster
+    q = ScanQuery.of("test", [WEEK], columns=("dimA", "metLong"),
+                     order="ascending", offset=25)
+    streamed = [e for b in broker.run_streaming(q) for e in b["events"]]
+    want = [e for b in broker.run(q) for e in b["events"]]
+    assert streamed == want
+
+
+def test_http_ndjson_streaming(segments):
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.lifecycle import QueryLifecycle
+    ex = QueryExecutor(segments)
+    srv = QueryHttpServer(QueryLifecycle(ex), port=0).start()
+    try:
+        payload = {"queryType": "scan", "dataSource": "test",
+                   "intervals": [str(WEEK)], "columns": ["dimA"],
+                   "batchSize": 1000, "limit": 3500}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2",
+            json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "Accept": "application/x-ndjson"})
+        with urllib.request.urlopen(req) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            batches = [json.loads(line) for line in r if line.strip()]
+        assert sum(len(b["events"]) for b in batches) == 3500
+        assert len(batches) >= 4        # chunked, not one blob
+        # plain Accept still gets the one-shot JSON array
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/druid/v2",
+            json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2) as r2:
+            arr = json.loads(r2.read())
+        assert sum(len(b["events"]) for b in arr) == 3500
+    finally:
+        srv.stop()
+
+
+def test_abandoned_stream_is_accounted(segments):
+    """Client disconnect (generator close) still emits the request log and
+    the failure count — streams must not vanish from metrics."""
+    from druid_tpu.server.lifecycle import QueryLifecycle, RequestLogger
+    results = []
+    logger = RequestLogger()
+    lc = QueryLifecycle(QueryExecutor(segments), request_logger=logger,
+                        on_result=results.append)
+    q = ScanQuery.of("test", [WEEK], columns=("dimA",))
+    q = q.__class__(**{**q.__dict__, "batch_size": 10})
+    gen = lc.run_streaming(q)
+    next(gen)
+    gen.close()
+    assert results == [False]
+    assert logger.entries and "abandoned" in str(logger.entries[-1])
+    # a fully consumed stream counts success
+    rows = list(lc.run_streaming(q))
+    assert rows and results == [False, True]
+
+
+def test_streaming_stamps_query_id_for_cancel(segments):
+    """run_streaming must stamp its generated queryId into the query it
+    executes, so cancel tokens act on the running scatter."""
+    from druid_tpu.server.lifecycle import QueryLifecycle
+    from druid_tpu.server.querymanager import QueryManager
+    seen = {}
+
+    class Probe:
+        def run_streaming(self, query):
+            seen["qid"] = query.context_map.get("queryId")
+            yield {"events": []}
+
+        def run(self, query):
+            return []
+
+    qm = QueryManager()
+    lc = QueryLifecycle(Probe(), query_manager=qm)
+    list(lc.run_streaming(ScanQuery.of("test", [WEEK])))
+    assert seen["qid"]
