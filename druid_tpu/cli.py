@@ -57,10 +57,18 @@ def cmd_server(args) -> int:
     sql = SqlExecutor(broker)
     http = QueryHttpServer(lifecycle, sql, port=cfg.get_int("server.port",
                                                             8082))
-    http.start()
     monitors = MonitorScheduler(emitter, [SysMonitor(), ProcessMonitor()],
                                 cfg.get_float("monitor.period", 60.0))
-    monitors.start()
+
+    # ordered bring-up/teardown (java-util Lifecycle): monitors and the
+    # overlord pool before the HTTP server accepts, HTTP down first on stop
+    from druid_tpu.utils.lifecycle import Lifecycle, Stage
+    lc = Lifecycle()
+    lc.add(monitors, stage=Stage.NORMAL, name="monitors")
+    lc.add(start=None, stop=overlord.shutdown, stage=Stage.NORMAL,
+           name="overlord")
+    lc.add(http, stage=Stage.SERVER, name="http")
+    lc.start()
     print(f"druid-tpu server listening on :{http.port} "
           f"({n_nodes} data node(s))", flush=True)
 
@@ -70,8 +78,7 @@ def cmd_server(args) -> int:
             coordinator.run_once()
             time.sleep(period)
     except KeyboardInterrupt:
-        http.stop()
-        overlord.shutdown()
+        lc.stop()
         return 0
 
 

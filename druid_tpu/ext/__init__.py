@@ -20,6 +20,7 @@ from druid_tpu.ext.hllsketch import (HLLSketchBuildAggregator,
                                      HLLSketchMergeAggregator,
                                      HLLSketchToEstimatePostAgg)
 from druid_tpu.ext.protobuf_parser import ProtobufInputRowParser
+from druid_tpu.ext.time_minmax import (TimeMaxAggregator, TimeMinAggregator)
 
 __all__ = [
     "HLLSketchBuildAggregator", "HLLSketchMergeAggregator",
@@ -29,6 +30,6 @@ __all__ = [
     "ThetaSketchSetOpPostAgg", "QuantilesSketchAggregator", "QuantilePostAgg",
     "QuantilesPostAgg", "ApproximateHistogramAggregator", "HistogramValue",
     "HistogramQuantilePostAgg", "BloomFilterAggregator", "BloomFilterValue",
-    "ProtobufInputRowParser",
+    "ProtobufInputRowParser", "TimeMinAggregator", "TimeMaxAggregator",
     "BloomDimFilter",
 ]

@@ -1,0 +1,110 @@
+"""Time min/max aggregators (reference: extensions-contrib/time-min-max —
+TimestampMinAggregatorFactory / TimestampMaxAggregatorFactory: the
+earliest/latest event __time per group, usable in any aggregation, not
+just timeBoundary).
+
+TPU-first: segments stage row time as an int32 offset from the segment
+interval start, so the device reduction is a narrow segment-min/max; the
+host widens to absolute int64 epoch millis (identity-aware) for
+cross-segment merges — the exact narrow-sentinel discipline of the core
+MinMaxKernel.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from druid_tpu.engine.kernels import (AggKernel, INT64_MAX, INT64_MIN,
+                                      _seg_max, _seg_min, register_kernel)
+from druid_tpu.query.aggregators import AggregatorSpec, register_aggregator
+
+INT32_MAX = 2**31 - 1
+INT32_MIN = -(2**31)
+
+
+@dataclass(frozen=True)
+class TimeMinAggregator(AggregatorSpec):
+    name: str
+
+    def required_columns(self):
+        return set()          # __time is always staged
+
+    def combining(self):
+        return TimeMinAggregator(self.name)
+
+    def to_json(self):
+        return {"type": "timeMin", "name": self.name,
+                "fieldName": "__time"}
+
+
+@dataclass(frozen=True)
+class TimeMaxAggregator(AggregatorSpec):
+    name: str
+
+    def required_columns(self):
+        return set()
+
+    def combining(self):
+        return TimeMaxAggregator(self.name)
+
+    def to_json(self):
+        return {"type": "timeMax", "name": self.name,
+                "fieldName": "__time"}
+
+
+class TimeMinMaxKernel(AggKernel):
+    def __init__(self, spec, segment, is_max: bool):
+        super().__init__(spec)
+        self.is_max = is_max
+        self.reduce_kind = "max" if is_max else "min"
+
+    def signature(self):
+        return f"time{'max' if self.is_max else 'min'}()"
+
+    @property
+    def identity(self):
+        return INT64_MIN if self.is_max else INT64_MAX
+
+    def update(self, cols, mask, keys, num, aux):
+        import jax.numpy as jnp
+        t = cols["__time_offset"]                       # int32 relative
+        ident = jnp.int32(INT32_MIN if self.is_max else INT32_MAX)
+        tm = jnp.where(mask, t, ident)
+        return _seg_max(tm, keys, num) if self.is_max \
+            else _seg_min(tm, keys, num)
+
+    def host_post(self, state, segment):
+        st = np.asarray(state).astype(np.int64)
+        narrow_ident = INT32_MIN if self.is_max else INT32_MAX
+        abs_t = st + segment.interval.start
+        return np.where(np.asarray(state) == narrow_ident,
+                        self.identity, abs_t)
+
+    def device_post(self, state, time0):
+        import jax.numpy as jnp
+        narrow_ident = INT32_MIN if self.is_max else INT32_MAX
+        t64 = state.astype(jnp.int64) + time0
+        return jnp.where(state == jnp.int32(narrow_ident),
+                         jnp.int64(self.identity), t64)
+
+    def device_combine(self, a, b):
+        import jax.numpy as jnp
+        return jnp.maximum(a, b) if self.is_max else jnp.minimum(a, b)
+
+    def host_from_device(self, state):
+        return np.asarray(state)
+
+    def combine(self, a, b):
+        return np.maximum(a, b) if self.is_max else np.minimum(a, b)
+
+    def empty_state(self, n):
+        return np.full(n, self.identity, dtype=np.int64)
+
+
+register_aggregator("timeMin", lambda j: TimeMinAggregator(j["name"]))
+register_aggregator("timeMax", lambda j: TimeMaxAggregator(j["name"]))
+register_kernel(TimeMinAggregator,
+                lambda spec, seg: TimeMinMaxKernel(spec, seg, False))
+register_kernel(TimeMaxAggregator,
+                lambda spec, seg: TimeMinMaxKernel(spec, seg, True))

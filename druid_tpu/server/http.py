@@ -105,6 +105,10 @@ class QueryHttpServer:
 
             def do_POST(self):
                 try:
+                    # read the body BEFORE any early reply: on a keep-alive
+                    # (HTTP/1.1) connection an unread body would be parsed
+                    # as the next request line, desyncing the stream
+                    payload = self._body()
                     identity = self.headers.get("X-Druid-Identity")
                     if outer.auth_chain is not None:
                         auth = outer.auth_chain.authenticate(
@@ -113,7 +117,6 @@ class QueryHttpServer:
                             self._reply(401, {"error": "unauthenticated"})
                             return
                         identity = auth
-                    payload = self._body()
                     if self.path.rstrip("/") == "/druid/v2/sql/avatica":
                         if outer.avatica is None:
                             self._reply(404, {"error": "SQL not enabled"})

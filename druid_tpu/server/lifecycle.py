@@ -80,19 +80,26 @@ class QueryLifecycle:
             raise
         return self.run(query, identity)
 
-    def run(self, query: Query, identity: Optional[str] = None):
+    def _prepare(self, query: Query, identity):
+        """Shared security-sensitive prologue of run()/run_streaming:
+        authorize, stamp the queryId so cancel/timeout plumbing sees it,
+        register with the query manager. Returns (query, qid)."""
         qid = query.context_map.get("queryId") or str(uuid.uuid4())
-        if self.authorizer is not None and not self.authorizer(identity, query):
+        if self.authorizer is not None \
+                and not self.authorizer(identity, query):
             self._log(query, qid, 0.0, False, error="unauthorized")
             raise Unauthorized(f"identity {identity!r} denied on "
                                f"[{query.datasource}]")
         if qid != query.context_map.get("queryId"):
-            # stamp the generated id so cancel/timeout plumbing sees it
             from dataclasses import replace
             query = replace(query, context=tuple(sorted(
                 {**query.context_map, "queryId": qid}.items())))
         if self.query_manager is not None:
             self.query_manager.register(qid)
+        return query, qid
+
+    def run(self, query: Query, identity: Optional[str] = None):
+        query, qid = self._prepare(query, identity)
         t0 = time.monotonic()
         try:
             rows = self.runner.run(query)
@@ -121,25 +128,12 @@ class QueryLifecycle:
         if runner_stream is None:
             yield from self.run(query, identity)
             return
-        qid = query.context_map.get("queryId") or str(uuid.uuid4())
-        if self.authorizer is not None \
-                and not self.authorizer(identity, query):
-            self._log(query, qid, 0.0, False, error="unauthorized")
-            raise Unauthorized(f"identity {identity!r} denied on "
-                               f"[{query.datasource}]")
-        if qid != query.context_map.get("queryId"):
-            # stamp the id so the scatter's cancel token and DELETE
-            # /druid/v2/{id} act on THIS execution, exactly like run()
-            from dataclasses import replace
-            query = replace(query, context=tuple(sorted(
-                {**query.context_map, "queryId": qid}.items())))
-        if self.query_manager is not None:
-            self.query_manager.register(qid)
+        query, qid = self._prepare(query, identity)
         t0 = time.monotonic()
         n = 0
         try:
             for batch in runner_stream(query):
-                n += _count_rows([batch])
+                n += 1        # batches, matching run()'s len(rows)
                 yield batch
             self._log(query, qid, (time.monotonic() - t0) * 1000, True,
                       n_rows=n)

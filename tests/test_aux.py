@@ -306,3 +306,121 @@ def test_cli_validate_rejects_garbage(tmp_path, capsys):
     d.mkdir()
     (d / "meta.smoosh").write_text("garbage")
     assert main(["validate-segment", str(d)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Ordered service lifecycle (java-util Lifecycle.java)
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_stage_order_and_reverse_stop():
+    from druid_tpu.utils.lifecycle import Lifecycle, Stage
+    events = []
+
+    def h(name):
+        return dict(start=lambda: events.append(f"+{name}"),
+                    stop=lambda: events.append(f"-{name}"))
+
+    lc = Lifecycle()
+    # registered out of stage order on purpose
+    lc.add(**h("announce"), stage=Stage.ANNOUNCEMENTS)
+    lc.add(**h("http"), stage=Stage.SERVER)
+    lc.add(**h("meta"), stage=Stage.INIT)
+    lc.add(**h("monitorA"), stage=Stage.NORMAL)
+    lc.add(**h("monitorB"), stage=Stage.NORMAL)
+    with lc:
+        assert events == ["+meta", "+monitorA", "+monitorB", "+http",
+                          "+announce"]
+    assert events[5:] == ["-announce", "-http", "-monitorB", "-monitorA",
+                          "-meta"]
+
+
+def test_lifecycle_failed_start_unwinds_started_prefix():
+    from druid_tpu.utils.lifecycle import Lifecycle, Stage
+    events = []
+    lc = Lifecycle()
+    lc.add(start=lambda: events.append("+a"),
+           stop=lambda: events.append("-a"), stage=Stage.INIT)
+    lc.add(start=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+           stop=lambda: events.append("-b"), stage=Stage.NORMAL)
+    lc.add(start=lambda: events.append("+c"),
+           stop=lambda: events.append("-c"), stage=Stage.SERVER)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="boom"):
+        lc.start()
+    # only the started prefix unwound; the never-started c is untouched
+    assert events == ["+a", "-a"]
+    assert not lc.running
+
+
+def test_lifecycle_rejects_late_registration_and_double_start():
+    from druid_tpu.utils.lifecycle import Lifecycle
+    import pytest as _pytest
+    lc = Lifecycle()
+    lc.add(start=lambda: None, stop=lambda: None)
+    lc.start()
+    with _pytest.raises(RuntimeError, match="already started"):
+        lc.add(start=lambda: None, stop=lambda: None)
+    lc.start()                      # idempotent
+    lc.stop()
+    lc.stop()                       # idempotent
+
+
+def test_lifecycle_stop_keeps_going_past_bad_handler():
+    from druid_tpu.utils.lifecycle import Lifecycle
+    events = []
+    lc = Lifecycle()
+    lc.add(start=lambda: None, stop=lambda: events.append("-a"))
+    lc.add(start=lambda: None,
+           stop=lambda: (_ for _ in ()).throw(RuntimeError("bad stop")))
+    lc.add(start=lambda: None, stop=lambda: events.append("-c"))
+    lc.start()
+    lc.stop()
+    assert events == ["-c", "-a"]
+
+
+def test_keepalive_connection_survives_401(segment):
+    """HTTP/1.1 keep-alive: a 401 reply must drain the request body, or
+    the next request on the same connection parses the stale body as its
+    request line."""
+    import http.client
+    from druid_tpu.server.security import (AuthChain, AuthenticationResult)
+
+    class HeaderGate:
+        """Authenticates only requests carrying X-Magic."""
+        def authenticate(self, headers):
+            if any(k.lower() == "x-magic" for k in headers):
+                return AuthenticationResult("alice", "allowAll")
+            return None
+
+    ex = QueryExecutor([segment])
+    chain = AuthChain(authenticators=[HeaderGate()])
+    srv = QueryHttpServer(QueryLifecycle(ex), SqlExecutor(ex),
+                          auth_chain=chain, port=0).start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port)
+        body = json.dumps({"query": "SELECT COUNT(*) FROM test"})
+        c.request("POST", "/druid/v2/sql", body,
+                  {"Content-Type": "application/json"})
+        r1 = c.getresponse()
+        assert r1.status == 401
+        r1.read()
+        # same connection, now authenticated: must succeed, not 400
+        c.request("POST", "/druid/v2/sql", body,
+                  {"Content-Type": "application/json", "X-Magic": "1"})
+        r2 = c.getresponse()
+        assert r2.status == 200, r2.status
+        assert json.loads(r2.read())[0]["EXPR$0"] == segment.n_rows
+    finally:
+        srv.stop()
+
+
+def test_lifecycle_join_blocks_again_after_restart():
+    from druid_tpu.utils.lifecycle import Lifecycle
+    lc = Lifecycle()
+    lc.add(start=lambda: None, stop=lambda: None)
+    lc.start()
+    lc.stop()
+    lc.start()
+    assert not lc.join(timeout=0.05)     # must block: not stopped yet
+    lc.stop()
+    assert lc.join(timeout=0.05)

@@ -377,3 +377,55 @@ def test_unknown_parser_type_raises():
     import pytest
     with pytest.raises(ValueError, match="unknown parser type"):
         InputRowParser.from_json({"type": "thrift", "parseSpec": {}})
+
+
+def test_time_min_max_grouped(ex, segment):
+    """timeMin/timeMax (extensions-contrib time-min-max): earliest/latest
+    event time per group, matching a host recompute."""
+    frame = rows_as_frame(segment)
+    rows = ex.run_json({
+        "queryType": "groupBy", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+        "dimensions": ["dimA"],
+        "aggregations": [{"type": "timeMin", "name": "tmin"},
+                         {"type": "timeMax", "name": "tmax"}]})
+    t = frame["__time"]
+    for r in rows:
+        sel = frame["dimA"] == r["event"]["dimA"]
+        assert r["event"]["tmin"] == int(t[sel].min())
+        assert r["event"]["tmax"] == int(t[sel].max())
+
+
+def test_time_min_max_filtered_timeseries(ex, segment):
+    frame = rows_as_frame(segment)
+    rows = ex.run_json({
+        "queryType": "timeseries", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+        "filter": {"type": "bound", "dimension": "metLong",
+                   "lower": "50", "ordering": "numeric"},
+        "aggregations": [{"type": "timeMin", "name": "tmin"},
+                         {"type": "timeMax", "name": "tmax"}]})
+    sel = frame["metLong"] >= 50
+    assert rows[0]["result"]["tmin"] == int(frame["__time"][sel].min())
+    assert rows[0]["result"]["tmax"] == int(frame["__time"][sel].max())
+
+
+def test_time_min_max_multi_segment_merge(segments):
+    """Cross-segment merge keeps absolute-time semantics."""
+    from tests.conftest import rows_as_frame as raf
+    ex2 = QueryExecutor(segments)
+    rows = ex2.run_json({
+        "queryType": "groupBy", "dataSource": "test",
+        "intervals": ["2026-01-01/2026-01-08"], "granularity": "all",
+        "dimensions": ["dimA"],
+        "aggregations": [{"type": "timeMin", "name": "tmin"},
+                         {"type": "timeMax", "name": "tmax"}]})
+    frames = [raf(s) for s in segments]
+    for r in rows:
+        lo = min(int(f["__time"][f["dimA"] == r["event"]["dimA"]].min())
+                 for f in frames
+                 if (f["dimA"] == r["event"]["dimA"]).any())
+        hi = max(int(f["__time"][f["dimA"] == r["event"]["dimA"]].max())
+                 for f in frames
+                 if (f["dimA"] == r["event"]["dimA"]).any())
+        assert r["event"]["tmin"] == lo and r["event"]["tmax"] == hi

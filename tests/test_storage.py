@@ -101,12 +101,16 @@ def test_delta_encoding_roundtrip_and_wins():
         0, 2**64, 10_000, dtype=np.uint64))
     assert np.array_equal(decompress_array(compress_array(u)), u)
     # non-monotonic unsigned must NOT delta-encode (wrapped deltas look
-    # falsely monotonic)
-    from druid_tpu.storage.codec import ENC_NONE, _pick_encoding
+    # falsely monotonic) — auto may byte-pack it instead, which is exact
+    from druid_tpu.storage.codec import ENC_DELTA, _pick_encoding
     nm = np.asarray([10, 3, 7, 1], dtype=np.uint64)
-    assert _pick_encoding(nm, "auto") == ENC_NONE
+    assert _pick_encoding(nm, "auto") != ENC_DELTA
+    assert np.array_equal(decompress_array(compress_array(nm)), nm)
     with pytest.raises(ValueError):
-        compress_array(ts, encoding="table")
+        compress_array(ts, encoding="tabel")   # typo'd encodings reject
+    # table on >256 distinct values silently falls back to none but exact
+    assert np.array_equal(
+        decompress_array(compress_array(ts, encoding="table")), ts)
     # floats / 2-D untouched
     f = np.random.default_rng(3).normal(size=1000).astype(np.float32)
     assert np.array_equal(decompress_array(compress_array(f)), f)
@@ -203,3 +207,51 @@ def test_loaded_segment_queries_match(tmp_path, segment):
     a = run_timeseries(q, [segment])
     b = run_timeseries(q, [loaded])
     assert a == b
+
+
+def test_vsize_packing_roundtrip_and_shrink():
+    """Small-range int64 columns byte-pack (VSizeLongSerde): exact
+    roundtrip, and the part is materially smaller than unpacked."""
+    from druid_tpu.storage.codec import (ENC_VSIZE8, ENC_VSIZE16,
+                                         _pick_encoding, compress_array,
+                                         decompress_array)
+    rng = np.random.default_rng(5)
+    for hi, enc in ((250, ENC_VSIZE8), (60_000, ENC_VSIZE16)):
+        arr = rng.integers(0, hi, size=200_000).astype(np.int64)
+        assert _pick_encoding(arr, "auto") == enc
+        buf = compress_array(arr, encoding="auto")
+        assert np.array_equal(decompress_array(buf), arr)
+        raw = compress_array(arr, encoding="none")
+        assert len(buf) < len(raw) * 0.7
+    # negative values cannot byte-pack
+    neg = rng.integers(-5, 5, size=1000).astype(np.int64)
+    assert _pick_encoding(neg, "auto") == 0
+    assert np.array_equal(
+        decompress_array(compress_array(neg, encoding="auto")), neg)
+
+
+def test_table_encoding_roundtrip():
+    """≤256 distinct values store the table once + u8 indexes
+    (CompressionFactory TABLE)."""
+    from druid_tpu.storage.codec import (ENC_TABLE, _pick_encoding,
+                                         compress_array, decompress_array)
+    rng = np.random.default_rng(6)
+    vals = np.array([10**12 + v * 10**9 for v in range(40)], dtype=np.int64)
+    arr = vals[rng.integers(0, 40, size=100_000)]
+    assert _pick_encoding(arr, "table") == ENC_TABLE
+    buf = compress_array(arr, encoding="table")
+    assert np.array_equal(decompress_array(buf), arr)
+    # too many distinct values: table refused, falls back to none
+    wide = rng.integers(0, 10**12, size=5000).astype(np.int64)
+    assert _pick_encoding(wide, "table") == 0
+
+
+def test_vsize_writeout_file_byte_identical(tmp_path):
+    from druid_tpu.storage.codec import (compress_array,
+                                         compress_array_to_file)
+    rng = np.random.default_rng(7)
+    arr = rng.integers(0, 200, size=300_000).astype(np.int64)
+    p = str(tmp_path / "part.bin")
+    compress_array_to_file(arr, p, encoding="auto")
+    with open(p, "rb") as f:
+        assert f.read() == compress_array(arr, encoding="auto")
