@@ -72,6 +72,10 @@ def _slice_scan_batches(batches, skip: int, remaining):
 def _filter_domain(flt) -> Dict[str, List[Optional[str]]]:
     """Extract dim → candidate-values constraints for shard pruning
     (the broker's hash-pruning of secondary partitions)."""
+    if getattr(flt, "extraction_fn", None) is not None:
+        # the raw dictionary values behind fn(v) == target are unknowable
+        # here — no pruning constraint may be derived
+        return {}
     if isinstance(flt, F.SelectorFilter):
         return {flt.dimension: [flt.value]}
     if isinstance(flt, F.InFilter):

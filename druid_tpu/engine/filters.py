@@ -299,7 +299,22 @@ def _dictionary_lut(d: Dictionary, pred) -> np.ndarray:
 
 def _string_predicate(flt: F.DimFilter):
     """Value-level predicate for a single-dim string filter (used for LUTs and
-    for row-level evaluation in having specs)."""
+    for row-level evaluation in having specs). An extraction_fn on the
+    filter transforms each dictionary value BEFORE the predicate — exactly
+    the reference's dimension-extraction filtering, and still one host LUT
+    over the dictionary."""
+    ex = getattr(flt, "extraction_fn", None)
+    if ex is not None:
+        import dataclasses
+        base = _string_predicate(dataclasses.replace(flt,
+                                                     extraction_fn=None))
+        if base is None:
+            return None
+
+        def extracted(v, _base=base, _ex=ex):
+            out = _ex.apply(v)
+            return _base("" if out is None else out)
+        return extracted
     # extension filters (e.g. bloom) expose a value_predicate() hook
     if hasattr(flt, "value_predicate"):
         return flt.value_predicate()
@@ -411,6 +426,10 @@ def _plan(flt: F.DimFilter, segment: Segment,
         # (Dictionary.id_range); the LUT is equally one gather so we keep
         # the uniform mechanism.
         return LutNode(dim, _dictionary_lut(d, pred))
+    if getattr(flt, "extraction_fn", None) is not None:
+        # numeric/time columns have no dictionary to transform
+        raise ValueError(
+            f"extractionFn filter on non-string column [{dim}]")
     # numeric column (metric) or __time
     if dim == "__time":
         dtype, colname = np.int32, "__time_offset"

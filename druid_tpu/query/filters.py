@@ -42,14 +42,25 @@ class FalseFilter(DimFilter):
         return {"type": "false"}
 
 
+def _with_exfn(j: dict, fn) -> dict:
+    if fn is not None:
+        j["extractionFn"] = fn.to_json()
+    return j
+
+
 @dataclass(frozen=True)
 class SelectorFilter(DimFilter):
-    """dimension == value (reference: query/filter/SelectorDimFilter.java)."""
+    """dimension == value (reference: query/filter/SelectorDimFilter.java).
+    An optional extraction_fn transforms each dictionary value BEFORE the
+    comparison — the dimension-extraction filter surface every leaf string
+    filter shares in the reference."""
     dimension: str
     value: Optional[str]
+    extraction_fn: Optional[object] = None
 
     def to_json(self):
-        return {"type": "selector", "dimension": self.dimension, "value": self.value}
+        return _with_exfn({"type": "selector", "dimension": self.dimension,
+                           "value": self.value}, self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
@@ -60,16 +71,19 @@ class InFilter(DimFilter):
     """dimension IN (values) (reference: query/filter/InDimFilter.java)."""
     dimension: str
     values: Tuple[Optional[str], ...]
+    extraction_fn: Optional[object] = None
 
     def to_json(self):
-        return {"type": "in", "dimension": self.dimension, "values": list(self.values)}
+        return _with_exfn({"type": "in", "dimension": self.dimension,
+                           "values": list(self.values)}, self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
 
     def optimize(self):
         if len(self.values) == 1:
-            return SelectorFilter(self.dimension, self.values[0])
+            return SelectorFilter(self.dimension, self.values[0],
+                                  self.extraction_fn)
         return self
 
 
@@ -83,11 +97,15 @@ class BoundFilter(DimFilter):
     lower_strict: bool = False
     upper_strict: bool = False
     ordering: str = "lexicographic"  # or "numeric"
+    extraction_fn: Optional[object] = None
 
     def to_json(self):
-        return {"type": "bound", "dimension": self.dimension, "lower": self.lower,
-                "upper": self.upper, "lowerStrict": self.lower_strict,
-                "upperStrict": self.upper_strict, "ordering": self.ordering}
+        return _with_exfn(
+            {"type": "bound", "dimension": self.dimension,
+             "lower": self.lower, "upper": self.upper,
+             "lowerStrict": self.lower_strict,
+             "upperStrict": self.upper_strict,
+             "ordering": self.ordering}, self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
@@ -99,6 +117,7 @@ class LikeFilter(DimFilter):
     dimension: str
     pattern: str
     escape: Optional[str] = None
+    extraction_fn: Optional[object] = None
 
     def regex(self) -> str:
         out, i = [], 0
@@ -118,8 +137,9 @@ class LikeFilter(DimFilter):
         return "^" + "".join(out) + "$"
 
     def to_json(self):
-        return {"type": "like", "dimension": self.dimension,
-                "pattern": self.pattern, "escape": self.escape}
+        return _with_exfn({"type": "like", "dimension": self.dimension,
+                           "pattern": self.pattern, "escape": self.escape},
+                          self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
@@ -129,9 +149,11 @@ class LikeFilter(DimFilter):
 class RegexFilter(DimFilter):
     dimension: str
     pattern: str
+    extraction_fn: Optional[object] = None
 
     def to_json(self):
-        return {"type": "regex", "dimension": self.dimension, "pattern": self.pattern}
+        return _with_exfn({"type": "regex", "dimension": self.dimension,
+                           "pattern": self.pattern}, self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
@@ -144,11 +166,14 @@ class SearchFilter(DimFilter):
     dimension: str
     value: str
     case_sensitive: bool = False
+    extraction_fn: Optional[object] = None
 
     def to_json(self):
-        return {"type": "search", "dimension": self.dimension,
-                "query": {"type": "contains", "value": self.value,
-                          "caseSensitive": self.case_sensitive}}
+        return _with_exfn(
+            {"type": "search", "dimension": self.dimension,
+             "query": {"type": "contains", "value": self.value,
+                       "caseSensitive": self.case_sensitive}},
+            self.extraction_fn)
 
     def required_columns(self):
         return {self.dimension}
@@ -444,22 +469,31 @@ def filter_from_json(j: Optional[dict]) -> Optional[DimFilter]:
     if t == "spatial":
         return SpatialFilter(j["dimension"],
                              SpatialBound.from_json(j["bound"]))
+    exfn = None
+    if j.get("extractionFn") is not None:
+        # lazy: extraction fns live in query.model, which imports this module
+        from druid_tpu.query.model import extractionfn_from_json
+        exfn = extractionfn_from_json(j["extractionFn"])
+        if t not in ("selector", "in", "bound", "like", "regex", "search"):
+            # silently dropping the fn would return wrong rows
+            raise ValueError(f"extractionFn unsupported on filter type {t!r}")
     if t == "selector":
-        return SelectorFilter(j["dimension"], j.get("value"))
+        return SelectorFilter(j["dimension"], j.get("value"), exfn)
     if t == "in":
-        return InFilter(j["dimension"], tuple(j["values"]))
+        return InFilter(j["dimension"], tuple(j["values"]), exfn)
     if t == "bound":
         return BoundFilter(j["dimension"], j.get("lower"), j.get("upper"),
                            j.get("lowerStrict", False), j.get("upperStrict", False),
-                           j.get("ordering", "lexicographic"))
+                           j.get("ordering", "lexicographic"), exfn)
     if t == "like":
-        return LikeFilter(j["dimension"], j["pattern"], j.get("escape"))
+        return LikeFilter(j["dimension"], j["pattern"], j.get("escape"),
+                          exfn)
     if t == "regex":
-        return RegexFilter(j["dimension"], j["pattern"])
+        return RegexFilter(j["dimension"], j["pattern"], exfn)
     if t == "search":
         q = j.get("query", {})
         return SearchFilter(j["dimension"], q.get("value", ""),
-                            q.get("caseSensitive", False))
+                            q.get("caseSensitive", False), exfn)
     if t == "interval":
         return IntervalFilter(j["dimension"],
                               tuple(normalize_intervals(j["intervals"])))

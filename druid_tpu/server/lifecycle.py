@@ -133,8 +133,8 @@ class QueryLifecycle:
         n = 0
         try:
             for batch in runner_stream(query):
-                n += 1        # batches, matching run()'s len(rows)
-                yield batch
+                n += 1    # top-level results (scan batches), like run()'s
+                yield batch   # len(rows) over the materialized batch list
             self._log(query, qid, (time.monotonic() - t0) * 1000, True,
                       n_rows=n)
             if self.on_result:

@@ -332,6 +332,77 @@ def _lit_str(e) -> str:
     return "" if e.value is None else str(e.value)
 
 
+def _extraction_of(e, table: str, schema: SqlSchema):
+    """String-function call tree over ONE column → (column name,
+    ExtractionFn), or None. Nested calls cascade (reference:
+    Expressions.toSimpleExtraction — UPPER/LOWER/SUBSTRING/TRIM/LEFT/
+    RIGHT/CHAR_LENGTH/REGEXP_EXTRACT/LOOKUP compose on a dimension)."""
+    from druid_tpu.query.model import (CascadeExtractionFn, ExtractionFn,
+                                       RegexExtractionFn, StrlenExtractionFn)
+
+    def inner(node):
+        if isinstance(node, P.Col):
+            if schema.type_of(table, node.name) != "string":
+                return None           # extraction reads string dims only
+            return node.name, ()
+        if not isinstance(node, P.Fn) or not node.args:
+            return None
+        base = inner(node.args[0])
+        if base is None:
+            return None
+        col, chain = base
+
+        def lit(i, default=None):
+            if len(node.args) > i and isinstance(node.args[i], P.Lit):
+                return node.args[i].value
+            return default
+
+        nm = node.name
+        if nm == "UPPER" and len(node.args) == 1:
+            return col, chain + (UpperExtractionFn(),)
+        if nm == "LOWER" and len(node.args) == 1:
+            return col, chain + (LowerExtractionFn(),)
+        if nm == "SUBSTRING" and len(node.args) >= 2:
+            start = lit(1)
+            if start is None:
+                return None
+            if len(node.args) > 2 and lit(2) is None:
+                return None    # non-literal length → expression path
+            return col, chain + (SubstringExtractionFn(
+                int(start) - 1,
+                None if len(node.args) < 3 else int(lit(2))),)
+        if nm == "LEFT" and len(node.args) == 2 and lit(1) is not None:
+            return col, chain + (SubstringExtractionFn(0, int(lit(1))),)
+        if nm == "RIGHT" and len(node.args) == 2 and lit(1) is not None:
+            n = int(lit(1))
+            return col, chain + (RegexExtractionFn(
+                f"(.{{0,{n}}})$", 1),)
+        if nm == "TRIM" and len(node.args) == 1:
+            return col, chain + (RegexExtractionFn(
+                r"^\s*(.*?)\s*$", 1),)
+        if nm in ("CHAR_LENGTH", "LENGTH", "STRLEN") \
+                and len(node.args) == 1:
+            return col, chain + (StrlenExtractionFn(),)
+        if nm == "REGEXP_EXTRACT" and len(node.args) >= 2 \
+                and lit(1) is not None:
+            if len(node.args) > 2 and lit(2) is None:
+                return None    # non-literal group index → expression path
+            return col, chain + (RegexExtractionFn(
+                str(lit(1)), int(lit(2, 0)),
+                replace_missing=True, replacement=None),)
+        if nm == "LOOKUP" and len(node.args) == 2 and lit(1) is not None:
+            return col, chain + (RegisteredLookupExtractionFn(str(lit(1))),)
+        return None
+
+    got = inner(e)
+    if got is None or not got[1]:
+        return None
+    col, chain = got
+    fn: ExtractionFn = chain[0] if len(chain) == 1 \
+        else CascadeExtractionFn(tuple(chain))
+    return col, fn
+
+
 def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
     """SQL boolean AST → DimFilter tree (reference: Expressions.toFilter)."""
     if isinstance(e, P.Bin) and e.op in ("AND", "OR"):
@@ -353,11 +424,22 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
             vals = tuple(_lit_str(v) for v in e.values)
             flt = F.InFilter(e.operand.name, vals)
             return F.NotFilter(flt) if e.negated else flt
+        ext = _extraction_of(e.operand, table, schema)
+        if ext is not None:
+            vals = tuple(_lit_str(v) for v in e.values)
+            flt = F.InFilter(ext[0], vals, extraction_fn=ext[1])
+            return F.NotFilter(flt) if e.negated else flt
         raise PlannerError("IN supported on columns only")
     if isinstance(e, P.LikeExpr):
-        if isinstance(e.operand, P.Col) and isinstance(e.pattern, P.Lit):
-            flt = F.LikeFilter(e.operand.name, str(e.pattern.value))
-            return F.NotFilter(flt) if e.negated else flt
+        if isinstance(e.pattern, P.Lit):
+            if isinstance(e.operand, P.Col):
+                flt = F.LikeFilter(e.operand.name, str(e.pattern.value))
+                return F.NotFilter(flt) if e.negated else flt
+            ext = _extraction_of(e.operand, table, schema)
+            if ext is not None:
+                flt = F.LikeFilter(ext[0], str(e.pattern.value),
+                                   extraction_fn=ext[1])
+                return F.NotFilter(flt) if e.negated else flt
         raise PlannerError("LIKE needs column and literal pattern")
     if isinstance(e, P.BetweenExpr):
         if isinstance(e.operand, P.Col):
@@ -403,6 +485,27 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
                 return F.BoundFilter(name, lower=v, ordering=ordering)
         if isinstance(l, P.Col) and isinstance(r, P.Col) and op == "=":
             return F.ColumnComparisonFilter((l.name, r.name))
+        if isinstance(r, P.Lit) and not isinstance(l, P.Col):
+            # string-function call over a dimension: filter through an
+            # extraction fn on the dictionary (Expressions.toSimpleExtraction)
+            ext = _extraction_of(l, table, schema)
+            if ext is not None:
+                name, fn = ext
+                v = _lit_str(r)
+                ordering = "numeric" if isinstance(r.value, (int, float)) \
+                    and not isinstance(r.value, bool) else "lexicographic"
+                if op == "=":
+                    return F.SelectorFilter(name, v, extraction_fn=fn)
+                if op == "<>":
+                    return F.NotFilter(
+                        F.SelectorFilter(name, v, extraction_fn=fn))
+                strict = op in ("<", ">")
+                if op in ("<", "<="):
+                    return F.BoundFilter(name, upper=v, upper_strict=strict,
+                                         ordering=ordering,
+                                         extraction_fn=fn)
+                return F.BoundFilter(name, lower=v, lower_strict=strict,
+                                     ordering=ordering, extraction_fn=fn)
         # fall through to expression filter
         return F.ExpressionFilter(_expr_str(e, table, schema))
     if isinstance(e, P.Lit) and e.type == "bool":
@@ -628,21 +731,17 @@ def _dimension_spec(e, alias: str, table: str, schema: SqlSchema,
         # numeric columns group through the engine's numeric dimension
         # handler (query-time value dictionary)
         return DefaultDimensionSpec(e.name, alias)
-    if isinstance(e, P.Fn) and e.name == "SUBSTRING" \
-            and isinstance(e.args[0], P.Col):
-        start = e.args[1].value - 1
-        length = e.args[2].value if len(e.args) > 2 else None
-        return ExtractionDimensionSpec(e.args[0].name, alias,
-                                       SubstringExtractionFn(start, length))
-    if isinstance(e, P.Fn) and e.name in ("UPPER", "LOWER") \
-            and isinstance(e.args[0], P.Col):
-        fn = UpperExtractionFn() if e.name == "UPPER" else LowerExtractionFn()
-        return ExtractionDimensionSpec(e.args[0].name, alias, fn)
     if isinstance(e, P.Fn) and e.name == "LOOKUP" \
             and isinstance(e.args[0], P.Col) and isinstance(e.args[1], P.Lit):
         return ExtractionDimensionSpec(
             e.args[0].name, alias,
             RegisteredLookupExtractionFn(str(e.args[1].value)))
+    ext = _extraction_of(e, table, schema)
+    if ext is not None:
+        # the whole string-fn family (SUBSTRING/UPPER/LOWER/TRIM/LEFT/
+        # RIGHT/CHAR_LENGTH/REGEXP_EXTRACT, nested) groups through one
+        # extraction dimension spec
+        return ExtractionDimensionSpec(ext[0], alias, ext[1])
     # anything translatable to an expression groups as a computed
     # dimension (EXTRACT, TIME_FLOOR, MOD, CASE, arithmetic, ...): the
     # engine host-evaluates it into a per-segment value dictionary

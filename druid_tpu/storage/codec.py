@@ -108,7 +108,7 @@ def _pick_encoding(arr: np.ndarray, encoding: str) -> int:
             or not np.issubdtype(arr.dtype, np.integer):
         return ENC_NONE
     if encoding == "table":
-        return ENC_TABLE if np.unique(arr).size <= 256 else ENC_NONE
+        return _pick_encoding_ex(arr, "table")[0]
     if encoding == "vsize":
         return _vsize_id(arr)
     if encoding == "auto" and not bool((arr[1:] >= arr[:-1]).all()):

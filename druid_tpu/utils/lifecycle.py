@@ -37,6 +37,8 @@ class Lifecycle:
         self._lock = threading.Lock()
         self._stop_event = threading.Event()
         self.running = False
+        self._in_start = False
+        self._stop_requested = False
 
     def add(self, obj=None, *, start: Optional[Callable] = None,
             stop: Optional[Callable] = None, stage: Stage = Stage.NORMAL,
@@ -63,26 +65,51 @@ class Lifecycle:
             if self.running:
                 return self
             self.running = True
+            self._in_start = True
+            self._stop_requested = False
             # restart after stop(): join() must block again
             self._stop_event.clear()
-        for h in sorted(self._handlers, key=lambda h: (h[0], h[1])):
-            stage, _, label, start_fn, _ = h
-            try:
-                if start_fn is not None:
-                    start_fn()
-                self._started.append(h)
-            except BaseException:
-                log.exception("start failed at %s (stage %s); unwinding",
-                              label, stage.name)
-                self._unwind()
+        aborted = False
+        try:
+            for h in sorted(self._handlers, key=lambda h: (h[0], h[1])):
                 with self._lock:
-                    self.running = False
-                raise
+                    if self._stop_requested:
+                        aborted = True
+                        break
+                stage, _, label, start_fn, _ = h
+                try:
+                    if start_fn is not None:
+                        start_fn()
+                except BaseException:
+                    log.exception("start failed at %s (stage %s); unwinding",
+                                  label, stage.name)
+                    self._unwind()
+                    with self._lock:
+                        self.running = False
+                    raise
+                with self._lock:
+                    self._started.append(h)
+        finally:
+            with self._lock:
+                self._in_start = False
+                aborted = aborted or self._stop_requested
+        if aborted:
+            # a concurrent stop() arrived mid-start: this thread owns the
+            # unwind so no just-started handler can leak
+            self._unwind()
+            with self._lock:
+                self.running = False
+            self._stop_event.set()
         return self
 
     def stop(self) -> None:
         with self._lock:
             if not self.running:
+                return
+            self._stop_requested = True
+            if self._in_start:
+                # the starting thread sees the flag and unwinds everything
+                # it started — stopping here would race its handler loop
                 return
             self.running = False
         self._unwind()

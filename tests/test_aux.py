@@ -424,3 +424,34 @@ def test_lifecycle_join_blocks_again_after_restart():
     assert not lc.join(timeout=0.05)     # must block: not stopped yet
     lc.stop()
     assert lc.join(timeout=0.05)
+
+
+def test_lifecycle_stop_during_start_leaks_nothing():
+    """A stop() racing start() must not leave later-stage handlers running
+    forever (the starting thread owns the unwind)."""
+    import threading
+    import time as _time
+    from druid_tpu.utils.lifecycle import Lifecycle, Stage
+    events = []
+    gate = threading.Event()
+
+    def slow_start():
+        events.append("+slow")
+        gate.set()
+        _time.sleep(0.15)
+
+    lc = Lifecycle()
+    lc.add(start=slow_start, stop=lambda: events.append("-slow"),
+           stage=Stage.INIT)
+    lc.add(start=lambda: events.append("+http"),
+           stop=lambda: events.append("-http"), stage=Stage.SERVER)
+    t = threading.Thread(target=lc.start)
+    t.start()
+    gate.wait(2.0)
+    lc.stop()               # arrives while slow_start is still running
+    t.join(5.0)
+    assert not lc.running
+    # everything that started was stopped; nothing leaked
+    started = {e[1:] for e in events if e.startswith("+")}
+    stopped = {e[1:] for e in events if e.startswith("-")}
+    assert started == stopped

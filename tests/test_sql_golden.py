@@ -255,3 +255,82 @@ def test_information_schema_tables(sql):
     cols, rows = sql.execute(
         "SELECT TABLE_NAME FROM INFORMATION_SCHEMA.TABLES")
     assert ["foo"] in rows
+
+
+# ---------------------------------------------------------------------------
+# String-function extraction filters + dims (Expressions.toSimpleExtraction)
+# ---------------------------------------------------------------------------
+
+def test_string_fn_filters(sql):
+    cases = [
+        ("SELECT COUNT(*) FROM foo WHERE UPPER(dim1) = 'A'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE LOWER(dim2) = 'x'", 3),
+        ("SELECT COUNT(*) FROM foo WHERE SUBSTRING(dim1, 1, 1) = 'b'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE CHAR_LENGTH(dim1) >= 1", 6),
+        ("SELECT COUNT(*) FROM foo WHERE CHAR_LENGTH(dim1) > 1", 0),
+        ("SELECT COUNT(*) FROM foo WHERE "
+         "REGEXP_EXTRACT(dim1, '(a|c)', 1) = 'c'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE "
+         "UPPER(SUBSTRING(dim1, 1, 1)) LIKE 'A%'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE LEFT(dim1, 1) = 'c'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE RIGHT(dim2, 1) = 'y'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE TRIM(dim1) = 'a'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE UPPER(dim1) <> 'A'", 4),
+        ("SELECT COUNT(*) FROM foo WHERE UPPER(dim1) IN ('A', 'C')", 4),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows, want)
+
+
+def test_string_fn_group_by(sql):
+    cols, rows = sql.execute(
+        "SELECT UPPER(dim1) u, COUNT(*) n, SUM(l1) s FROM foo "
+        "GROUP BY UPPER(dim1) ORDER BY u")
+    assert rows == [["A", 2, 7], ["B", 2, 325332], ["C", 2, 13]]
+
+
+def test_string_fn_wire_roundtrip(sql):
+    """The planned extraction filter survives JSON serde (native wire)."""
+    from druid_tpu.query.model import query_from_json
+    plan = sql.explain("SELECT COUNT(*) FROM foo WHERE UPPER(dim1) = 'A'")
+    assert plan["filter"]["extractionFn"]["type"] == "upper"
+    q = query_from_json(plan)
+    assert q.filter.extraction_fn is not None
+
+
+def test_non_literal_extraction_args_rejected_cleanly(sql):
+    """SUBSTRING with a non-literal length must not silently plan a
+    substring-to-end extraction — it errors cleanly instead of returning
+    wrong rows (the numeric expression language cannot host it either)."""
+    from druid_tpu.sql import PlannerError
+    with pytest.raises(PlannerError, match="not translatable"):
+        sql.execute("SELECT COUNT(*) FROM foo WHERE "
+                    "SUBSTRING(dim1, 1, CHAR_LENGTH(dim2)) = 'a'")
+
+
+def test_extractionfn_on_unsupported_filter_type_rejected(sql):
+    from druid_tpu.query.filters import filter_from_json
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unsupported"):
+        filter_from_json({"type": "columnComparison",
+                          "dimensions": ["a", "b"],
+                          "extractionFn": {"type": "upper"}})
+
+
+def test_regex_search_filters_carry_extraction(sql):
+    """regex/search filters consume extractionFn instead of dropping it."""
+    from druid_tpu.query.filters import filter_from_json
+    f = filter_from_json({"type": "regex", "dimension": "dim1",
+                          "pattern": "^A", "extractionFn": {"type": "upper"}})
+    assert f.extraction_fn is not None
+    # end to end: ^A on UPPER(dim1) matches the two 'a' rows
+    from druid_tpu.query.model import query_from_json
+    native = {"queryType": "timeseries", "dataSource": "foo",
+              "intervals": ["2026-02-01/2026-02-08"], "granularity": "all",
+              "filter": {"type": "regex", "dimension": "dim1",
+                         "pattern": "^A",
+                         "extractionFn": {"type": "upper"}},
+              "aggregations": [{"type": "count", "name": "n"}]}
+    rows = sql.qe.run(query_from_json(native))
+    assert rows[0]["result"]["n"] == 2
