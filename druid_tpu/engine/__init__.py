@@ -15,10 +15,29 @@ jax.config.update("jax_enable_x64", True)
 # cold compile across PROCESSES (the reference's warm JVM + code cache have
 # no cold-start; this is our equivalent). Opt out with
 # DRUID_TPU_COMPILE_CACHE=0; override the directory by setting it to a path.
+def _host_fingerprint() -> str:
+    """CPU-feature fingerprint: a shared home directory must not feed one
+    machine AOT executables compiled for another's instruction set (XLA
+    loads mismatched CPU AOT results with only a warning — SIGILL risk)."""
+    import hashlib
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 lists ISA extensions under "flags", aarch64 under
+                # "Features" — either distinguishes incompatible hosts
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+    ident = f"{platform.machine()}-{platform.processor()}"
+    return hashlib.sha1(ident.encode()).hexdigest()[:12]
+
+
 _cc = os.environ.get("DRUID_TPU_COMPILE_CACHE", "")
 if _cc != "0":
     cache_dir = _cc if _cc not in ("", "1") else os.path.expanduser(
-        "~/.cache/druid_tpu/xla")
+        f"~/.cache/druid_tpu/xla-{_host_fingerprint()}")
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -556,6 +557,13 @@ def windowed_window(segment: Segment, intervals: Sequence[Interval],
     return 0
 
 
+#: measurement override (tools/chip_suite.py; env DRUID_TPU_STRATEGY):
+#: force an ELIGIBLE strategy so cutovers are tuned from measured
+#: per-backend numbers, not assumptions. Ineligible forces fall through
+#: to normal selection.
+FORCE_STRATEGY: Optional[str] = os.environ.get("DRUID_TPU_STRATEGY") or None
+
+
 def select_strategy(spec: GroupSpec, kernels: Sequence[AggKernel],
                     col_dtypes: Dict, padded_rows: int,
                     windowed_w) -> Tuple[str, int]:
@@ -569,6 +577,22 @@ def select_strategy(spec: GroupSpec, kernels: Sequence[AggKernel],
     plans = [k.mm_plan(col_dtypes, padded_rows) for k in kernels]
     mm_ok = all(p is not None for p in plans)
     blocked_ok = all(k.blocked_supported(col_dtypes) for k in kernels)
+    if FORCE_STRATEGY:
+        f = FORCE_STRATEGY
+        if f == "mixed":
+            return "mixed", 0
+        if f == "mm" and mm_ok and num <= MM_GROUP_LIMIT:
+            return "mm", 0
+        if f == "blocked" and blocked_ok and num <= BLOCKED_GROUP_LIMIT:
+            # beyond the limit fuse_filter_update would silently scatter —
+            # mislabeled timings are worse than a fallthrough
+            return "blocked", 0
+        if f == "windowed" and blocked_ok:
+            w = windowed_w() if callable(windowed_w) else windowed_w
+            if w:
+                return "windowed", w
+        if f == "projection" and blocked_ok:
+            return "projection", 0
     if blocked_ok and num <= 64:
         return "blocked", 0      # near-streaming; scan step scales with 1/G
     if mm_ok and num <= 2048:

@@ -327,3 +327,43 @@ def test_mm_double_sum_falls_back(monkeypatch):
     monkeypatch.setattr(grouping, "select_strategy", spy)
     _run(segments, aggs, ["dimB"])
     assert seen and all(s != "mm" for s in seen)
+
+
+def test_force_strategy_override_equivalence(segments, monkeypatch):
+    """DRUID_TPU_STRATEGY / grouping.FORCE_STRATEGY forces an eligible
+    strategy (the chip-suite measurement hook); results stay identical."""
+    from druid_tpu.engine import QueryExecutor, grouping
+    from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+    from druid_tpu.utils.intervals import Interval
+    iv = Interval.of("2026-01-01", "2026-01-08")
+    q = GroupByQuery.of(
+        "test", [iv],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("n"), LongSumAggregator("s", "metLong")],
+        granularity="all")
+    base = QueryExecutor(segments).run(q)
+    key = lambda rows: {(r["event"]["dimA"], r["event"]["dimB"]):
+                        (r["event"]["n"], r["event"]["s"]) for r in rows}
+    want = key(base)
+    real_select = grouping.select_strategy
+    chosen = []
+
+    def spy(*a, **kw):
+        out = real_select(*a, **kw)
+        chosen.append(out[0])
+        return out
+
+    monkeypatch.setattr(grouping, "select_strategy", spy)
+    # mixed/projection are always eligible; windowed may legitimately fall
+    # through when the span check refuses (results must still match)
+    for strat, strict in (("mixed", True), ("projection", True),
+                          ("windowed", False)):
+        chosen.clear()
+        monkeypatch.setattr(grouping, "FORCE_STRATEGY", strat)
+        got = key(QueryExecutor(segments).run(q))
+        assert got == want, f"strategy {strat} diverged"
+        assert chosen
+        if strict:
+            # the force must actually select it, not fall through
+            assert all(c == strat for c in chosen), (strat, chosen)

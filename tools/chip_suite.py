@@ -1,0 +1,189 @@
+"""One-command on-chip validation ladder. Run on the real TPU:
+
+    python tools/chip_suite.py [--rows N] [--skip-bench]
+
+Stages (each gates the next):
+  1. sanity     — devices visible, tiny matmul executes
+  2. pallas     — the fused groupBy kernel compiles and matches the
+                  mixed-strategy result exactly (chip_pallas_test inline)
+  3. strategies — per-strategy timings on the headline shape so
+                  select_strategy cutovers are measured, not assumed
+  4. bench      — the full headline bench (same config the driver runs)
+
+Exit code 0 only when every requested stage passes. This supersedes the
+one-off microbench scripts; `profile_headline.py` remains for per-phase
+profiling.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, flush=True)
+
+
+def stage_sanity() -> bool:
+    import jax
+    import jax.numpy as jnp
+    t0 = time.time()
+    devs = jax.devices()
+    log(f"[sanity] devices={devs} ({time.time() - t0:.1f}s)")
+    t0 = time.time()
+    y = jnp.ones((512, 512)) @ jnp.ones((512, 512))
+    ok = float(np.asarray(y)[0, 0]) == 512.0
+    log(f"[sanity] matmul {'ok' if ok else 'WRONG'} "
+        f"({time.time() - t0:.1f}s)")
+    return ok
+
+
+def _headline_segments(rows: int, n_segments: int = 1):
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.utils.intervals import Interval
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=100,
+                   distribution="uniform"),
+        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
+        ColumnSpec("metLong", "long", low=0, high=10_000),
+        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
+                   std=25.0),
+    )
+    iv = Interval.of("2026-01-01", "2026-01-02")
+    gen = DataGenerator(schema, seed=1234)
+    return gen.segments(n_segments, rows // n_segments, iv,
+                        datasource="bench"), iv
+
+
+def _headline_query(iv):
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             FloatMaxAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import BoundFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+    return GroupByQuery.of(
+        "bench", [iv],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
+         FloatMaxAggregator("fmax", "metFloat")],
+        granularity="all",
+        filter=BoundFilter("metLong", lower=100, upper=9_900,
+                           ordering="numeric"))
+
+
+def stage_pallas(rows: int) -> bool:
+    """Fused pallas kernel vs mixed strategy: exact result parity."""
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.engine import grouping, pallas_agg
+    if not pallas_agg.backend_ok():
+        log("[pallas] backend not available (non-TPU or gated off) — skip")
+        return True
+    segs, iv = _headline_segments(rows)
+    q = _headline_query(iv)
+
+    def run_with(strategy_env):
+        os.environ.pop("DRUID_TPU_PALLAS", None)
+        if strategy_env is not None:
+            os.environ["DRUID_TPU_PALLAS"] = strategy_env
+        ex = QueryExecutor(segs)
+        t0 = time.time()
+        out = ex.run(q)
+        warm = time.time() - t0
+        t0 = time.time()
+        out = ex.run(q)
+        log(f"[pallas] {strategy_env or 'default'}: {len(out)} groups "
+            f"(warm {warm:.1f}s, hot {time.time() - t0:.3f}s)")
+        return {(r['event']['dimA'], r['event']['dimB']):
+                (r['event']['rows'], r['event']['lsum'],
+                 round(r['event']['fmax'], 3)) for r in out}
+
+    got = run_with(None)            # pallas eligible
+    want = run_with("0")            # XLA strategies only
+    os.environ.pop("DRUID_TPU_PALLAS", None)
+    if got != want:
+        diff = sum(1 for k in want if got.get(k) != want[k])
+        log(f"[pallas] MISMATCH: {diff} differing groups of {len(want)}")
+        return False
+    log(f"[pallas] exact match over {len(want)} groups")
+    return True
+
+
+def stage_strategies(rows: int) -> bool:
+    """Time each eligible groupBy strategy on the headline shape."""
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.engine import grouping
+    segs, iv = _headline_segments(rows)
+    q = _headline_query(iv)
+    timings = {}
+    forced = getattr(grouping, "FORCE_STRATEGY", None)
+    for strat in ("mixed", "windowed", "projection"):
+        try:
+            grouping.FORCE_STRATEGY = strat
+            ex = QueryExecutor(segs)
+            ex.run(q)                      # warm
+            ts = []
+            for _ in range(3):
+                t0 = time.time()
+                ex.run(q)
+                ts.append(time.time() - t0)
+            timings[strat] = min(ts)
+            log(f"[strategies] {strat}: {min(ts) * 1e3:.0f}ms "
+                f"({rows / min(ts) / 1e6:.0f}M rows/s)")
+        except Exception as e:
+            log(f"[strategies] {strat}: failed — {type(e).__name__}: "
+                f"{str(e)[:120]}")
+        finally:
+            grouping.FORCE_STRATEGY = forced
+    if timings:
+        best = min(timings, key=timings.get)
+        log(f"[strategies] best: {best} ({timings[best] * 1e3:.0f}ms)")
+    return bool(timings)
+
+
+def stage_bench() -> bool:
+    env = dict(os.environ)
+    t0 = time.time()
+    p = subprocess.run([sys.executable, "bench.py"], cwd=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), env=env,
+        capture_output=True, text=True, timeout=3600)
+    log(f"[bench] rc={p.returncode} ({time.time() - t0:.0f}s)")
+    for line in p.stderr.splitlines()[-6:]:
+        log(f"[bench]   {line}")
+    if p.returncode != 0:
+        return False
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    log(f"[bench] {out}")
+    floor = 49_054_911          # BENCH_r03 — never regress below this
+    if out["value"] < floor:
+        log(f"[bench] REGRESSION: {out['value']:,.0f} < {floor:,}")
+        return False
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=12_500_000)
+    ap.add_argument("--skip-bench", action="store_true")
+    args = ap.parse_args()
+    for name, fn in [("sanity", stage_sanity),
+                     ("pallas", lambda: stage_pallas(args.rows)),
+                     ("strategies", lambda: stage_strategies(args.rows)),
+                     ("bench", None if args.skip_bench else stage_bench)]:
+        if fn is None:
+            log(f"[{name}] skipped")
+            continue
+        if not fn():
+            log(f"FAILED at stage {name}")
+            return 1
+    log("ALL STAGES PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
