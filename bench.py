@@ -25,6 +25,64 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# -- headline configuration, shared with tools/chip_suite.py and
+#    tools/chip_pallas_test.py so tuning/validation and the gate measure
+#    the SAME shape -------------------------------------------------------
+
+HEADLINE_SEED = 1234
+
+
+def headline_interval():
+    from druid_tpu.utils.intervals import Interval
+    return Interval.of("2026-01-01", "2026-01-02")
+
+
+def headline_segments(rows: int, n_segments: int):
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=100, distribution="uniform"),
+        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
+        ColumnSpec("metLong", "long", low=0, high=10_000),
+        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
+                   std=25.0),
+    )
+    gen = DataGenerator(schema, seed=HEADLINE_SEED)
+    return gen.segments(n_segments, rows // n_segments, headline_interval(),
+                        datasource="bench")
+
+
+def headline_groupby():
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             FloatMaxAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import BoundFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+    return GroupByQuery.of(
+        "bench", [headline_interval()],
+        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
+         FloatMaxAggregator("fmax", "metFloat")],
+        granularity="all",
+        filter=BoundFilter("metLong", lower=100, upper=9_900,
+                           ordering="numeric"))
+
+
+def headline_topn(segments):
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import InFilter
+    from druid_tpu.query.model import TopNQuery
+    # filter on REAL dictionary values (half of dimA) — a padded-format
+    # mismatch here would silently benchmark an empty-result query
+    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
+    assert len(dimA_vals) >= 100, "unexpected dimA cardinality"
+    return TopNQuery.of(
+        "bench", [headline_interval()], "dimB", "lsum", 100,
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
+        granularity="all",
+        filter=InFilter("dimA", dimA_vals[0:100:2]))
+
+
 def main():
     rows = int(os.environ.get("DRUID_TPU_BENCH_ROWS", 100_000_000))
     n_segments = int(os.environ.get("DRUID_TPU_BENCH_SEGMENTS", 8))
@@ -33,51 +91,17 @@ def main():
     import jax
     log(f"devices: {jax.devices()}")
 
-    from druid_tpu.data.generator import ColumnSpec, DataGenerator
     from druid_tpu.engine import QueryExecutor
     from druid_tpu.parallel import make_mesh
-    from druid_tpu.query.aggregators import (CountAggregator,
-                                             FloatMaxAggregator,
-                                             LongSumAggregator)
-    from druid_tpu.query.filters import BoundFilter, InFilter
-    from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
-                                       TopNQuery)
-    from druid_tpu.utils.intervals import Interval
-
-    schema = (
-        ColumnSpec("dimA", "string", cardinality=100, distribution="uniform"),
-        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
-        ColumnSpec("metLong", "long", low=0, high=10_000),
-        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
-                   std=25.0),
-    )
-    interval = Interval.of("2026-01-01", "2026-01-02")
 
     t0 = time.time()
-    gen = DataGenerator(schema, seed=1234)
-    segments = gen.segments(n_segments, rows // n_segments, interval,
-                            datasource="bench")
+    segments = headline_segments(rows, n_segments)
     total_rows = sum(s.n_rows for s in segments)
     log(f"generated {total_rows:,} rows in {n_segments} segments "
         f"({time.time() - t0:.1f}s)")
 
-    groupby = GroupByQuery.of(
-        "bench", [interval],
-        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
-        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
-         FloatMaxAggregator("fmax", "metFloat")],
-        granularity="all",
-        filter=BoundFilter("metLong", lower=100, upper=9_900,
-                           ordering="numeric"))
-    # filter on REAL dictionary values (half of dimA) — a padded-format
-    # mismatch here would silently benchmark an empty-result query
-    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
-    assert len(dimA_vals) >= 100, "unexpected dimA cardinality"
-    topn = TopNQuery.of(
-        "bench", [interval], "dimB", "lsum", 100,
-        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
-        granularity="all",
-        filter=InFilter("dimA", dimA_vals[0:100:2]))
+    groupby = headline_groupby()
+    topn = headline_topn(segments)
 
     executor = QueryExecutor(segments, mesh=make_mesh(1))
 

@@ -7,8 +7,23 @@ Reference analog: Druid's distribution layer — the broker scatter-gather
 over a jax.sharding.Mesh axis; per-segment partial aggregation states live in
 HBM and merge with XLA collectives (psum/pmin/pmax/all_gather) over ICI
 instead of shipping intermediate bytes over HTTP.
-"""
-from druid_tpu.parallel.context import (get_mesh, make_mesh, set_mesh,
-                                        use_mesh)
 
-__all__ = ["get_mesh", "make_mesh", "set_mesh", "use_mesh"]
+Scaling axes, explicitly:
+  * within a host/pod (ICI): the stacked sharded program — segments on the
+    mesh axis, partials combined with collectives (distributed.py);
+  * across hosts (DCN): the broker scatter over remote data nodes
+    (cluster/broker.py + cluster/dataserver.py binary wire) — exactly the
+    reference's host-level model, with each node running its own mesh.
+    Segments are immutable and partials are tiny, so host-level scatter
+    composes with chip-level collectives without a global mesh.
+  * a jax-level multi-host mesh (initialize_multihost + make_mesh spanning
+    processes) is available for pod-slice deployments; the stacked program
+    requires process-addressable shards, so on a cross-process mesh it
+    falls back to per-segment execution and the broker layer carries the
+    cross-host combine (try_sharded guards this explicitly).
+"""
+from druid_tpu.parallel.context import (get_mesh, initialize_multihost,
+                                        make_mesh, set_mesh, use_mesh)
+
+__all__ = ["get_mesh", "initialize_multihost", "make_mesh", "set_mesh",
+           "use_mesh"]

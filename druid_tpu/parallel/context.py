@@ -19,8 +19,37 @@ SEGMENT_AXIS = "seg"
 _state = threading.local()
 
 
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join a multi-host jax.distributed job (pod-slice deployments where
+    one logical data node spans several hosts). After this, jax.devices()
+    lists EVERY host's chips and make_mesh() builds a global mesh whose
+    psum/pmax collectives ride ICI within a pod and DCN across pods —
+    the role NCCL/MPI play for the reference's distribution layer.
+    With no arguments, jax reads JAX_COORDINATOR_ADDRESS from the
+    environment and auto-detects process count/id on recognized clusters
+    (TPU pod metadata, SLURM, OMPI); elsewhere pass num_processes and
+    process_id explicitly. Returns the process count. Idempotent."""
+    import jax
+    if getattr(initialize_multihost, "_done", False):
+        return jax.process_count()
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    initialize_multihost._done = True
+    return jax.process_count()
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = SEGMENT_AXIS):
-    """1-D mesh over the first `n_devices` local devices (all by default)."""
+    """1-D mesh over the first `n_devices` devices (all by default). After
+    initialize_multihost() the device list is global, so the mesh spans
+    every process's chips."""
     import jax
     from jax.sharding import Mesh
     devices = jax.devices()

@@ -82,6 +82,12 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     mesh = context.get_mesh()
     if mesh is None or not segments:
         return None
+    import jax
+    if any(d.process_index != jax.process_index()
+           for d in mesh.devices.flat):
+        # cross-process mesh: the stacked program would need every shard's
+        # data process-addressable; host-level combine is the broker's job
+        return None
     axis = mesh.axis_names[0]
     n_dev = mesh.shape[axis]
 

@@ -14,36 +14,13 @@ def main():
     import jax
     print(f"devices: {jax.devices()}", flush=True)
 
-    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    import bench
     from druid_tpu.engine import QueryExecutor, grouping
-    from druid_tpu.query.aggregators import (CountAggregator,
-                                             FloatMaxAggregator,
-                                             LongSumAggregator)
-    from druid_tpu.query.filters import BoundFilter
-    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
-    from druid_tpu.utils.intervals import Interval
 
-    schema = (
-        ColumnSpec("dimA", "string", cardinality=100, distribution="uniform"),
-        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
-        ColumnSpec("metLong", "long", low=0, high=10_000),
-        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
-                   std=25.0),
-    )
-    interval = Interval.of("2026-01-01", "2026-01-02")
-    gen = DataGenerator(schema, seed=1234)
     t0 = time.time()
-    segments = gen.segments(1, ROWS, interval, datasource="bench")
+    segments = bench.headline_segments(ROWS, 1)   # the gated headline shape
     print(f"gen {time.time()-t0:.1f}s", flush=True)
-
-    q = GroupByQuery.of(
-        "bench", [interval],
-        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
-        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
-         FloatMaxAggregator("fmax", "metFloat")],
-        granularity="all",
-        filter=BoundFilter("metLong", lower=100, upper=9_900,
-                           ordering="numeric"))
+    q = bench.headline_groupby()
 
     picks = []
     orig = grouping.select_strategy

@@ -44,48 +44,43 @@ def stage_sanity() -> bool:
     return ok
 
 
-def _headline_segments(rows: int, n_segments: int = 1):
-    from druid_tpu.data.generator import ColumnSpec, DataGenerator
-    from druid_tpu.utils.intervals import Interval
-    schema = (
-        ColumnSpec("dimA", "string", cardinality=100,
-                   distribution="uniform"),
-        ColumnSpec("dimB", "string", cardinality=1000, distribution="zipf"),
-        ColumnSpec("metLong", "long", low=0, high=10_000),
-        ColumnSpec("metFloat", "float", distribution="normal", mean=100.0,
-                   std=25.0),
-    )
-    iv = Interval.of("2026-01-01", "2026-01-02")
-    gen = DataGenerator(schema, seed=1234)
-    return gen.segments(n_segments, rows // n_segments, iv,
-                        datasource="bench"), iv
+def _headline(rows: int, n_segments: int = 1):
+    """The EXACT shape bench.py gates on (shared helpers in bench.py)."""
+    import bench
+    return bench.headline_segments(rows, n_segments), bench.headline_groupby()
 
 
-def _headline_query(iv):
-    from druid_tpu.query.aggregators import (CountAggregator,
-                                             FloatMaxAggregator,
-                                             LongSumAggregator)
-    from druid_tpu.query.filters import BoundFilter
-    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
-    return GroupByQuery.of(
-        "bench", [iv],
-        [DefaultDimensionSpec("dimA"), DefaultDimensionSpec("dimB")],
-        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong"),
-         FloatMaxAggregator("fmax", "metFloat")],
-        granularity="all",
-        filter=BoundFilter("metLong", lower=100, upper=9_900,
-                           ordering="numeric"))
+class _spied_selection:
+    """Record which strategy select_strategy actually returns — a forced
+    strategy that falls through must not have its timing mislabeled."""
+
+    def __enter__(self):
+        from druid_tpu.engine import grouping
+        self.grouping = grouping
+        self.real = grouping.select_strategy
+        self.chosen = []
+
+        def spy(*a, **kw):
+            out = self.real(*a, **kw)
+            self.chosen.append(out[0])
+            return out
+
+        grouping.select_strategy = spy
+        return self
+
+    def __exit__(self, *exc):
+        self.grouping.select_strategy = self.real
 
 
 def stage_pallas(rows: int) -> bool:
     """Fused pallas kernel vs mixed strategy: exact result parity."""
     from druid_tpu.engine import QueryExecutor
-    from druid_tpu.engine import grouping, pallas_agg
+    from druid_tpu.engine import pallas_agg
     if not pallas_agg.backend_ok():
         log("[pallas] backend not available (non-TPU or gated off) — skip")
         return True
-    segs, iv = _headline_segments(rows)
-    q = _headline_query(iv)
+    segs, q = _headline(rows)
+    saved = os.environ.get("DRUID_TPU_PALLAS")
 
     def run_with(strategy_env):
         os.environ.pop("DRUID_TPU_PALLAS", None)
@@ -103,9 +98,15 @@ def stage_pallas(rows: int) -> bool:
                 (r['event']['rows'], r['event']['lsum'],
                  round(r['event']['fmax'], 3)) for r in out}
 
-    got = run_with(None)            # pallas eligible
-    want = run_with("0")            # XLA strategies only
-    os.environ.pop("DRUID_TPU_PALLAS", None)
+    try:
+        got = run_with(None)            # pallas eligible
+        want = run_with("0")            # XLA strategies only
+    finally:
+        # restore the operator's setting for the later stages
+        if saved is None:
+            os.environ.pop("DRUID_TPU_PALLAS", None)
+        else:
+            os.environ["DRUID_TPU_PALLAS"] = saved
     if got != want:
         diff = sum(1 for k in want if got.get(k) != want[k])
         log(f"[pallas] MISMATCH: {diff} differing groups of {len(want)}")
@@ -115,25 +116,29 @@ def stage_pallas(rows: int) -> bool:
 
 
 def stage_strategies(rows: int) -> bool:
-    """Time each eligible groupBy strategy on the headline shape."""
+    """Time each eligible groupBy strategy on the headline shape; a forced
+    strategy that falls through is reported under what actually ran."""
     from druid_tpu.engine import QueryExecutor
     from druid_tpu.engine import grouping
-    segs, iv = _headline_segments(rows)
-    q = _headline_query(iv)
+    segs, q = _headline(rows)
     timings = {}
-    forced = getattr(grouping, "FORCE_STRATEGY", None)
+    forced = grouping.FORCE_STRATEGY
     for strat in ("mixed", "windowed", "projection"):
         try:
             grouping.FORCE_STRATEGY = strat
-            ex = QueryExecutor(segs)
-            ex.run(q)                      # warm
-            ts = []
-            for _ in range(3):
-                t0 = time.time()
-                ex.run(q)
-                ts.append(time.time() - t0)
-            timings[strat] = min(ts)
-            log(f"[strategies] {strat}: {min(ts) * 1e3:.0f}ms "
+            with _spied_selection() as sel:
+                ex = QueryExecutor(segs)
+                ex.run(q)                      # warm
+                ts = []
+                for _ in range(3):
+                    t0 = time.time()
+                    ex.run(q)
+                    ts.append(time.time() - t0)
+            actual = sel.chosen[-1] if sel.chosen else strat
+            label = strat if actual == strat \
+                else f"{strat}->fell-through-to-{actual}"
+            timings[label] = min(ts)
+            log(f"[strategies] {label}: {min(ts) * 1e3:.0f}ms "
                 f"({rows / min(ts) / 1e6:.0f}M rows/s)")
         except Exception as e:
             log(f"[strategies] {strat}: failed — {type(e).__name__}: "
@@ -147,21 +152,25 @@ def stage_strategies(rows: int) -> bool:
 
 
 def stage_bench() -> bool:
-    env = dict(os.environ)
     t0 = time.time()
     p = subprocess.run([sys.executable, "bench.py"], cwd=os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), env=env,
+        os.path.dirname(os.path.abspath(__file__))), env=dict(os.environ),
         capture_output=True, text=True, timeout=3600)
     log(f"[bench] rc={p.returncode} ({time.time() - t0:.0f}s)")
     for line in p.stderr.splitlines()[-6:]:
         log(f"[bench]   {line}")
     if p.returncode != 0:
         return False
-    out = json.loads(p.stdout.strip().splitlines()[-1])
+    try:
+        out = json.loads(p.stdout.strip().splitlines()[-1])
+        value = float(out["value"])
+    except (IndexError, ValueError, KeyError, TypeError) as e:
+        log(f"[bench] UNPARSEABLE output ({e}): {p.stdout[-200:]!r}")
+        return False
     log(f"[bench] {out}")
     floor = 49_054_911          # BENCH_r03 — never regress below this
-    if out["value"] < floor:
-        log(f"[bench] REGRESSION: {out['value']:,.0f} < {floor:,}")
+    if value < floor:
+        log(f"[bench] REGRESSION: {value:,.0f} < {floor:,}")
         return False
     return True
 
