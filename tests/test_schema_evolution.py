@@ -1,0 +1,139 @@
+"""Schema evolution: queries spanning segments whose schemas differ
+(columns added/removed over time) must behave like the reference —
+missing dimensions group as null, missing metrics aggregate their
+identity, filters on absent columns match selector-null semantics
+(reference: processing/src/test/.../query/SchemaEvolutionTest.java).
+"""
+import numpy as np
+import pytest
+
+from druid_tpu.data.segment import SegmentBuilder, ValueType
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import (CountAggregator, LongSumAggregator)
+from druid_tpu.query.filters import BoundFilter, SelectorFilter
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   ScanQuery, TimeseriesQuery)
+from druid_tpu.utils.intervals import Interval, parse_ts
+
+IV = Interval.of("2026-03-01", "2026-03-03")
+T0 = parse_ts("2026-03-01")
+DAY = 86_400_000
+
+
+@pytest.fixture(scope="module")
+def evolving():
+    """Old segment: dims (page); metrics (hits). New segment adds a
+    `country` dim and a `bytes` metric."""
+    old = SegmentBuilder("evo", Interval(T0, T0 + DAY), version="v1")
+    old.add_columns(
+        [T0 + i * 1000 for i in range(6)],
+        dims={"page": ["a", "b", "a", "c", "b", "a"]},
+        metrics={"hits": np.asarray([1, 2, 3, 4, 5, 6], np.int64)},
+        metric_types={"hits": ValueType.LONG})
+    new = SegmentBuilder("evo", Interval(T0 + DAY, T0 + 2 * DAY),
+                         version="v1")
+    new.add_columns(
+        [T0 + DAY + i * 1000 for i in range(4)],
+        dims={"page": ["a", "d", "d", "b"],
+              "country": ["US", "DE", "US", "DE"]},
+        metrics={"hits": np.asarray([10, 20, 30, 40], np.int64),
+                 "bytes": np.asarray([7, 8, 9, 10], np.int64)},
+        metric_types={"hits": ValueType.LONG, "bytes": ValueType.LONG})
+    return [old.build(), new.build()]
+
+
+def test_sum_of_late_metric_counts_only_where_present(evolving):
+    rows = QueryExecutor(evolving).run(TimeseriesQuery.of(
+        "evo", [IV], [CountAggregator("n"),
+                      LongSumAggregator("b", "bytes"),
+                      LongSumAggregator("h", "hits")], granularity="all"))
+    r = rows[0]["result"]
+    assert r["n"] == 10
+    assert r["h"] == 21 + 100
+    assert r["b"] == 34          # identity (0) contribution from old
+
+
+def test_group_by_late_dimension_nulls_old_rows(evolving):
+    rows = QueryExecutor(evolving).run(GroupByQuery.of(
+        "evo", [IV], [DefaultDimensionSpec("country")],
+        [CountAggregator("n"), LongSumAggregator("h", "hits")],
+        granularity="all"))
+    got = {r["event"]["country"]: (r["event"]["n"], r["event"]["h"])
+           for r in rows}
+    assert got["US"] == (2, 40) and got["DE"] == (2, 60)
+    # the 6 old rows land in the null group
+    null_keys = [k for k in got if k in (None, "")]
+    assert len(null_keys) == 1
+    assert got[null_keys[0]] == (6, 21)
+
+
+def test_filter_on_late_dimension(evolving):
+    ex = QueryExecutor(evolving)
+    rows = ex.run(TimeseriesQuery.of(
+        "evo", [IV], [CountAggregator("n")], granularity="all",
+        filter=SelectorFilter("country", "US")))
+    assert rows[0]["result"]["n"] == 2
+    # selector null matches every old-segment row plus none of the new
+    rows = ex.run(TimeseriesQuery.of(
+        "evo", [IV], [CountAggregator("n")], granularity="all",
+        filter=SelectorFilter("country", None)))
+    assert rows[0]["result"]["n"] == 6
+
+
+def test_numeric_filter_on_late_metric(evolving):
+    rows = QueryExecutor(evolving).run(TimeseriesQuery.of(
+        "evo", [IV], [CountAggregator("n")], granularity="all",
+        filter=BoundFilter("bytes", lower="8", ordering="numeric")))
+    assert rows[0]["result"]["n"] == 3          # 8, 9, 10
+
+
+def test_scan_projects_missing_columns_as_null(evolving):
+    batches = QueryExecutor(evolving).run(ScanQuery.of(
+        "evo", [IV], columns=("page", "country", "bytes"),
+        order="ascending"))
+    events = [e for b in batches for e in b["events"]]
+    assert len(events) == 10
+    old_events = events[:6]
+    # pinned: missing columns project as null/absent, NEVER zero-fill
+    assert all(e.get("country") is None for e in old_events)
+    assert all(e.get("bytes") is None for e in old_events)
+    assert events[6]["country"] == "US"
+
+
+def test_group_by_dim_absent_from_every_queried_segment(evolving):
+    rows = QueryExecutor(evolving).run(GroupByQuery.of(
+        "evo", [Interval(T0, T0 + DAY)],
+        [DefaultDimensionSpec("country")], [CountAggregator("n")],
+        granularity="all"))
+    # only the old segment participates: all rows in the null group
+    assert len(rows) == 1
+    assert rows[0]["event"]["n"] == 6
+
+
+def test_group_by_dim_dropped_in_new_segment():
+    """The reverse evolution: a dim the OLD segment has and the NEW one
+    dropped — new rows fall in the null group, old groups survive."""
+    old = SegmentBuilder("rev", Interval(T0, T0 + DAY), version="v1")
+    old.add_columns(
+        [T0 + i * 1000 for i in range(4)],
+        dims={"page": ["a", "b", "a", "b"],
+              "legacy": ["x", "y", "x", "y"]},
+        metrics={"hits": np.asarray([1, 2, 3, 4], np.int64)},
+        metric_types={"hits": ValueType.LONG})
+    new = SegmentBuilder("rev", Interval(T0 + DAY, T0 + 2 * DAY),
+                         version="v1")
+    new.add_columns(
+        [T0 + DAY + i * 1000 for i in range(3)],
+        dims={"page": ["a", "b", "a"]},
+        metrics={"hits": np.asarray([10, 20, 30], np.int64)},
+        metric_types={"hits": ValueType.LONG})
+    rows = QueryExecutor([old.build(), new.build()]).run(GroupByQuery.of(
+        "rev", [IV], [DefaultDimensionSpec("legacy")],
+        [CountAggregator("n"), LongSumAggregator("h", "hits")],
+        granularity="all"))
+    got = {r["event"]["legacy"]: (r["event"]["n"], r["event"]["h"])
+           for r in rows}
+    assert got["x"] == (2, 4) and got["y"] == (2, 6)
+    null_keys = [k for k in got if k in (None, "")]
+    assert len(null_keys) == 1
+    assert got[null_keys[0]] == (3, 60)
