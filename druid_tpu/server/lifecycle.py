@@ -52,17 +52,51 @@ class QueryLifecycle:
                  request_logger: Optional[RequestLogger] = None,
                  authorizer: Optional[Callable[[Optional[str], Query], bool]] = None,
                  on_result: Optional[Callable[[bool], None]] = None,
-                 query_manager=None):
+                 query_manager=None, scheduler=None):
         self.runner = runner
         self.emitter = emitter
         self.request_logger = request_logger
         self.authorizer = authorizer          # (identity, query) → allowed
         self.on_result = on_result            # QueryCountStatsMonitor hook
+        #: optional QueryScheduler: bounded priority-ordered admission
+        #: (the PrioritizedExecutorService role, per query not per segment)
+        self.scheduler = scheduler
         # share the runner's manager so a DELETE at this resource trips the
         # same token the broker's scatter is checking
         self.query_manager = query_manager \
             if query_manager is not None \
             else getattr(runner, "query_manager", None)
+
+    def _admit(self, query: Query, qid: str):
+        """Acquire a scheduler slot (priority/lane from the query context).
+        Returns (query, release): the context timeout is rewritten to the
+        budget REMAINING after the queue wait — timeout means total query
+        time, not per-phase — and a DELETE on the queued id aborts the
+        wait via the token. Without a scheduler: (query, no-op)."""
+        if self.scheduler is None:
+            return query, (lambda: None)
+        from druid_tpu.server.querymanager import (QueryTimeoutError,
+                                                   context_priority,
+                                                   context_timeout_ms)
+        lane = query.context_map.get("lane")
+        tmo = context_timeout_ms(query)
+        token = self.query_manager.token(qid) \
+            if self.query_manager is not None else None
+        t0 = time.monotonic()
+        ok = self.scheduler.acquire(
+            priority=context_priority(query), lane=lane,
+            timeout=None if tmo is None else tmo / 1000.0,
+            should_abort=token.check if token is not None else None)
+        if not ok:
+            raise QueryTimeoutError(
+                "query timed out waiting for an execution slot")
+        waited_ms = (time.monotonic() - t0) * 1000
+        if tmo is not None and waited_ms > 1.0:
+            from dataclasses import replace
+            remaining = max(1, int(tmo - waited_ms))
+            query = replace(query, context=tuple(sorted(
+                {**query.context_map, "timeout": remaining}.items())))
+        return query, (lambda: self.scheduler.release(lane))
 
     def cancel(self, query_id: str) -> bool:
         """DELETE /druid/v2/{id} (QueryResource.cancelQuery)."""
@@ -101,7 +135,9 @@ class QueryLifecycle:
     def run(self, query: Query, identity: Optional[str] = None):
         query, qid = self._prepare(query, identity)
         t0 = time.monotonic()
+        release = lambda: None
         try:
+            query, release = self._admit(query, qid)
             rows = self.runner.run(query)
         except Exception as e:
             ms = (time.monotonic() - t0) * 1000
@@ -110,6 +146,7 @@ class QueryLifecycle:
                 self.on_result(False)
             raise
         finally:
+            release()
             if self.query_manager is not None:
                 self.query_manager.unregister(qid)
         ms = (time.monotonic() - t0) * 1000
@@ -131,7 +168,9 @@ class QueryLifecycle:
         query, qid = self._prepare(query, identity)
         t0 = time.monotonic()
         n = 0
+        release = lambda: None
         try:
+            query, release = self._admit(query, qid)
             for batch in runner_stream(query):
                 n += 1    # top-level results (scan batches), like run()'s
                 yield batch   # len(rows) over the materialized batch list
@@ -153,6 +192,7 @@ class QueryLifecycle:
                 self.on_result(False)
             raise
         finally:
+            release()
             if self.query_manager is not None:
                 self.query_manager.unregister(qid)
 

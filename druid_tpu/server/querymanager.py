@@ -139,6 +139,119 @@ class QueryToken:
             self._fire(hooks)
 
 
+class QueryScheduler:
+    """Bounded, priority-ordered admission of queries.
+
+    Reference analog: query/PrioritizedExecutorService.java (per-segment
+    work ordered by query priority on a bounded pool) + the laning idea of
+    DruidProcessingConfig — here admission happens once per query, because
+    a query is ONE fused device program, not thousands of per-segment
+    tasks. `total_slots` bounds concurrent queries; waiting queries are
+    admitted highest-priority-first (FIFO within a priority); an optional
+    per-lane cap (context "lane") keeps one class of queries from
+    saturating the node."""
+
+    def __init__(self, total_slots: int = 8,
+                 lanes: Optional[Dict[str, int]] = None):
+        self.total_slots = total_slots
+        self.lane_caps = dict(lanes or {})
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = 0
+        self._lane_running: Dict[str, int] = {}
+        self._waiters: List[tuple] = []   # (-priority, seq, event, lane)
+        self._seq = 0
+
+    def _admissible(self, lane: Optional[str]) -> bool:
+        if self._running >= self.total_slots:
+            return False
+        if lane is not None and lane in self.lane_caps:
+            return self._lane_running.get(lane, 0) < self.lane_caps[lane]
+        return True
+
+    def acquire(self, priority: int = 0, lane: Optional[str] = None,
+                timeout: Optional[float] = None,
+                should_abort: Optional[Callable[[], None]] = None) -> bool:
+        """Block until admitted (priority order). False on timeout.
+        `should_abort` (e.g. QueryToken.check) is polled while queued and
+        may raise to abandon the wait — a DELETE on a queued query must
+        free the waiter, not let it run later."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if not self._waiters and self._admissible(lane):
+                self._admit(lane)
+                return True
+            ev = threading.Event()
+            entry = (-priority, self._seq, ev, lane)
+            self._seq += 1
+            self._waiters.append(entry)
+            self._waiters.sort(key=lambda w: (w[0], w[1]))
+            # a lane-blocked head must not stall an admissible newcomer
+            self._wake_admissible()
+            got_slot = False
+            try:
+                while True:
+                    if ev.is_set():
+                        got_slot = True
+                        return True
+                    if should_abort is not None:
+                        should_abort()
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    if should_abort is not None:
+                        # no notification on cancel: poll the token
+                        self._cond.wait(0.1 if remaining is None
+                                        else min(0.1, remaining))
+                    else:
+                        self._cond.wait(remaining)
+            finally:
+                if entry in self._waiters:
+                    self._waiters.remove(entry)
+                if ev.is_set() and not got_slot:
+                    # admitted concurrently with a timeout/abort: give the
+                    # slot back or it leaks forever
+                    self._running -= 1
+                    if lane is not None and lane in self._lane_running:
+                        self._lane_running[lane] -= 1
+                    self._wake_admissible()
+
+    def _admit(self, lane: Optional[str]) -> None:
+        self._running += 1
+        if lane is not None:
+            self._lane_running[lane] = self._lane_running.get(lane, 0) + 1
+
+    def _wake_admissible(self) -> None:
+        # admit the best-priority waiters whose lane has room
+        admitted = []
+        for entry in self._waiters:
+            _, _, ev, lane = entry
+            if self._running >= self.total_slots:
+                break
+            if lane is not None and lane in self.lane_caps and \
+                    self._lane_running.get(lane, 0) >= self.lane_caps[lane]:
+                continue          # lane full: try the next waiter
+            self._admit(lane)
+            ev.set()
+            admitted.append(entry)
+        for entry in admitted:
+            self._waiters.remove(entry)
+
+    def release(self, lane: Optional[str] = None) -> None:
+        with self._cond:
+            self._running -= 1
+            if lane is not None and lane in self._lane_running:
+                self._lane_running[lane] -= 1
+            self._wake_admissible()
+            self._cond.notify_all()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"running": self._running,
+                    "waiting": len(self._waiters)}
+
+
 class QueryManager:
     """Registry of in-flight queries (server/QueryManager analog)."""
 

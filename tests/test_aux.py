@@ -455,3 +455,126 @@ def test_lifecycle_stop_during_start_leaks_nothing():
     started = {e[1:] for e in events if e.startswith("+")}
     stopped = {e[1:] for e in events if e.startswith("-")}
     assert started == stopped
+
+
+# ---------------------------------------------------------------------------
+# Prioritized query scheduler (PrioritizedExecutorService analog)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_priority_order_and_capacity():
+    import threading
+    import time as _time
+    from druid_tpu.server.querymanager import QueryScheduler
+    sched = QueryScheduler(total_slots=1)
+    assert sched.acquire(priority=0)
+    admitted = []
+
+    def waiter(name, prio):
+        sched.acquire(priority=prio)
+        admitted.append(name)
+        sched.release()
+
+    threads = [threading.Thread(target=waiter, args=("low", -1))]
+    threads[0].start()
+    _time.sleep(0.05)
+    threads.append(threading.Thread(target=waiter, args=("high", 10)))
+    threads[1].start()
+    _time.sleep(0.05)
+    assert admitted == []               # slot still held
+    sched.release()
+    for t in threads:
+        t.join(5.0)
+    # the later-arriving high-priority query was admitted first
+    assert admitted == ["high", "low"]
+
+
+def test_scheduler_lane_cap_does_not_block_other_lanes():
+    from druid_tpu.server.querymanager import QueryScheduler
+    sched = QueryScheduler(total_slots=4, lanes={"heavy": 1})
+    assert sched.acquire(lane="heavy")
+    # heavy lane full: a second heavy query times out...
+    assert not sched.acquire(lane="heavy", timeout=0.1)
+    # ...but an unlaned query sails through
+    assert sched.acquire(timeout=0.1)
+    sched.release("heavy")
+    assert sched.acquire(lane="heavy", timeout=0.5)
+
+
+def test_lifecycle_scheduler_admission_timeout(segment):
+    from druid_tpu.server.querymanager import (QueryScheduler,
+                                               QueryTimeoutError)
+    sched = QueryScheduler(total_slots=1)
+    lc = QueryLifecycle(QueryExecutor([segment]), scheduler=sched)
+    q = TimeseriesQuery.of("test", [DAY], [CountAggregator("n")])
+    rows = lc.run(q)
+    assert rows[0]["result"]["n"] == segment.n_rows
+    # slot freed after the run: a held slot + timeout context -> 504 path
+    assert sched.stats()["running"] == 0
+    sched.acquire()
+    from dataclasses import replace
+    q2 = replace(q, context=(("timeout", 100),))
+    with pytest.raises(QueryTimeoutError, match="slot"):
+        lc.run(q2)
+    sched.release()
+    assert lc.run(q)[0]["result"]["n"] == segment.n_rows
+
+
+def test_cancel_while_queued_frees_waiter(segment):
+    """DELETE on a query waiting for a slot aborts the wait — it must not
+    consume a slot and run later."""
+    import threading
+    import time as _time
+    from druid_tpu.server.querymanager import (QueryInterruptedError,
+                                               QueryManager, QueryScheduler)
+    sched = QueryScheduler(total_slots=1)
+    qm = QueryManager()
+    lc = QueryLifecycle(QueryExecutor([segment]), scheduler=sched,
+                        query_manager=qm)
+    sched.acquire()                      # hold the only slot
+    from dataclasses import replace
+    q = TimeseriesQuery.of("test", [DAY], [CountAggregator("n")])
+    q = replace(q, context=(("queryId", "waiting-q"),))
+    errs = []
+
+    def run():
+        try:
+            lc.run(q)
+        except QueryInterruptedError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    _time.sleep(0.2)
+    assert lc.cancel("waiting-q")
+    t.join(5.0)
+    assert errs and "cancelled" in str(errs[0])
+    assert sched.stats() == {"running": 1, "waiting": 0}
+    sched.release()
+
+
+def test_scheduler_timeout_budget_is_total(segment):
+    """`timeout` covers queue wait + execution: time spent waiting for a
+    slot is deducted from the execution deadline."""
+    from druid_tpu.server.querymanager import QueryScheduler
+    seen = {}
+
+    class Probe:
+        def run(self, query):
+            seen["timeout"] = query.context_map.get("timeout")
+            return []
+
+    import threading
+    import time as _time
+    sched = QueryScheduler(total_slots=1)
+    lc = QueryLifecycle(Probe(), scheduler=sched)
+    from dataclasses import replace
+    q = TimeseriesQuery.of("test", [DAY], [CountAggregator("n")])
+    q = replace(q, context=(("timeout", 5000),))
+    sched.acquire()
+    t = threading.Thread(target=lambda: lc.run(q))
+    t.start()
+    _time.sleep(0.4)                     # make it wait ~400ms
+    sched.release()
+    t.join(5.0)
+    assert seen["timeout"] is not None
+    assert seen["timeout"] <= 4800       # wait time deducted
