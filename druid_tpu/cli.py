@@ -22,6 +22,22 @@ import time
 VERSION = "druid-tpu-0.1"
 
 
+def _scheduler_from_config(cfg):
+    """`server.querySlots` bounds concurrent queries (0/unset = unbounded);
+    `server.lanes` caps named lanes, e.g. "reports=1,adhoc=4"
+    (DruidProcessingConfig numThreads + laning)."""
+    slots = cfg.get_int("server.querySlots", 0)
+    if not slots:
+        return None
+    from druid_tpu.server.querymanager import QueryScheduler
+    lanes = {}
+    for part in (cfg.get("server.lanes") or "").split(","):
+        name, _, cap = part.partition("=")
+        if name.strip() and cap.strip().isdigit():
+            lanes[name.strip()] = int(cap)
+    return QueryScheduler(total_slots=slots, lanes=lanes)
+
+
 def cmd_server(args) -> int:
     from druid_tpu.cluster import (Broker, Coordinator, DataNode,
                                    DynamicConfig, InventoryView, LruCache,
@@ -53,7 +69,8 @@ def cmd_server(args) -> int:
                             **cfg.subtree("emitter")
                             if cfg.get("emitter.type") == "file" else {}))
     logger = RequestLogger(cfg.get("request.log.path"))
-    lifecycle = QueryLifecycle(broker, emitter, logger)
+    lifecycle = QueryLifecycle(broker, emitter, logger,
+                               scheduler=_scheduler_from_config(cfg))
     sql = SqlExecutor(broker)
     http = QueryHttpServer(lifecycle, sql, port=cfg.get_int("server.port",
                                                             8082))
@@ -121,18 +138,23 @@ def cmd_historical(args) -> int:
         return 0
 
 
-def build_broker(data_node_urls, port: int = 8082):
+def build_broker(data_node_urls, port: int = 8082, query_slots: int = 0,
+                 lanes: str = ""):
     """Broker over remote data nodes discovered via /status sync."""
     from druid_tpu.cluster import (Broker, InventoryView, LruCache,
                                    RemoteDataNodeClient)
     from druid_tpu.server import QueryHttpServer, QueryLifecycle
     from druid_tpu.sql import SqlExecutor
+    from druid_tpu.utils.config import Config
     view = InventoryView()
     for i, url in enumerate(data_node_urls):
         view.register(RemoteDataNodeClient(f"data{i}", url))
     view.sync_all()
     broker = Broker(view, cache=LruCache())
-    lifecycle = QueryLifecycle(broker)
+    sched = _scheduler_from_config(Config.load(
+        None, env={}, overrides={"server.querySlots": str(query_slots),
+                                 "server.lanes": lanes}))
+    lifecycle = QueryLifecycle(broker, scheduler=sched)
     http = QueryHttpServer(lifecycle, SqlExecutor(broker), port=port)
     http.start()
     return view, broker, http
@@ -153,7 +175,9 @@ def _reregister_missing(view, urls) -> None:
 
 def cmd_broker(args) -> int:
     urls = args.data_node or []
-    view, broker, http = build_broker(urls, args.port)
+    view, broker, http = build_broker(urls, args.port,
+                                      query_slots=args.query_slots,
+                                      lanes=args.lanes)
     print(f"broker listening on :{http.port} "
           f"({len(urls)} data node(s))", flush=True)
     try:
@@ -304,6 +328,10 @@ def main(argv=None) -> int:
     s.add_argument("--data-node", action="append",
                    help="data node base URL (repeatable)")
     s.add_argument("--sync-period", type=float, default=10.0)
+    s.add_argument("--query-slots", type=int, default=0,
+                   help="bound concurrent queries (0 = unbounded)")
+    s.add_argument("--lanes", default="",
+                   help='per-lane caps, e.g. "reports=1,adhoc=4"')
     s.set_defaults(fn=cmd_broker)
 
     s = sub.add_parser("coordinator", help="run the coordinator loop")

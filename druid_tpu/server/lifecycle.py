@@ -91,6 +91,11 @@ class QueryLifecycle:
             raise QueryTimeoutError(
                 "query timed out waiting for an execution slot")
         waited_ms = (time.monotonic() - t0) * 1000
+        if self.emitter is not None:
+            # time queued before execution (reference: query/wait/time)
+            self.emitter.metric("query/wait/time", waited_ms,
+                                dataSource=query.datasource,
+                                type=query.query_type, id=qid)
         if tmo is not None and waited_ms > 1.0:
             from dataclasses import replace
             remaining = max(1, int(tmo - waited_ms))

@@ -191,11 +191,13 @@ class QueryScheduler:
             got_slot = False
             try:
                 while True:
+                    if should_abort is not None:
+                        # BEFORE honoring admission: a cancel that raced a
+                        # release must win, or the cancelled query runs
+                        should_abort()
                     if ev.is_set():
                         got_slot = True
                         return True
-                    if should_abort is not None:
-                        should_abort()
                     remaining = None if deadline is None \
                         else deadline - time.monotonic()
                     if remaining is not None and remaining <= 0:
@@ -211,11 +213,13 @@ class QueryScheduler:
                     self._waiters.remove(entry)
                 if ev.is_set() and not got_slot:
                     # admitted concurrently with a timeout/abort: give the
-                    # slot back or it leaks forever
+                    # slot back or it leaks forever, and wake the waiter
+                    # it now belongs to (it may be in an untimed wait)
                     self._running -= 1
                     if lane is not None and lane in self._lane_running:
                         self._lane_running[lane] -= 1
                     self._wake_admissible()
+                    self._cond.notify_all()
 
     def _admit(self, lane: Optional[str]) -> None:
         self._running += 1

@@ -106,7 +106,10 @@ _SQL_FN_TO_EXPR = {"ABS": "abs", "CEIL": "ceil", "FLOOR": "floor",
                    "NVL": "nvl", "MOD": "mod", "ROUND": "round",
                    "SIGN": "sign", "TRUNCATE": "trunc", "TRUNC": "trunc",
                    "GREATEST": "greatest", "LEAST": "least",
-                   "SAFE_DIVIDE": "safe_divide"}
+                   "SAFE_DIVIDE": "safe_divide",
+                   "ASIN": "asin", "ACOS": "acos", "ATAN": "atan",
+                   "ATAN2": "atan2", "COT": "cot", "DEGREES": "degrees",
+                   "RADIANS": "radians", "PI": "pi"}
 
 
 _UNIT_MS = {"SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,

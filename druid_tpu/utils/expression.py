@@ -371,6 +371,23 @@ _FUNCTIONS: Dict[str, Callable] = {
     "sin": lambda x: _xp(x).sin(x) if hasattr(x, "shape") else math.sin(x),
     "cos": lambda x: _xp(x).cos(x) if hasattr(x, "shape") else math.cos(x),
     "tan": lambda x: _xp(x).tan(x) if hasattr(x, "shape") else math.tan(x),
+    "asin": lambda x: _xp(x).arcsin(x) if hasattr(x, "shape")
+        else math.asin(x),
+    "acos": lambda x: _xp(x).arccos(x) if hasattr(x, "shape")
+        else math.acos(x),
+    "atan": lambda x: _xp(x).arctan(x) if hasattr(x, "shape")
+        else math.atan(x),
+    "atan2": lambda y, x: _xp(y, x).arctan2(y, x)
+        if hasattr(y, "shape") or hasattr(x, "shape") else math.atan2(y, x),
+    "cot": lambda x: (1.0 / _xp(x).tan(x)) if hasattr(x, "shape")
+        else (1.0 / math.tan(x)),
+    "log10": lambda x: _xp(x).log10(x) if hasattr(x, "shape")
+        else math.log10(x),
+    "degrees": lambda x: _xp(x).degrees(x) if hasattr(x, "shape")
+        else math.degrees(x),
+    "radians": lambda x: _xp(x).radians(x) if hasattr(x, "shape")
+        else math.radians(x),
+    "pi": lambda: math.pi,
     "min": lambda a, b: _xp(a, b).minimum(a, b)
         if hasattr(a, "shape") or hasattr(b, "shape") else min(a, b),
     "max": lambda a, b: _xp(a, b).maximum(a, b)

@@ -578,3 +578,62 @@ def test_scheduler_timeout_budget_is_total(segment):
     t.join(5.0)
     assert seen["timeout"] is not None
     assert seen["timeout"] <= 4800       # wait time deducted
+
+
+def test_query_wait_time_metric(segment):
+    from druid_tpu.server.querymanager import QueryScheduler
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("broker", "h", sink)
+    lc = QueryLifecycle(QueryExecutor([segment]), em,
+                        scheduler=QueryScheduler(total_slots=2))
+    lc.run(TimeseriesQuery.of("test", [DAY], [CountAggregator("n")]))
+    waits = sink.metrics("query/wait/time")
+    assert waits and waits[0].dims["dataSource"] == "test"
+
+
+def test_cancel_beats_racing_admission(segment, monkeypatch):
+    """A cancel that lands just as a slot frees must win: should_abort is
+    consulted before the admission event is honored."""
+    from druid_tpu.server.querymanager import (QueryInterruptedError,
+                                               QueryScheduler)
+    sched = QueryScheduler(total_slots=1)
+    sched.acquire()
+    cancelled = {"on": False}
+
+    def abort():
+        if cancelled["on"]:
+            raise QueryInterruptedError("cancelled")
+
+    import threading
+    import time as _time
+    result = {}
+
+    def waiter():
+        try:
+            result["ok"] = sched.acquire(should_abort=abort)
+        except QueryInterruptedError:
+            result["aborted"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    _time.sleep(0.15)
+    # cancel, THEN free the slot: the waiter must abort, not run
+    cancelled["on"] = True
+    sched.release()
+    t.join(5.0)
+    assert result.get("aborted") is True
+    # the slot given back by the aborting waiter is acquirable again
+    assert sched.acquire(timeout=1.0)
+    assert sched.stats()["running"] == 1
+    sched.release()
+
+
+def test_cli_scheduler_config():
+    from druid_tpu.cli import _scheduler_from_config
+    from druid_tpu.utils.config import Config
+    cfg = Config.load(None, env={}, overrides={
+        "server.querySlots": "4", "server.lanes": "reports=1,adhoc=2"})
+    sched = _scheduler_from_config(cfg)
+    assert sched.total_slots == 4
+    assert sched.lane_caps == {"reports": 1, "adhoc": 2}
+    assert _scheduler_from_config(Config.load(None, env={})) is None

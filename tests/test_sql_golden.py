@@ -334,3 +334,20 @@ def test_regex_search_filters_carry_extraction(sql):
               "aggregations": [{"type": "count", "name": "n"}]}
     rows = sql.qe.run(query_from_json(native))
     assert rows[0]["result"]["n"] == 2
+
+
+def test_extended_math_functions(sql):
+    import math
+    cases = [
+        ("SELECT MAX(ROUND(DEGREES(PI()), 3)) FROM foo", 180.0),
+        ("SELECT MAX(ROUND(RADIANS(180) / PI(), 3)) FROM foo", 1.0),
+        ("SELECT MAX(ROUND(ATAN2(1, 1) * 4 / PI(), 3)) FROM foo", 1.0),
+        ("SELECT MAX(ROUND(ASIN(1) * 2 / PI(), 3)) FROM foo", 1.0),
+        ("SELECT MAX(ROUND(ACOS(0) * 2 / PI(), 3)) FROM foo", 1.0),
+        ("SELECT MAX(ROUND(LOG10(l1 * 0 + 1000), 3)) FROM foo", 3.0),
+        ("SELECT MAX(ROUND(COT(ATAN(l1 * 0 + 1)), 3)) FROM foo", 1.0),
+        ("SELECT SUM(ROUND(ATAN(l1 - l1), 3)) FROM foo", 0.0),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == pytest.approx(want, abs=1e-3), (q, rows)
