@@ -19,6 +19,14 @@ from druid_tpu.query.lookup import LookupReferencesManager
 
 _CONFIG_KEY = "lookups"
 
+#: extractionNamespace type → loader(namespace_spec) -> Dict[str, str]
+#: (the lookups-cached-global extension registers "uri" here)
+_NAMESPACE_LOADERS: Dict[str, object] = {}
+
+
+def register_namespace_loader(type_name: str, loader) -> None:
+    _NAMESPACE_LOADERS[type_name] = loader
+
 
 class LookupCoordinatorManager:
     """Authoritative lookup spec store + push loop."""
@@ -34,20 +42,42 @@ class LookupCoordinatorManager:
     def _store(self, specs: Dict[str, Dict[str, dict]]) -> None:
         self.metadata.set_config(_CONFIG_KEY, specs)
 
+    def _next_version(self, tier_specs: Dict[str, dict], name: str,
+                      version: Optional[str]) -> str:
+        if version is not None:
+            return version
+        cur = tier_specs.get(name, {}).get("version")
+        return f"v{int(cur[1:]) + 1}" \
+            if cur and cur[0] == "v" and cur[1:].isdigit() else \
+            (f"v{int(time.time() * 1000)}" if cur else "v0")
+
     def set_lookup(self, tier: str, name: str, mapping: Dict[str, str],
                    version: Optional[str] = None) -> str:
         """Create/update one lookup; bumps the version unless given."""
         with self._lock:
             specs = self._load()
             tier_specs = specs.setdefault(tier, {})
-            if version is None:
-                cur = tier_specs.get(name, {}).get("version")
-                version = f"v{int(cur[1:]) + 1}" \
-                    if cur and cur[0] == "v" and cur[1:].isdigit() else \
-                    (f"v{int(time.time() * 1000)}" if cur else "v0")
+            version = self._next_version(tier_specs, name, version)
             tier_specs[name] = {"version": version,
                                 "lookupExtractorFactory": {
                                     "type": "map", "map": dict(mapping)}}
+            self._store(specs)
+            return version
+
+    def set_namespace_lookup(self, tier: str, name: str, namespace: dict,
+                             version: Optional[str] = None) -> str:
+        """Register a namespace-backed lookup (reference: the
+        lookups-cached-global cachedNamespace factory): nodes materialize
+        the map by running the namespace's registered loader and re-poll it
+        every `pollPeriod` seconds."""
+        with self._lock:
+            specs = self._load()
+            tier_specs = specs.setdefault(tier, {})
+            version = self._next_version(tier_specs, name, version)
+            tier_specs[name] = {"version": version,
+                                "lookupExtractorFactory": {
+                                    "type": "cachedNamespace",
+                                    "extractionNamespace": dict(namespace)}}
             self._store(specs)
             return version
 
@@ -77,6 +107,8 @@ class LookupNodeSync:
         self.manager = manager
         self.tier = tier
         self.registry = registry
+        self._ns_loaded: Dict[str, float] = {}   # name → last load ts
+        self._managed: set = set()               # names this sync applied
 
     def poll(self) -> int:
         """Apply current specs; returns how many lookups changed."""
@@ -84,14 +116,66 @@ class LookupNodeSync:
         changed = 0
         for name, spec in specs.items():
             factory = spec.get("lookupExtractorFactory", {})
-            if factory.get("type") != "map":
-                continue
-            if self.registry.add(name, factory.get("map", {}),
-                                 version=spec.get("version", "v0")):
-                changed += 1
-        # drop local lookups the coordinator no longer defines
+            version = spec.get("version", "v0")
+            if factory.get("type") == "map":
+                cur = self.registry.get(name)
+                if cur is not None and "+" in cur.version and \
+                        cur.version.split("+", 1)[0] != version:
+                    # converting a namespace lookup back to a plain map:
+                    # the reload-stamped version would outrank the plain
+                    # spec version forever — clear it first
+                    self.registry.remove(name)
+                    self._ns_loaded.pop(name, None)
+                if self.registry.add(name, factory.get("map", {}),
+                                     version=version):
+                    self._managed.add(name)
+                    changed += 1
+            elif factory.get("type") == "cachedNamespace":
+                if self._poll_namespace(name, factory, version):
+                    self._managed.add(name)
+                    changed += 1
+        # drop lookups the coordinator no longer defines — but ONLY ones
+        # this sync (or a namespace reload: "+"-stamped version) applied;
+        # process-local register_lookup() entries are not ours to delete
         for name in self.registry.names():
-            if name not in specs:
+            if name in specs:
+                continue
+            cur = self.registry.get(name)
+            stamped = cur is not None and "+" in cur.version
+            if name in self._managed or stamped:
                 self.registry.remove(name)
+                self._managed.discard(name)
+                self._ns_loaded.pop(name, None)
                 changed += 1
         return changed
+
+    def _poll_namespace(self, name: str, factory: dict,
+                        version: str) -> bool:
+        """(Re)load a namespace-backed lookup when the spec version moved
+        or pollPeriod elapsed. A failed load KEEPS the last good mapping
+        (the reference's cached-namespace behavior)."""
+        ns = factory.get("extractionNamespace", {})
+        loader = _NAMESPACE_LOADERS.get(str(ns.get("type")))
+        if loader is None:
+            return False          # extension not loaded on this node
+        period = float(ns.get("pollPeriod", 0) or 0)
+        now = time.time()
+        last = self._ns_loaded.get(name)
+        cur = self.registry.get(name)
+        spec_changed = cur is None or \
+            not cur.version.startswith(f"{version}+")
+        # `last is None` counts as due: a recreated sync over a registry
+        # that already holds the lookup must still honor pollPeriod
+        due = spec_changed or (period > 0
+                               and (last is None or now - last >= period))
+        if not due:
+            return False
+        try:
+            mapping = loader(ns)
+        except Exception:
+            return False          # keep serving the last good mapping
+        self._ns_loaded[name] = now
+        # stamped reload counter keeps periodic refreshes version-ascending
+        n = 0 if cur is None or spec_changed \
+            else int(cur.version.rsplit("+", 1)[1]) + 1
+        return self.registry.add(name, mapping, version=f"{version}+{n:09d}")

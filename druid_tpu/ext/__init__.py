@@ -21,6 +21,7 @@ from druid_tpu.ext.hllsketch import (HLLSketchBuildAggregator,
                                      HLLSketchToEstimatePostAgg)
 from druid_tpu.ext.protobuf_parser import ProtobufInputRowParser
 from druid_tpu.ext.time_minmax import (TimeMaxAggregator, TimeMinAggregator)
+from druid_tpu.ext.namespace_lookup import load_uri_namespace
 
 __all__ = [
     "HLLSketchBuildAggregator", "HLLSketchMergeAggregator",
@@ -31,5 +32,6 @@ __all__ = [
     "QuantilesPostAgg", "ApproximateHistogramAggregator", "HistogramValue",
     "HistogramQuantilePostAgg", "BloomFilterAggregator", "BloomFilterValue",
     "ProtobufInputRowParser", "TimeMinAggregator", "TimeMaxAggregator",
+    "load_uri_namespace",
     "BloomDimFilter",
 ]

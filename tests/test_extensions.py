@@ -429,3 +429,142 @@ def test_time_min_max_multi_segment_merge(segments):
                  for f in frames
                  if (f["dimA"] == r["event"]["dimA"]).any())
         assert r["event"]["tmin"] == lo and r["event"]["tmax"] == hi
+
+
+# ---------------------------------------------------------------------------
+# URI namespace lookups (extensions-core/lookups-cached-global)
+# ---------------------------------------------------------------------------
+
+def test_uri_namespace_lookup_sync_and_repoll(tmp_path):
+    import json as _json
+    import time as _time
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync)
+    from druid_tpu.query.lookup import LookupReferencesManager
+    path = tmp_path / "map.json"
+    path.write_text(_json.dumps({"a": "Alpha", "b": "Beta"}))
+    mgr = LookupCoordinatorManager(MetadataStore())
+    mgr.set_namespace_lookup("_default", "codes", {
+        "type": "uri", "uri": f"file://{path}",
+        "namespaceParseSpec": {"format": "json"}, "pollPeriod": 0.05})
+    reg = LookupReferencesManager()
+    sync = LookupNodeSync(mgr, "_default", reg)
+    assert sync.poll() == 1
+    assert reg.get("codes").mapping == {"a": "Alpha", "b": "Beta"}
+    # file changes; repoll after pollPeriod picks it up
+    path.write_text(_json.dumps({"a": "Alpha", "c": "Gamma"}))
+    _time.sleep(0.06)
+    assert sync.poll() == 1
+    assert reg.get("codes").mapping == {"a": "Alpha", "c": "Gamma"}
+    # a broken file keeps the last good mapping
+    path.write_text("{not json")
+    _time.sleep(0.06)
+    assert sync.poll() == 0
+    assert reg.get("codes").mapping == {"a": "Alpha", "c": "Gamma"}
+    # spec bump (new version) forces reload immediately
+    path.write_text(_json.dumps({"z": "Zed"}))
+    mgr.set_namespace_lookup("_default", "codes", {
+        "type": "uri", "uri": f"file://{path}",
+        "namespaceParseSpec": {"format": "json"}, "pollPeriod": 3600})
+    assert sync.poll() == 1
+    assert reg.get("codes").mapping == {"z": "Zed"}
+    # deletion drops it
+    mgr.delete_lookup("_default", "codes")
+    assert sync.poll() == 1
+    assert reg.get("codes") is None
+
+
+def test_uri_namespace_csv_and_customjson(tmp_path):
+    import json as _json
+    from druid_tpu.ext import load_uri_namespace
+    c = tmp_path / "m.csv"
+    c.write_text("code,name\nus,United States\nde,Germany\n")
+    got = load_uri_namespace({"uri": str(c),
+                              "namespaceParseSpec": {"format": "csv"}})
+    assert got == {"us": "United States", "de": "Germany"}
+    j = tmp_path / "m.json"
+    j.write_text(_json.dumps([{"k": "x", "v": "X"}, {"k": "y", "v": "Y"}]))
+    got = load_uri_namespace({"uri": f"file://{j}", "namespaceParseSpec": {
+        "format": "customJson", "keyFieldName": "k", "valueFieldName": "v"}})
+    assert got == {"x": "X", "y": "Y"}
+
+
+def test_uri_namespace_lookup_queryable(tmp_path, segment):
+    """End to end: a URI lookup resolves through LOOKUP() in a query."""
+    import json as _json
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync)
+    from druid_tpu.query.lookup import lookup_manager
+    vals = list(segment.dims["dimA"].dictionary.values)
+    path = tmp_path / "dimmap.json"
+    path.write_text(_json.dumps({vals[0]: "FIRST"}))
+    mgr = LookupCoordinatorManager(MetadataStore())
+    mgr.set_namespace_lookup("_default", "dimmap", {
+        "type": "uri", "uri": str(path),
+        "namespaceParseSpec": {"format": "json"}})
+    LookupNodeSync(mgr, "_default", lookup_manager()).poll()
+    try:
+        rows = QueryExecutor([segment]).run_json({
+            "queryType": "groupBy", "dataSource": "test",
+            "intervals": ["2026-01-01/2026-01-02"], "granularity": "all",
+            "dimensions": [{"type": "extraction", "dimension": "dimA",
+                            "outputName": "d",
+                            "extractionFn": {"type": "registeredLookup",
+                                             "lookup": "dimmap",
+                                             "retainMissingValue": True}}],
+            "aggregations": [{"type": "count", "name": "n"}]})
+        got = {r["event"]["d"] for r in rows}
+        assert "FIRST" in got and vals[0] not in got
+    finally:
+        lookup_manager().remove("dimmap")
+
+
+def test_namespace_to_map_conversion_and_foreign_lookups(tmp_path):
+    """Converting a namespace lookup back to a plain map takes effect, and
+    poll() never deletes process-local register_lookup() entries."""
+    import json as _json
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync)
+    from druid_tpu.query.lookup import LookupReferencesManager
+    path = tmp_path / "m.json"
+    path.write_text(_json.dumps({"a": "FromUri"}))
+    mgr = LookupCoordinatorManager(MetadataStore())
+    mgr.set_namespace_lookup("_default", "conv", {
+        "type": "uri", "uri": str(path),
+        "namespaceParseSpec": {"format": "json"}})
+    reg = LookupReferencesManager()
+    reg.add("local_only", {"k": "v"}, version="v0")     # not ours
+    sync = LookupNodeSync(mgr, "_default", reg)
+    sync.poll()
+    assert reg.get("conv").mapping == {"a": "FromUri"}
+    # convert to a plain map: must take effect despite the stamped version
+    mgr.set_lookup("_default", "conv", {"a": "Inline"})
+    sync.poll()
+    assert reg.get("conv").mapping == {"a": "Inline"}
+    # foreign lookup survives every poll
+    assert reg.get("local_only") is not None
+    # fresh sync over a pre-populated registry still honors pollPeriod
+    path.write_text(_json.dumps({"a": "Reloaded"}))
+    mgr.set_namespace_lookup("_default", "conv", {
+        "type": "uri", "uri": str(path),
+        "namespaceParseSpec": {"format": "json"}, "pollPeriod": 0.01})
+    sync.poll()
+    import time as _time
+    _time.sleep(0.02)
+    sync2 = LookupNodeSync(mgr, "_default", reg)
+    path.write_text(_json.dumps({"a": "Reloaded2"}))
+    assert sync2.poll() == 1
+    assert reg.get("conv").mapping == {"a": "Reloaded2"}
+
+
+def test_customjson_object_payload_is_a_failure(tmp_path):
+    from druid_tpu.ext import load_uri_namespace
+    p = tmp_path / "bad.json"
+    p.write_text('{"x": "X"}')
+    with pytest.raises(ValueError, match="list of objects"):
+        load_uri_namespace({"uri": str(p), "namespaceParseSpec": {
+            "format": "customJson", "keyFieldName": "k",
+            "valueFieldName": "v"}})
