@@ -28,6 +28,24 @@ def register_namespace_loader(type_name: str, loader) -> None:
     _NAMESPACE_LOADERS[type_name] = loader
 
 
+def _period_seconds(val) -> float:
+    """pollPeriod as seconds: numbers pass through, ISO-8601 periods
+    ("PT5M") parse like the reference's Period configs; anything
+    malformed disables periodic refresh for THAT lookup instead of
+    crashing the whole poll."""
+    if val is None:
+        return 0.0
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        pass
+    try:
+        from druid_tpu.utils.intervals import parse_period_ms
+        return parse_period_ms(str(val)) / 1000.0
+    except Exception:
+        return 0.0
+
+
 class LookupCoordinatorManager:
     """Authoritative lookup spec store + push loop."""
 
@@ -111,15 +129,28 @@ class LookupNodeSync:
         self._managed: set = set()               # names this sync applied
 
     def poll(self) -> int:
-        """Apply current specs; returns how many lookups changed."""
+        """Apply current specs; returns how many lookups changed.
+
+        Deletion scope: only lookups this sync manages (every name it has
+        seen in the coordinator specs, plus entries carrying the sync's
+        own reload stamp) — process-local register_lookup() entries are
+        never deleted. A map-type leftover from a sync that died before
+        the spec was deleted is cleaned up on process restart (the
+        in-process registry starts empty), matching the reference's
+        fresh LookupReferencesManager at node start."""
+        import re
         specs = self.manager.get_tier(self.tier)
         changed = 0
         for name, spec in specs.items():
             factory = spec.get("lookupExtractorFactory", {})
             version = spec.get("version", "v0")
+            # a spec we have seen is managed even if already up to date —
+            # a recreated sync must still be able to delete it later
+            self._managed.add(name)
             if factory.get("type") == "map":
                 cur = self.registry.get(name)
-                if cur is not None and "+" in cur.version and \
+                if cur is not None and \
+                        re.search(r"\+\d{9}$", cur.version) and \
                         cur.version.split("+", 1)[0] != version:
                     # converting a namespace lookup back to a plain map:
                     # the reload-stamped version would outrank the plain
@@ -128,20 +159,18 @@ class LookupNodeSync:
                     self._ns_loaded.pop(name, None)
                 if self.registry.add(name, factory.get("map", {}),
                                      version=version):
-                    self._managed.add(name)
                     changed += 1
             elif factory.get("type") == "cachedNamespace":
                 if self._poll_namespace(name, factory, version):
-                    self._managed.add(name)
                     changed += 1
-        # drop lookups the coordinator no longer defines — but ONLY ones
-        # this sync (or a namespace reload: "+"-stamped version) applied;
-        # process-local register_lookup() entries are not ours to delete
         for name in self.registry.names():
             if name in specs:
                 continue
             cur = self.registry.get(name)
-            stamped = cur is not None and "+" in cur.version
+            # the sync's own stamp is exactly "+NNNNNNNNN" — a user version
+            # that merely contains '+' is not ours
+            stamped = cur is not None and \
+                re.search(r"\+\d{9}$", cur.version) is not None
             if name in self._managed or stamped:
                 self.registry.remove(name)
                 self._managed.discard(name)
@@ -158,7 +187,7 @@ class LookupNodeSync:
         loader = _NAMESPACE_LOADERS.get(str(ns.get("type")))
         if loader is None:
             return False          # extension not loaded on this node
-        period = float(ns.get("pollPeriod", 0) or 0)
+        period = _period_seconds(ns.get("pollPeriod"))
         now = time.time()
         last = self._ns_loaded.get(name)
         cur = self.registry.get(name)
@@ -175,6 +204,9 @@ class LookupNodeSync:
         except Exception:
             return False          # keep serving the last good mapping
         self._ns_loaded[name] = now
+        if not spec_changed and cur is not None \
+                and mapping == cur.mapping:
+            return False          # unchanged content: no registry churn
         # stamped reload counter keeps periodic refreshes version-ascending
         n = 0 if cur is None or spec_changed \
             else int(cur.version.rsplit("+", 1)[1]) + 1

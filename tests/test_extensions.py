@@ -568,3 +568,44 @@ def test_customjson_object_payload_is_a_failure(tmp_path):
         load_uri_namespace({"uri": str(p), "namespaceParseSpec": {
             "format": "customJson", "keyFieldName": "k",
             "valueFieldName": "v"}})
+
+
+def test_recreated_sync_still_deletes_map_lookups(tmp_path):
+    """Restart convergence: a NEW sync instance over the same registry can
+    delete coordinator map lookups it merely re-observed; ISO pollPeriods
+    parse; unchanged reload content doesn't churn the registry."""
+    import json as _json
+    import time as _time
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync, _period_seconds)
+    from druid_tpu.query.lookup import LookupReferencesManager
+    assert _period_seconds("PT5M") == 300.0
+    assert _period_seconds(2.5) == 2.5
+    assert _period_seconds("garbage") == 0.0
+    mgr = LookupCoordinatorManager(MetadataStore())
+    mgr.set_lookup("_default", "m", {"a": "1"})
+    reg = LookupReferencesManager()
+    LookupNodeSync(mgr, "_default", reg).poll()
+    assert reg.get("m") is not None
+    # fresh sync re-observes (add returns False) then the spec vanishes
+    sync2 = LookupNodeSync(mgr, "_default", reg)
+    sync2.poll()
+    mgr.delete_lookup("_default", "m")
+    assert sync2.poll() == 1
+    assert reg.get("m") is None
+    # a user version merely containing '+' is NOT treated as sync-owned
+    reg.add("mine", {"k": "v"}, version="1.2+build7")
+    sync2.poll()
+    assert reg.get("mine") is not None
+    # unchanged namespace content: no churn on periodic reload
+    p = tmp_path / "n.json"
+    p.write_text(_json.dumps({"x": "X"}))
+    mgr.set_namespace_lookup("_default", "ns", {
+        "type": "uri", "uri": str(p),
+        "namespaceParseSpec": {"format": "json"}, "pollPeriod": 0.01})
+    assert sync2.poll() == 1
+    v1 = reg.get("ns").version
+    _time.sleep(0.02)
+    assert sync2.poll() == 0            # reloaded, identical → no change
+    assert reg.get("ns").version == v1

@@ -8,7 +8,9 @@ Stages (each gates the next):
                   mixed-strategy result exactly (chip_pallas_test inline)
   3. strategies — per-strategy timings on the headline shape so
                   select_strategy cutovers are measured, not assumed
-  4. bench      — the full headline bench (same config the driver runs)
+  4. extended   — the other tracked BASELINE.md configs (timeseries,
+                  selector-filtered topN, HLL cardinality, theta sketch)
+  5. bench      — the full headline bench (same config the driver runs)
 
 Exit code 0 only when every requested stage passes. This supersedes the
 one-off microbench scripts; `profile_headline.py` remains for per-phase
@@ -151,6 +153,60 @@ def stage_strategies(rows: int) -> bool:
     return bool(timings)
 
 
+def stage_extended(rows: int) -> bool:
+    """The OTHER tracked BASELINE.md configs: Wikipedia-style timeseries
+    (count+longSum), selector-filtered TopN with doubleSum, HLL
+    cardinality, theta sketch — rates per config on the headline data."""
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             DoubleSumAggregator,
+                                             HyperUniqueAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import SelectorFilter
+    from druid_tpu.query.model import TimeseriesQuery, TopNQuery
+    import bench
+    segs = bench.headline_segments(rows, 1)
+    iv = bench.headline_interval()
+    sel = list(segs[0].dims["dimA"].dictionary.values)[0]
+    import druid_tpu.ext  # noqa: F401 (theta aggregator)
+    from druid_tpu.ext import ThetaSketchAggregator
+    configs = [
+        ("timeseries count+longSum", TimeseriesQuery.of(
+            "bench", [iv], [CountAggregator("n"),
+                            LongSumAggregator("s", "metLong")],
+            granularity="hour")),
+        ("topN doubleSum+selector", TopNQuery.of(
+            "bench", [iv], "dimB", "ds", 100,
+            [DoubleSumAggregator("ds", "metFloat")],
+            granularity="all", filter=SelectorFilter("dimA", sel))),
+        ("hll cardinality", TimeseriesQuery.of(
+            "bench", [iv], [HyperUniqueAggregator("u", "dimB")],
+            granularity="all")),
+        ("theta sketch", TimeseriesQuery.of(
+            "bench", [iv], [ThetaSketchAggregator("u", "dimB")],
+            granularity="all")),
+    ]
+    ex = QueryExecutor(segs)
+    ok = True
+    for name, q in configs:
+        try:
+            t0 = time.time()
+            ex.run(q)
+            warm = time.time() - t0
+            ts = []
+            for _ in range(3):
+                t0 = time.time()
+                ex.run(q)
+                ts.append(time.time() - t0)
+            log(f"[extended] {name}: {min(ts) * 1e3:.0f}ms "
+                f"({rows / min(ts) / 1e6:.0f}M rows/s, warm {warm:.1f}s)")
+        except Exception as e:
+            log(f"[extended] {name}: FAILED {type(e).__name__}: "
+                f"{str(e)[:120]}")
+            ok = False
+    return ok
+
+
 def stage_bench() -> bool:
     t0 = time.time()
     p = subprocess.run([sys.executable, "bench.py"], cwd=os.path.dirname(
@@ -183,6 +239,7 @@ def main():
     for name, fn in [("sanity", stage_sanity),
                      ("pallas", lambda: stage_pallas(args.rows)),
                      ("strategies", lambda: stage_strategies(args.rows)),
+                     ("extended", lambda: stage_extended(args.rows)),
                      ("bench", None if args.skip_bench else stage_bench)]:
         if fn is None:
             log(f"[{name}] skipped")
