@@ -144,11 +144,8 @@ class LookupNodeSync:
         for name, spec in specs.items():
             factory = spec.get("lookupExtractorFactory", {})
             version = spec.get("version", "v0")
-            # a spec we have seen is managed even if already up to date —
-            # a recreated sync must still be able to delete it later
-            self._managed.add(name)
+            cur = self.registry.get(name)
             if factory.get("type") == "map":
-                cur = self.registry.get(name)
                 if cur is not None and \
                         re.search(r"\+\d{9}$", cur.version) and \
                         cur.version.split("+", 1)[0] != version:
@@ -157,12 +154,25 @@ class LookupNodeSync:
                     # spec version forever — clear it first
                     self.registry.remove(name)
                     self._ns_loaded.pop(name, None)
+                    cur = None
                 if self.registry.add(name, factory.get("map", {}),
                                      version=version):
+                    self._managed.add(name)
                     changed += 1
+                elif cur is not None and cur.version == version:
+                    # re-observation of OUR earlier write (same spec
+                    # version): a recreated sync may delete it later. A
+                    # version-gated no-op against a DIFFERENT local
+                    # version is not ours to claim.
+                    self._managed.add(name)
             elif factory.get("type") == "cachedNamespace":
                 if self._poll_namespace(name, factory, version):
+                    self._managed.add(name)
                     changed += 1
+                elif cur is not None and re.match(
+                        rf"^{re.escape(version)}\+\d{{9}}$", cur.version):
+                    # re-observation of our own stamp: ownable, unchanged
+                    self._managed.add(name)
         for name in self.registry.names():
             if name in specs:
                 continue
@@ -187,12 +197,17 @@ class LookupNodeSync:
         loader = _NAMESPACE_LOADERS.get(str(ns.get("type")))
         if loader is None:
             return False          # extension not loaded on this node
+        import re
         period = _period_seconds(ns.get("pollPeriod"))
         now = time.time()
         last = self._ns_loaded.get(name)
         cur = self.registry.get(name)
-        spec_changed = cur is None or \
-            not cur.version.startswith(f"{version}+")
+        # only our exact stamp counts as "same spec already applied" — a
+        # user version that happens to share the prefix must not be
+        # parsed as a reload counter
+        stamp = None if cur is None else re.match(
+            rf"^{re.escape(version)}\+(\d{{9}})$", cur.version)
+        spec_changed = stamp is None
         # `last is None` counts as due: a recreated sync over a registry
         # that already holds the lookup must still honor pollPeriod
         due = spec_changed or (period > 0
@@ -208,6 +223,5 @@ class LookupNodeSync:
                 and mapping == cur.mapping:
             return False          # unchanged content: no registry churn
         # stamped reload counter keeps periodic refreshes version-ascending
-        n = 0 if cur is None or spec_changed \
-            else int(cur.version.rsplit("+", 1)[1]) + 1
+        n = 0 if spec_changed else int(stamp.group(1)) + 1
         return self.registry.add(name, mapping, version=f"{version}+{n:09d}")

@@ -609,3 +609,35 @@ def test_recreated_sync_still_deletes_map_lookups(tmp_path):
     _time.sleep(0.02)
     assert sync2.poll() == 0            # reloaded, identical → no change
     assert reg.get("ns").version == v1
+
+
+def test_local_lookup_with_conflicting_name_never_deleted(tmp_path):
+    """A coordinator spec sharing a name with a LOCAL register_lookup()
+    entry it could not overwrite must not claim ownership — spec deletion
+    leaves the local entry; and a local version sharing the stamp prefix
+    never crashes the namespace reload counter."""
+    import json as _json
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync)
+    from druid_tpu.query.lookup import LookupReferencesManager
+    mgr = LookupCoordinatorManager(MetadataStore())
+    reg = LookupReferencesManager()
+    # local entry with a HIGHER version than the coordinator will use
+    reg.add("x", {"local": "yes"}, version="zzzzzzzzzzzz")
+    mgr.set_lookup("_default", "x", {"coord": "yes"}, version="v1")
+    sync = LookupNodeSync(mgr, "_default", reg)
+    sync.poll()
+    assert reg.get("x").mapping == {"local": "yes"}   # version-gated no-op
+    mgr.delete_lookup("_default", "x")
+    sync.poll()
+    assert reg.get("x") is not None                   # NOT ours to delete
+    # namespace spec whose version prefixes a local version: no crash
+    p = tmp_path / "ns.json"
+    p.write_text(_json.dumps({"a": "A"}))
+    reg.add("y", {"loc": "1"}, version="1.2+build7")
+    mgr.set_namespace_lookup("_default", "y", {
+        "type": "uri", "uri": str(p),
+        "namespaceParseSpec": {"format": "json"}, "pollPeriod": 0.01},
+        version="1.2")
+    sync.poll()                                       # must not raise
