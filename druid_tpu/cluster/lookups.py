@@ -125,19 +125,17 @@ class LookupNodeSync:
         self.manager = manager
         self.tier = tier
         self.registry = registry
+        self._owner = f"lookup-sync:{tier}"
         self._ns_loaded: Dict[str, float] = {}   # name → last load ts
-        self._managed: set = set()               # names this sync applied
 
     def poll(self) -> int:
         """Apply current specs; returns how many lookups changed.
 
-        Deletion scope: only lookups this sync manages (every name it has
-        seen in the coordinator specs, plus entries carrying the sync's
-        own reload stamp) — process-local register_lookup() entries are
-        never deleted. A map-type leftover from a sync that died before
-        the spec was deleted is cleaned up on process restart (the
-        in-process registry starts empty), matching the reference's
-        fresh LookupReferencesManager at node start."""
+        Authority follows the registry's explicit `owner` field: this sync
+        only ever replaces or deletes entries it owns. Process-local
+        register_lookup() entries (owner None) and other tiers' entries
+        are untouchable — a name collision means the first writer wins
+        and the spec is skipped."""
         import re
         specs = self.manager.get_tier(self.tier)
         changed = 0
@@ -145,54 +143,41 @@ class LookupNodeSync:
             factory = spec.get("lookupExtractorFactory", {})
             version = spec.get("version", "v0")
             cur = self.registry.get(name)
+            if cur is not None and cur.owner != self._owner:
+                continue          # not ours: never overwrite, never load
             if factory.get("type") == "map":
-                if cur is not None and \
-                        re.search(r"\+\d{9}$", cur.version) and \
-                        cur.version.split("+", 1)[0] != version:
-                    # converting a namespace lookup back to a plain map:
-                    # the reload-stamped version would outrank the plain
-                    # spec version forever — clear it first
-                    self.registry.remove(name)
-                    self._ns_loaded.pop(name, None)
-                    cur = None
-                if self.registry.add(name, factory.get("map", {}),
-                                     version=version):
-                    self._managed.add(name)
+                if cur is not None and re.search(r"\+\d{9}$", cur.version):
+                    # converting a namespace lookup (reload-STAMP version,
+                    # ours by the owner check) back to a plain map: even an
+                    # identical spec version would be outranked by its own
+                    # longer stamp — swap atomically, no unregistered gap
+                    if self.registry.force_replace(
+                            name, factory.get("map", {}), version,
+                            self._owner):
+                        self._ns_loaded.pop(name, None)
+                        changed += 1
+                elif self.registry.add(name, factory.get("map", {}),
+                                       version=version, owner=self._owner):
                     changed += 1
-                elif cur is not None and cur.version == version:
-                    # re-observation of OUR earlier write (same spec
-                    # version): a recreated sync may delete it later. A
-                    # version-gated no-op against a DIFFERENT local
-                    # version is not ours to claim.
-                    self._managed.add(name)
             elif factory.get("type") == "cachedNamespace":
-                if self._poll_namespace(name, factory, version):
-                    self._managed.add(name)
+                if self._poll_namespace(name, factory, version, cur):
                     changed += 1
-                elif cur is not None and re.match(
-                        rf"^{re.escape(version)}\+\d{{9}}$", cur.version):
-                    # re-observation of our own stamp: ownable, unchanged
-                    self._managed.add(name)
         for name in self.registry.names():
             if name in specs:
                 continue
             cur = self.registry.get(name)
-            # the sync's own stamp is exactly "+NNNNNNNNN" — a user version
-            # that merely contains '+' is not ours
-            stamped = cur is not None and \
-                re.search(r"\+\d{9}$", cur.version) is not None
-            if name in self._managed or stamped:
+            if cur is not None and cur.owner == self._owner:
                 self.registry.remove(name)
-                self._managed.discard(name)
                 self._ns_loaded.pop(name, None)
                 changed += 1
         return changed
 
-    def _poll_namespace(self, name: str, factory: dict,
-                        version: str) -> bool:
+    def _poll_namespace(self, name: str, factory: dict, version: str,
+                        cur) -> bool:
         """(Re)load a namespace-backed lookup when the spec version moved
         or pollPeriod elapsed. A failed load KEEPS the last good mapping
-        (the reference's cached-namespace behavior)."""
+        (the reference's cached-namespace behavior). `cur` is this sync's
+        own entry or None (foreign entries were filtered by the caller)."""
         ns = factory.get("extractionNamespace", {})
         loader = _NAMESPACE_LOADERS.get(str(ns.get("type")))
         if loader is None:
@@ -201,10 +186,6 @@ class LookupNodeSync:
         period = _period_seconds(ns.get("pollPeriod"))
         now = time.time()
         last = self._ns_loaded.get(name)
-        cur = self.registry.get(name)
-        # only our exact stamp counts as "same spec already applied" — a
-        # user version that happens to share the prefix must not be
-        # parsed as a reload counter
         stamp = None if cur is None else re.match(
             rf"^{re.escape(version)}\+(\d{{9}})$", cur.version)
         spec_changed = stamp is None
@@ -222,6 +203,14 @@ class LookupNodeSync:
         if not spec_changed and cur is not None \
                 and mapping == cur.mapping:
             return False          # unchanged content: no registry churn
+        if spec_changed and cur is not None:
+            # our entry under an older spec version: swap atomically (the
+            # old stamp could outrank the new version string, and a
+            # remove+add gap would briefly 404 concurrent get_lookup())
+            return self.registry.force_replace(
+                name, mapping, f"{version}+{0:09d}", self._owner)
         # stamped reload counter keeps periodic refreshes version-ascending
         n = 0 if spec_changed else int(stamp.group(1)) + 1
-        return self.registry.add(name, mapping, version=f"{version}+{n:09d}")
+        return self.registry.add(name, mapping,
+                                 version=f"{version}+{n:09d}",
+                                 owner=self._owner)

@@ -19,10 +19,14 @@ from typing import Dict, List, Optional
 
 @dataclass
 class LookupContainer:
-    """A named lookup version (reference: LookupExtractorFactoryContainer)."""
+    """A named lookup version (reference: LookupExtractorFactoryContainer).
+    `owner` records which manager wrote it (None = process-local
+    register_lookup; "lookup-sync:<tier>" = cluster sync) — deletion and
+    replacement authority follow ownership, never version-string shape."""
     name: str
     mapping: Dict[str, str]
     version: str = "v0"
+    owner: object = None
 
 
 class LookupReferencesManager:
@@ -39,15 +43,35 @@ class LookupReferencesManager:
         return (len(v), v)
 
     def add(self, name: str, mapping: Dict[str, str],
-            version: str = "v0") -> bool:
+            version: str = "v0", owner: object = None) -> bool:
         """Register/replace; a replace with a version <= current is a no-op
-        (mirrors LookupReferencesManager version-gated updates)."""
+        (mirrors LookupReferencesManager version-gated updates). A write
+        from a DIFFERENT owner than the current entry's never applies —
+        first writer wins on a name collision; the other party must
+        remove() first (which only the owning sync does)."""
         with self._lock:
             cur = self._lookups.get(name)
+            if cur is not None and cur.owner != owner:
+                return False
             if cur is not None and \
                     self._version_key(version) <= self._version_key(cur.version):
                 return False
-            self._lookups[name] = LookupContainer(name, dict(mapping), version)
+            self._lookups[name] = LookupContainer(name, dict(mapping),
+                                                  version, owner)
+            return True
+
+    def force_replace(self, name: str, mapping: Dict[str, str],
+                      version: str = "v0", owner: object = None) -> bool:
+        """Atomic ownership-checked replace with NO version gate — the
+        owning sync swapping its own entry across version-scheme changes
+        (namespace stamp → plain spec version). One lock acquisition, so
+        concurrent get_lookup() never observes the name missing."""
+        with self._lock:
+            cur = self._lookups.get(name)
+            if cur is not None and cur.owner != owner:
+                return False
+            self._lookups[name] = LookupContainer(name, dict(mapping),
+                                                  version, owner)
             return True
 
     def remove(self, name: str) -> bool:

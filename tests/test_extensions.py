@@ -632,7 +632,8 @@ def test_local_lookup_with_conflicting_name_never_deleted(tmp_path):
     mgr.delete_lookup("_default", "x")
     sync.poll()
     assert reg.get("x") is not None                   # NOT ours to delete
-    # namespace spec whose version prefixes a local version: no crash
+    # namespace spec colliding with a local entry: first writer wins —
+    # the sync neither overwrites nor loads, and never deletes it
     p = tmp_path / "ns.json"
     p.write_text(_json.dumps({"a": "A"}))
     reg.add("y", {"loc": "1"}, version="1.2+build7")
@@ -641,3 +642,35 @@ def test_local_lookup_with_conflicting_name_never_deleted(tmp_path):
         "namespaceParseSpec": {"format": "json"}, "pollPeriod": 0.01},
         version="1.2")
     sync.poll()                                       # must not raise
+    assert reg.get("y").mapping == {"loc": "1"}       # untouched
+    mgr.delete_lookup("_default", "y")
+    sync.poll()
+    assert reg.get("y") is not None                   # still not ours
+    # namespace→map conversion under the SAME version string applies
+    p2 = tmp_path / "same.json"
+    p2.write_text(_json.dumps({"k": "FromUri"}))
+    mgr.set_namespace_lookup("_default", "same", {
+        "type": "uri", "uri": str(p2),
+        "namespaceParseSpec": {"format": "json"}}, version="v7")
+    sync.poll()
+    assert reg.get("same").mapping == {"k": "FromUri"}
+    mgr.set_lookup("_default", "same", {"k": "Inline"}, version="v7")
+    sync.poll()
+    assert reg.get("same").mapping == {"k": "Inline"}
+
+
+def test_map_spec_with_plus_version_converges():
+    """A coordinator map spec whose version itself contains '+' must
+    converge (no perpetual remove/re-add churn)."""
+    from druid_tpu.cluster import MetadataStore
+    from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
+                                           LookupNodeSync)
+    from druid_tpu.query.lookup import LookupReferencesManager
+    mgr = LookupCoordinatorManager(MetadataStore())
+    mgr.set_lookup("_default", "x", {"a": "1"}, version="1.0+hotfix")
+    reg = LookupReferencesManager()
+    sync = LookupNodeSync(mgr, "_default", reg)
+    assert sync.poll() == 1
+    assert sync.poll() == 0
+    assert sync.poll() == 0
+    assert reg.get("x").mapping == {"a": "1"}
