@@ -217,3 +217,63 @@ def test_fuzz_day_granularity_vs_oracle(case, segments, frames):
     # engine emits empty covered buckets too; compare the non-empty ones
     non_empty = {t: v for t, v in got.items() if v[0] != 0}
     assert non_empty == want, (case, non_empty, want)
+
+
+# ---------------------------------------------------------------------------
+# Extraction dims + HAVING + limitSpec fuzz
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("case", range(10))
+def test_fuzz_extraction_groupby_vs_oracle(case, segments, frames):
+    from druid_tpu.query.model import (DefaultLimitSpec,
+                                       ExtractionDimensionSpec,
+                                       GreaterThanHaving, OrderByColumnSpec,
+                                       SubstringExtractionFn,
+                                       UpperExtractionFn)
+    rng = np.random.default_rng(7000 + case)
+    flt, mask_fn = _rand_filter(rng, frames)
+    use_upper = bool(rng.integers(0, 2))
+    # generated values are zero-padded ("v00000012"): substring over the
+    # VARYING tail so keys PARTIALLY collapse (many→fewer groups) — the
+    # interesting extraction+having+limit merge; a prefix substring would
+    # collapse everything to one vacuous group
+    start = int(rng.integers(7, 9))
+    if use_upper:
+        dimspec = ExtractionDimensionSpec("dimB", "d", UpperExtractionFn())
+        ex_fn = lambda v: v.upper()
+    else:
+        dimspec = ExtractionDimensionSpec(
+            "dimB", "d", SubstringExtractionFn(start, 2))
+        ex_fn = lambda v: v[start:start + 2]
+    threshold = int(rng.integers(0, 30))
+    limit = int(rng.integers(1, 8)) if rng.integers(0, 2) else None
+
+    q = GroupByQuery.of(
+        "test", [WEEK], [dimspec],
+        [A.CountAggregator("n"), A.LongSumAggregator("s", "metLong")],
+        granularity="all", filter=flt,
+        having=GreaterThanHaving("n", threshold),
+        limit_spec=DefaultLimitSpec(
+            [OrderByColumnSpec("s", "descending", "numeric")], limit)
+        if limit else None)
+    rows = QueryExecutor(segments).run(q)
+    got = [(r["event"]["d"], r["event"]["n"], r["event"]["s"])
+           for r in rows]
+
+    want = {}
+    for f in frames:
+        m = mask_fn(f)
+        for v, x in zip(np.asarray(f["dimB"])[m], f["metLong"][m]):
+            k = ex_fn(v)
+            n0, s0 = want.get(k, (0, 0))
+            want[k] = (n0 + 1, s0 + int(x))
+    want = {k: v for k, v in want.items() if v[0] > threshold}
+    if limit:
+        top = sorted(want.items(), key=lambda kv: -kv[1][1])[:limit]
+        assert len(got) == min(limit, len(want)), (case, got)
+        # compare sums at each rank (key ties may reorder)
+        assert [g[2] for g in got] == [v[1] for _, v in top], (case,)
+        for k, n, s in got:
+            assert want.get(k) == (n, s), (case, k)
+    else:
+        assert {g[0]: (g[1], g[2]) for g in got} == want, (case,)
