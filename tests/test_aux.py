@@ -637,3 +637,75 @@ def test_cli_scheduler_config():
     assert sched.total_slots == 4
     assert sched.lane_caps == {"reports": 1, "adhoc": 2}
     assert _scheduler_from_config(Config.load(None, env={})) is None
+
+
+def test_cli_server_subprocess_smoke(tmp_path):
+    """`python -m druid_tpu server` brings the whole single-process stack
+    up through the staged Lifecycle, serves native + SQL queries, and
+    shuts down cleanly on SIGINT."""
+    import os
+    import re as _re
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.request
+
+    cfg = tmp_path / "runtime.properties"
+    cfg.write_text("server.port=0\nmetadata.path=:memory:\n"
+                   f"storage.dir={tmp_path}/deep\n"
+                   "server.querySlots=4\nserver.lanes=reports=1\n"
+                   "coordinator.period=1\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"        # subprocess: no axon plugin
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] or [])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env["PYTHONPATH"] else []))
+    p = subprocess.Popen(
+        [sys.executable, "-m", "druid_tpu", "server", "--config", str(cfg)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        import queue
+        import threading
+        lines: "queue.Queue[str]" = queue.Queue()
+
+        def pump():
+            for ln in p.stdout:
+                lines.put(ln)
+            lines.put("")                    # EOF marker
+
+        threading.Thread(target=pump, daemon=True).start()
+        seen, line = [], ""
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            try:
+                line = lines.get(timeout=max(0.1, deadline - _time.time()))
+            except queue.Empty:
+                break
+            if line == "":
+                break                        # child exited
+            seen.append(line)
+            if "listening on" in line:
+                break
+        m = _re.search(r"listening on :(\d+)", line)
+        assert m, f"no listen line; child output: {''.join(seen)!r}"
+        port = int(m.group(1))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=30) as r:
+            assert json.loads(r.read())["version"].startswith("druid-tpu")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/druid/v2/sql",
+            json.dumps({"query": "SELECT TABLE_NAME FROM "
+                        "INFORMATION_SCHEMA.TABLES"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            json.loads(r.read())            # empty cluster: no tables, 200
+        p.send_signal(signal.SIGINT)
+        assert p.wait(timeout=30) == 0
+    finally:
+        if p.poll() is None:
+            p.kill()

@@ -233,11 +233,12 @@ def test_fuzz_extraction_groupby_vs_oracle(case, segments, frames):
     rng = np.random.default_rng(7000 + case)
     flt, mask_fn = _rand_filter(rng, frames)
     use_upper = bool(rng.integers(0, 2))
-    # generated values are zero-padded ("v00000012"): substring over the
-    # VARYING tail so keys PARTIALLY collapse (many→fewer groups) — the
+    # generated values are zero-padded ("v00000012"): a substring at the
+    # units digit PARTIALLY collapses keys (100 values → 10 groups) — the
     # interesting extraction+having+limit merge; a prefix substring would
-    # collapse everything to one vacuous group
-    start = int(rng.integers(7, 9))
+    # collapse everything to one vacuous group, and start=7 would be a
+    # bijective rename (no merge at all)
+    start = 8
     if use_upper:
         dimspec = ExtractionDimensionSpec("dimB", "d", UpperExtractionFn())
         ex_fn = lambda v: v.upper()
