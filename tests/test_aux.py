@@ -639,10 +639,22 @@ def test_cli_scheduler_config():
     assert _scheduler_from_config(Config.load(None, env={})) is None
 
 
-def test_cli_server_subprocess_smoke(tmp_path):
+@pytest.mark.parametrize("attempt", [0])
+def test_cli_server_subprocess_smoke(tmp_path, attempt):
     """`python -m druid_tpu server` brings the whole single-process stack
     up through the staged Lifecycle, serves native + SQL queries, and
-    shuts down cleanly on SIGINT."""
+    shuts down cleanly on SIGINT. One retry: subprocess jax startup under
+    full-suite load can exceed the wait."""
+    for attempt in range(2):
+        try:
+            _run_server_smoke(tmp_path)
+            return
+        except AssertionError:
+            if attempt == 1:
+                raise
+
+
+def _run_server_smoke(tmp_path):
     import os
     import re as _re
     import signal
@@ -680,7 +692,7 @@ def test_cli_server_subprocess_smoke(tmp_path):
 
         threading.Thread(target=pump, daemon=True).start()
         seen, line = [], ""
-        deadline = _time.time() + 120
+        deadline = _time.time() + 300
         while _time.time() < deadline:
             try:
                 line = lines.get(timeout=max(0.1, deadline - _time.time()))
