@@ -89,7 +89,36 @@ def main():
     iters = int(os.environ.get("DRUID_TPU_BENCH_ITERS", 5))
 
     import jax
-    log(f"devices: {jax.devices()}")
+
+    def _fail(cause: str):
+        # backend down/wedged: still emit ONE parseable JSON line so the
+        # recorded failure carries its cause
+        print(json.dumps({"metric": "groupby+topn_scan_rate", "value": 0,
+                          "unit": "rows/sec/chip", "vs_baseline": 0,
+                          "error": cause[:300]}), flush=True)
+
+    # the TPU tunnel has two failure modes: fast "UNAVAILABLE" errors and
+    # an indefinite hang inside backend init — watchdog both
+    import threading
+    init: dict = {}
+
+    def _init():
+        try:
+            init["devices"] = jax.devices()
+        except Exception as e:   # ANY init failure must reach the JSON line
+            init["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_init, daemon=True)
+    t.start()
+    t.join(timeout=float(os.environ.get("DRUID_TPU_BENCH_INIT_TIMEOUT",
+                                        600)))
+    if t.is_alive():
+        _fail("backend init hung (TPU tunnel wedged)")
+        os._exit(1)          # the init thread is stuck in C — hard exit
+    if "devices" not in init:
+        _fail(f"backend unavailable: {init.get('error', 'no devices')}")
+        sys.exit(1)
+    log(f"devices: {init['devices']}")
 
     from druid_tpu.engine import QueryExecutor
     from druid_tpu.parallel import make_mesh

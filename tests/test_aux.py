@@ -639,17 +639,17 @@ def test_cli_scheduler_config():
     assert _scheduler_from_config(Config.load(None, env={})) is None
 
 
-@pytest.mark.parametrize("attempt", [0])
-def test_cli_server_subprocess_smoke(tmp_path, attempt):
+def test_cli_server_subprocess_smoke(tmp_path):
     """`python -m druid_tpu server` brings the whole single-process stack
     up through the staged Lifecycle, serves native + SQL queries, and
     shuts down cleanly on SIGINT. One retry: subprocess jax startup under
-    full-suite load can exceed the wait."""
+    full-suite load can exceed the wait (assertions AND timeout-class
+    failures alike)."""
     for attempt in range(2):
         try:
             _run_server_smoke(tmp_path)
             return
-        except AssertionError:
+        except Exception:
             if attempt == 1:
                 raise
 
