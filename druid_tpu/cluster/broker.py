@@ -266,6 +266,34 @@ class Broker:
             self.cache.put("result", rkey, rows)
         return rows
 
+    def etag(self, query: Query):
+        """Result-set identity for this query over the CURRENT timeline:
+        hashed query key + exact segment-id set (the reference's
+        X-Druid-ETag from CachingClusteredClient's etag computation).
+        None when any replica is realtime (rows mutate under a stable
+        segment id) or for nested/non-aggregate queries."""
+        from druid_tpu.engine.executor import apply_interval_chunking
+        import hashlib
+        if query.inner_query is not None or not _is_aggregate(query):
+            return None
+        try:
+            q = apply_interval_chunking(query)
+            segments = self._segments_to_query(q)
+            if not segments or not self._all_replicatable(segments):
+                return None
+            raw = result_level_key(q, [f"{d.id}" for d in segments])
+            # result-SHAPING context must distinguish etags (bySegment
+            # returns unmerged per-segment rows under the same cache key);
+            # volatile per-request keys must not
+            ctx = {k: v for k, v in query.context_map.items()
+                   if k not in ("queryId", "timeout", "priority", "lane")}
+            if ctx:
+                import json as _json
+                raw += "|ctx:" + _json.dumps(ctx, sort_keys=True)
+            return hashlib.sha1(raw.encode()).hexdigest()
+        except Exception:
+            return None   # etag is an optimization, never a failure
+
     def _all_replicatable(self, segments: List[SegmentDescriptor]) -> bool:
         """True when no queried segment is served by a realtime server.
         A sink's rows grow between queries under a STABLE segment id, so a

@@ -69,11 +69,14 @@ class QueryHttpServer:
             def log_message(self, fmt, *args):   # quiet
                 pass
 
-            def _reply(self, code: int, body: dict | list):
+            def _reply(self, code: int, body: dict | list,
+                       extra_headers: dict | None = None):
                 data = json.dumps(body, default=_json_value).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -154,9 +157,33 @@ class QueryHttpServer:
                                     self.headers.get("Accept") or ""):
                             self._stream_scan(payload, identity)
                             return
-                        rows = outer.lifecycle.run_json(
-                            payload, identity=identity)
-                        self._reply(200, rows)
+                        # ETag over the (query, exact segment set) identity
+                        # (QueryResource's If-None-Match / X-Druid-ETag).
+                        # Parsed ONCE; lifecycle.etag authorizes before any
+                        # 304 so a match never leaks forbidden data's state
+                        from druid_tpu.query.model import query_from_json
+                        try:
+                            query = query_from_json(payload)
+                        except (ValueError, KeyError, TypeError):
+                            # malformed queries count as failures, like
+                            # run_json's resource-layer accounting
+                            if outer.lifecycle.on_result:
+                                outer.lifecycle.on_result(False)
+                            raise
+                        etag = outer.lifecycle.etag(query,
+                                                    identity=identity)
+                        if etag is not None and \
+                                self.headers.get("If-None-Match") == etag:
+                            outer.lifecycle.log_conditional_hit(query, etag)
+                            self.send_response(304)
+                            self.send_header("X-Druid-ETag", etag)
+                            self.send_header("Content-Length", "0")
+                            self.end_headers()
+                            return
+                        rows = outer.lifecycle.run(query,
+                                                   identity=identity)
+                        self._reply(200, rows,
+                                    {"X-Druid-ETag": etag} if etag else None)
                     else:
                         self._reply(404, {"error": "unknown path"})
                 except Unauthorized as e:

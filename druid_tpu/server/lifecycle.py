@@ -137,6 +137,25 @@ class QueryLifecycle:
             self.query_manager.register(qid)
         return query, qid
 
+    def etag(self, query: Query, identity: Optional[str] = None):
+        """Authorization-gated result-set identity (X-Druid-ETag): raises
+        Unauthorized exactly like run() would — a 304 must never leak
+        whether forbidden data changed. None when the runner has no etag
+        surface or the query has none."""
+        if self.authorizer is not None \
+                and not self.authorizer(identity, query):
+            raise Unauthorized(f"identity {identity!r} denied on "
+                               f"[{query.datasource}]")
+        fn = getattr(self.runner, "etag", None)
+        return fn(query) if fn is not None else None
+
+    def log_conditional_hit(self, query: Query, etag: str) -> None:
+        """A 304 served off If-None-Match still counts: request log entry
+        and success tick, zero rows."""
+        self._log(query, f"etag:{etag[:12]}", 0.0, True, n_rows=0)
+        if self.on_result:
+            self.on_result(True)
+
     def run(self, query: Query, identity: Optional[str] = None):
         query, qid = self._prepare(query, identity)
         t0 = time.monotonic()

@@ -422,3 +422,107 @@ def test_coordinator_balances(segments):
     stats = coord.run_once()
     assert stats.moved >= 1
     assert abs(nodes[0].segment_count() - nodes[1].segment_count()) <= 1
+
+
+def test_http_etag_and_not_modified(cluster, segments):
+    """X-Druid-ETag on aggregate results; If-None-Match returns 304
+    without executing; a timeline change (segment drop) changes the
+    etag (reference: QueryResource + CachingClusteredClient etag)."""
+    import http.client
+    import json as _json
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.lifecycle import QueryLifecycle
+    view, nodes, broker = cluster
+    srv = QueryHttpServer(QueryLifecycle(broker), port=0).start()
+    try:
+        payload = _json.dumps({
+            "queryType": "timeseries", "dataSource": "test",
+            "intervals": [str(WEEK)], "granularity": "all",
+            "aggregations": [{"type": "count", "name": "n"}]})
+        c = http.client.HTTPConnection("127.0.0.1", srv.port)
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json"})
+        r1 = c.getresponse()
+        etag = r1.headers.get("X-Druid-ETag")
+        body1 = _json.loads(r1.read())
+        assert r1.status == 200 and etag
+        assert body1[0]["result"]["n"] == sum(s.n_rows for s in segments)
+        # conditional re-request: 304, empty body
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json",
+                   "If-None-Match": etag})
+        r2 = c.getresponse()
+        assert r2.status == 304
+        assert r2.read() == b""
+        assert r2.headers.get("X-Druid-ETag") == etag
+        # timeline change invalidates: drop a segment from BOTH replicas
+        # (one replica down leaves the segment set — and the etag — intact)
+        view.unannounce(nodes[0].name, descriptor_for(segments[0]).id)
+        view.unannounce(nodes[1].name, descriptor_for(segments[0]).id)
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json",
+                   "If-None-Match": etag})
+        r3 = c.getresponse()
+        assert r3.status == 200
+        new_etag = r3.headers.get("X-Druid-ETag")
+        r3.read()
+        assert new_etag and new_etag != etag
+    finally:
+        srv.stop()
+
+
+def test_etag_denied_identity_gets_403_not_304(cluster, segments):
+    """If-None-Match must not leak whether forbidden data changed: a
+    denied identity gets 403 on the conditional request too, and 304s
+    still hit the request log / success count."""
+    import http.client
+    import json as _json
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.lifecycle import QueryLifecycle, RequestLogger
+    _, _, broker = cluster
+    results = []
+    logger = RequestLogger()
+    lc = QueryLifecycle(broker, request_logger=logger,
+                        authorizer=lambda ident, q: ident != "evil",
+                        on_result=results.append)
+    srv = QueryHttpServer(lc, port=0).start()
+    try:
+        payload = _json.dumps({
+            "queryType": "timeseries", "dataSource": "test",
+            "intervals": [str(WEEK)], "granularity": "all",
+            "aggregations": [{"type": "count", "name": "n"}]})
+        c = http.client.HTTPConnection("127.0.0.1", srv.port)
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json"})
+        r1 = c.getresponse()
+        etag = r1.headers["X-Druid-ETag"]
+        r1.read()
+        # denied identity with a valid etag: 403, never 304
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json",
+                   "If-None-Match": etag, "X-Druid-Identity": "evil"})
+        r2 = c.getresponse()
+        assert r2.status == 403, r2.status
+        r2.read()
+        # allowed conditional hit: 304 AND accounted
+        n_logs = len(logger.entries)
+        c.request("POST", "/druid/v2", payload,
+                  {"Content-Type": "application/json",
+                   "If-None-Match": etag})
+        r3 = c.getresponse()
+        assert r3.status == 304
+        r3.read()
+        assert len(logger.entries) == n_logs + 1
+        assert results[-1] is True
+        # bySegment context yields a DIFFERENT etag (different result shape)
+        by_seg = _json.dumps({**_json.loads(payload),
+                              "context": {"bySegment": True}})
+        c.request("POST", "/druid/v2", by_seg,
+                  {"Content-Type": "application/json",
+                   "If-None-Match": etag})
+        r4 = c.getresponse()
+        assert r4.status == 200
+        assert r4.headers.get("X-Druid-ETag") not in (None, etag)
+        r4.read()
+    finally:
+        srv.stop()
