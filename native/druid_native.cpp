@@ -131,7 +131,9 @@ int64_t druid_lz4_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
         lit_len += s;
       } while (s == 255);
     }
-    if (ip + lit_len > iend || op + lit_len > oend) return -1;
+    // compare against remaining space, NOT `ip + lit_len` — a crafted
+    // multi-byte length (~2^40) would overflow the pointer sum into UB
+    if (lit_len > iend - ip || lit_len > oend - op) return -1;
     std::memcpy(op, ip, (size_t)lit_len);
     ip += lit_len;
     op += lit_len;
@@ -149,7 +151,7 @@ int64_t druid_lz4_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
         match_len += s;
       } while (s == 255);
     }
-    if (op + match_len > oend) return -1;
+    if (match_len > oend - op) return -1;
     const uint8_t* match = op - offset;
     for (int64_t i = 0; i < match_len; i++) op[i] = match[i];  // overlap-safe
     op += match_len;
