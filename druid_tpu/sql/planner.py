@@ -224,6 +224,23 @@ def _expr_str(e, table: str, schema: SqlSchema) -> str:
         if e.name in ("TIMESTAMP_TO_MILLIS", "MILLIS_TO_TIMESTAMP") \
                 and len(e.args) == 1:
             return _expr_str(e.args[0], table, schema)   # millis both ways
+        if e.name in ("TIMESTAMPADD", "TIMESTAMPDIFF") and len(e.args) == 3:
+            u = e.args[0]
+            unit = (u.name if isinstance(u, P.Col)
+                    else str(getattr(u, "value", u))).upper()
+            period = _UNIT_MS.get(unit)
+            if period is None:
+                raise PlannerError(
+                    f"{e.name} supports uniform units "
+                    f"({', '.join(sorted(_UNIT_MS))}); {unit} is "
+                    "calendar-variable")
+            if e.name == "TIMESTAMPADD":
+                n = _expr_str(e.args[1], table, schema)
+                x = _expr_str(e.args[2], table, schema)
+                return f"timestamp_shift({x}, {period}, {n})"
+            a = _expr_str(e.args[1], table, schema)
+            b = _expr_str(e.args[2], table, schema)
+            return f"div(({b}) - ({a}), {period})"
         fn = _SQL_FN_TO_EXPR.get(e.name)
         if fn is not None:
             args = ", ".join(_expr_str(a, table, schema) for a in e.args)
@@ -335,6 +352,56 @@ def _lit_str(e) -> str:
     return "" if e.value is None else str(e.value)
 
 
+def _peel_varchar_casts(e):
+    while isinstance(e, P.Cast) and \
+            str(e.to_type).upper() in ("VARCHAR", "CHAR", "STRING"):
+        e = e.operand
+    return e
+
+
+def _canonical_number(s: str) -> bool:
+    """Does this literal round-trip the numeric stringification? Only then
+    is CAST(numcol AS VARCHAR) = lit the same as numcol = number ('07'
+    and '7a' must NOT numeric-match)."""
+    try:
+        if str(int(s)) == s:
+            return True
+    except ValueError:
+        pass
+    try:
+        return s in (str(float(s)), repr(float(s)))
+    except ValueError:
+        return False
+
+
+def _unwrap_varchar_cast(e, table: str, schema: SqlSchema,
+                         op: str = "=", literals=()):
+    """CAST(x AS VARCHAR) unwraps ONLY where string-compare semantics
+    equal the column's own: always for string columns (pure identity);
+    for numeric columns only under =/<>/IN with canonical numeric
+    literals (ordering and LIKE compare strings lexicographically —
+    numeric planning would return different rows)."""
+    inner = _peel_varchar_casts(e)
+    if inner is e:
+        return e
+    if not isinstance(inner, P.Col):
+        return inner          # fn trees: the extraction path type-checks
+    ctype = schema.type_of(table, inner.name)
+    if ctype == "string":
+        return inner
+    if op in ("=", "<>", "in") and literals \
+            and all(_canonical_number(str(v)) for v in literals):
+        return inner
+    if op in ("<", "<=", ">", ">="):
+        # SQL compares the STRINGS lexicographically; numeric columns
+        # have no dictionary to realize that on the device, and the
+        # expression fallback would crash comparing number to string
+        raise PlannerError(
+            "lexicographic ordering over CAST(numeric AS VARCHAR) is not "
+            "supported — compare the numeric column directly")
+    return e                  # =/<> non-canonical: expression path (false)
+
+
 def _extraction_of(e, table: str, schema: SqlSchema):
     """String-function call tree over ONE column → (column name,
     ExtractionFn), or None. Nested calls cascade (reference:
@@ -423,11 +490,21 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         if e.subquery is not None:
             raise PlannerError(
                 "IN (SELECT ...) must be materialized by the SQL executor")
-        if isinstance(e.operand, P.Col):
-            vals = tuple(_lit_str(v) for v in e.values)
-            flt = F.InFilter(e.operand.name, vals)
+        operand = _peel_varchar_casts(e.operand)
+        if operand is not e.operand and isinstance(operand, P.Col) \
+                and schema.type_of(table, operand.name) != "string":
+            # CAST(numcol AS VARCHAR) IN (...): only canonical numeric
+            # strings can ever equal a stringified number — keep those,
+            # drop the rest (an empty remainder matches nothing)
+            vals = tuple(_lit_str(v) for v in e.values
+                         if _canonical_number(_lit_str(v)))
+            flt = F.InFilter(operand.name, vals)
             return F.NotFilter(flt) if e.negated else flt
-        ext = _extraction_of(e.operand, table, schema)
+        if isinstance(operand, P.Col):
+            vals = tuple(_lit_str(v) for v in e.values)
+            flt = F.InFilter(operand.name, vals)
+            return F.NotFilter(flt) if e.negated else flt
+        ext = _extraction_of(operand, table, schema)
         if ext is not None:
             vals = tuple(_lit_str(v) for v in e.values)
             flt = F.InFilter(ext[0], vals, extraction_fn=ext[1])
@@ -435,10 +512,13 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         raise PlannerError("IN supported on columns only")
     if isinstance(e, P.LikeExpr):
         if isinstance(e.pattern, P.Lit):
-            if isinstance(e.operand, P.Col):
-                flt = F.LikeFilter(e.operand.name, str(e.pattern.value))
+            # LIKE is string-lexical: unwrap applies to string columns only
+            operand = _unwrap_varchar_cast(e.operand, table, schema,
+                                           op="like")
+            if isinstance(operand, P.Col):
+                flt = F.LikeFilter(operand.name, str(e.pattern.value))
                 return F.NotFilter(flt) if e.negated else flt
-            ext = _extraction_of(e.operand, table, schema)
+            ext = _extraction_of(operand, table, schema)
             if ext is not None:
                 flt = F.LikeFilter(ext[0], str(e.pattern.value),
                                    extraction_fn=ext[1])
@@ -457,6 +537,16 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         raise PlannerError("BETWEEN supported on columns only")
     if isinstance(e, P.Bin) and e.op in ("=", "<>", "<", "<=", ">", ">="):
         l, r, op = e.left, e.right, e.op
+        # CAST(col AS VARCHAR) compared to a literal: unwrap where that is
+        # value-identity (see _unwrap_varchar_cast) so it plans as a
+        # proper column filter instead of a number-vs-string expression
+        # that silently matches nothing
+        if isinstance(r, P.Lit):
+            l = _unwrap_varchar_cast(l, table, schema, op,
+                                     (_lit_str(r),))
+        if isinstance(l, P.Lit):
+            r = _unwrap_varchar_cast(r, table, schema, op,
+                                     (_lit_str(l),))
         if isinstance(r, P.Col) and not isinstance(l, P.Col):
             l, r = r, l
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)

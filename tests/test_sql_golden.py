@@ -351,3 +351,64 @@ def test_extended_math_functions(sql):
     for q, want in cases:
         cols, rows = sql.execute(q)
         assert rows[0][0] == pytest.approx(want, abs=1e-3), (q, rows)
+
+
+def test_varchar_cast_keeps_column_identity(sql):
+    """CAST(col AS VARCHAR) compared to literals must filter on the
+    column's values (the expression path would compare a number to a
+    string and silently match nothing)."""
+    cases = [
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) = '7'", 1),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) IN "
+         "('3', '9', '10')", 3),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(dim1 AS VARCHAR) LIKE 'a%'",
+         2),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(dim1 AS VARCHAR) = 'b'", 2),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+
+
+def test_timestampadd_timestampdiff(sql):
+    cases = [
+        ("SELECT MAX(TIMESTAMPDIFF(DAY, TIMESTAMP '2026-02-01', __time)) "
+         "FROM foo", 5),
+        ("SELECT COUNT(*) FROM foo WHERE "
+         "TIMESTAMPDIFF(HOUR, TIMESTAMP '2026-02-01', __time) >= 48", 4),
+        ("SELECT COUNT(*) FROM foo WHERE "
+         "TIMESTAMPADD(DAY, 2, __time) > TIMESTAMP '2026-02-06'", 2),
+        ("SELECT COUNT(*) FROM foo WHERE "
+         "TIMESTAMPADD(DAY, 2, __time) >= TIMESTAMP '2026-02-06'", 3),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+    # calendar units reject cleanly instead of approximating
+    from druid_tpu.sql import PlannerError
+    with pytest.raises(PlannerError, match="calendar-variable"):
+        sql.execute("SELECT MAX(TIMESTAMPDIFF(MONTH, "
+                    "TIMESTAMP '2026-01-01', __time)) FROM foo")
+
+
+def test_varchar_cast_unwrap_is_semantics_safe(sql):
+    """Unwrap happens only where string-compare equals column-compare:
+    non-canonical numeric literals ('07', '7a') and ordering comparisons
+    must NOT numeric-match."""
+    cases = [
+        # '07' != '7' as strings: no match even though int('07') == 7
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) = '07'", 0),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) = '7a'", 0),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) IN "
+         "('07', '3')", 1),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+    # ordering on a varchar-cast numeric column is lexicographic in SQL;
+    # neither numeric-matching ('10' would wrongly pass > '5') nor a deep
+    # crash is acceptable — clean plan-time rejection
+    from druid_tpu.sql import PlannerError
+    with pytest.raises(PlannerError, match="lexicographic ordering"):
+        sql.execute(
+            "SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) > '5'")
