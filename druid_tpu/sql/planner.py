@@ -109,7 +109,11 @@ _SQL_FN_TO_EXPR = {"ABS": "abs", "CEIL": "ceil", "FLOOR": "floor",
                    "SAFE_DIVIDE": "safe_divide",
                    "ASIN": "asin", "ACOS": "acos", "ATAN": "atan",
                    "ATAN2": "atan2", "COT": "cot", "DEGREES": "degrees",
-                   "RADIANS": "radians", "PI": "pi"}
+                   "RADIANS": "radians", "PI": "pi",
+                   # string→numeric fns: per-dictionary-value LUT gathers
+                   # (utils.expression._STR_NUM_FNS)
+                   "CHAR_LENGTH": "strlen", "LENGTH": "strlen",
+                   "STRLEN": "strlen"}
 
 
 _UNIT_MS = {"SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,
@@ -241,6 +245,12 @@ def _expr_str(e, table: str, schema: SqlSchema) -> str:
             a = _expr_str(e.args[1], table, schema)
             b = _expr_str(e.args[2], table, schema)
             return f"div(({b}) - ({a}), {period})"
+        if e.name == "STRPOS" and len(e.args) == 2:
+            # SQL STRPOS is 1-based with 0 for absent; the native
+            # expression strpos is Druid's 0-based/-1 form
+            x = _expr_str(e.args[0], table, schema)
+            lit = _expr_str(e.args[1], table, schema)
+            return f"(strpos({x}, {lit}) + 1)"
         fn = _SQL_FN_TO_EXPR.get(e.name)
         if fn is not None:
             args = ", ".join(_expr_str(a, table, schema) for a in e.args)

@@ -166,6 +166,13 @@ class UnaryOp(Expr):
         return self.operand.required_columns()
 
 
+def _str_fn_err(name: str):
+    raise ValueError(
+        f"{name}() over a non-dictionary operand is not expressible on "
+        "the device path — apply it to a string dimension (LUT rewrite) "
+        "or a string literal")
+
+
 def _fn_if(cond, a, b):
     xp = _xp(cond, a, b)
     if hasattr(cond, "shape"):
@@ -388,6 +395,15 @@ _FUNCTIONS: Dict[str, Callable] = {
     "radians": lambda x: _xp(x).radians(x) if hasattr(x, "shape")
         else math.radians(x),
     "pi": lambda: math.pi,
+    # string fns evaluate host-side over python strings (literals); over
+    # a string DIMENSION they are rewritten to LUT gathers BEFORE eval
+    # (rewrite_string_sites) — reaching here with an array means the
+    # rewrite didn't apply, and a clear error beats len() of a tracer
+    "strlen": lambda x: len(x) if isinstance(x, str) else _str_fn_err(
+        "strlen"),
+    "strpos": lambda x, y: (x.find(y) if isinstance(x, str)
+                            and isinstance(y, str)
+                            else _str_fn_err("strpos")),
     "min": lambda a, b: _xp(a, b).minimum(a, b)
         if hasattr(a, "shape") or hasattr(b, "shape") else min(a, b),
     "max": lambda a, b: _xp(a, b).maximum(a, b)
@@ -452,6 +468,21 @@ class DimLut(Expr):
 _STR_CMP_FLIP = {"==": "==", "!=": "!=", "<": ">", "<=": ">=",
                  ">": "<", ">=": "<="}
 
+#: string→NUMERIC per-dictionary-value functions: like comparisons, they
+#: precompute one numeric LUT per site and the device gathers `lut[ids]`
+#: (strlen/strpos ride the same DimLut node; the gather result simply
+#: carries the LUT's dtype)
+_STR_NUM_FNS = {
+    "strlen": lambda vals, _lit: np.asarray(
+        [0 if v is None else len(v) for v in vals], dtype=np.int32),
+    # DRUID-native semantics: 0-based index, -1 when absent (the SQL
+    # layer emits strpos(...)+1 for SQL's 1-based STRPOS/POSITION)
+    "strpos": lambda vals, lit: np.asarray(
+        [-1 if v is None else v.find(lit) for v in vals],
+        dtype=np.int32),
+}
+_STR_NUM_ARITY = {"strlen": 1, "strpos": 2}
+
 
 def rewrite_string_sites(expr: Expr, string_dims) -> Tuple[Expr, List[tuple]]:
     """Replace (string dim ⋄ string literal) comparisons with DimLut
@@ -481,6 +512,16 @@ def rewrite_string_sites(expr: Expr, string_dims) -> Tuple[Expr, List[tuple]]:
         if isinstance(e, UnaryOp):
             return UnaryOp(e.op, walk(e.operand))
         if isinstance(e, FunctionCall):
+            if e.name in _STR_NUM_FNS \
+                    and len(e.args) == _STR_NUM_ARITY[e.name] \
+                    and isinstance(e.args[0], Identifier) \
+                    and e.args[0].name in string_dims \
+                    and all(isinstance(a, Literal) and isinstance(a.value,
+                                                                  str)
+                            for a in e.args[1:]):
+                lit = e.args[1].value if len(e.args) > 1 else None
+                sites.append((e.args[0].name, e.name, lit))
+                return DimLut(e.args[0].name, len(sites) - 1)
             return FunctionCall(e.name, tuple(walk(a) for a in e.args))
         if isinstance(e, Identifier) and e.name in string_dims:
             raise ValueError(
@@ -493,9 +534,13 @@ def rewrite_string_sites(expr: Expr, string_dims) -> Tuple[Expr, List[tuple]]:
 
 
 def lut_for_site(site: tuple, values) -> np.ndarray:
-    """Boolean per-dictionary-id LUT for one rewrite site (lexicographic
-    ordering, matching the reference's StringComparators.LEXICOGRAPHIC)."""
+    """Per-dictionary-id LUT for one rewrite site: BOOLEAN for comparison
+    sites (lexicographic ordering, matching the reference's
+    StringComparators.LEXICOGRAPHIC), INT32 for string→numeric function
+    sites (strlen/strpos)."""
     dim, op, lit = site
+    if op in _STR_NUM_FNS:
+        return _STR_NUM_FNS[op](list(values), lit)
     vals = np.asarray(list(values), dtype=object)
     if op == "==":
         out = vals == lit

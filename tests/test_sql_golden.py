@@ -412,3 +412,41 @@ def test_varchar_cast_unwrap_is_semantics_safe(sql):
     with pytest.raises(PlannerError, match="lexicographic ordering"):
         sql.execute(
             "SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) > '5'")
+
+
+def test_strlen_strpos_in_expressions(sql):
+    """CHAR_LENGTH/STRPOS over string dims ride per-dictionary-value
+    numeric LUT gathers — usable inside any aggregate expression."""
+    cases = [
+        ("SELECT MAX(CHAR_LENGTH(dim1)) FROM foo", 1),
+        ("SELECT SUM(CHAR_LENGTH(dim1) + CHAR_LENGTH(dim2)) FROM foo", 12),
+        ("SELECT SUM(STRPOS(dim1, 'a')) FROM foo", 2),      # 'a' rows only
+        ("SELECT SUM(STRPOS(dim2, 'z')) FROM foo", 1),
+        ("SELECT SUM(CASE WHEN STRPOS(dim1, 'b') > 0 THEN l1 ELSE 0 END) "
+         "FROM foo", 325332),
+        ("SELECT SUM(l1 * CHAR_LENGTH(dim2)) FROM foo", 325352),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+
+
+def test_strpos_semantics_and_literals(sql):
+    """SQL STRPOS is 1-based (0 absent); native expression strpos is
+    Druid's 0-based/-1. Literal-only string fns evaluate host-side."""
+    cases = [
+        ("SELECT MAX(STRPOS(dim2, 'x')) FROM foo", 1),
+        ("SELECT MIN(STRPOS(dim2, 'x')) FROM foo", 0),     # absent → 0
+        ("SELECT MAX(CHAR_LENGTH('abc') + l1 * 0) FROM foo", 3),
+        ("SELECT MAX(STRPOS('hello', 'll') + l1 * 0) FROM foo", 3),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+    # native expression semantics preserved (0-based / -1)
+    from druid_tpu.utils.expression import parse_expression
+    from druid_tpu.utils.expression import rewrite_string_sites, lut_for_site
+    expr, sites = rewrite_string_sites(
+        parse_expression("strpos(d, 'b')"), {"d"})
+    lut = lut_for_site(sites[0], ["abc", "xyz"])
+    assert lut.tolist() == [1, -1]
