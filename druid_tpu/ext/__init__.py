@@ -22,6 +22,7 @@ from druid_tpu.ext.hllsketch import (HLLSketchBuildAggregator,
 from druid_tpu.ext.protobuf_parser import ProtobufInputRowParser
 from druid_tpu.ext.time_minmax import (TimeMaxAggregator, TimeMinAggregator)
 from druid_tpu.ext.namespace_lookup import load_uri_namespace
+from druid_tpu.ext.distinctcount import DistinctCountAggregator
 
 __all__ = [
     "HLLSketchBuildAggregator", "HLLSketchMergeAggregator",
@@ -32,6 +33,6 @@ __all__ = [
     "QuantilesPostAgg", "ApproximateHistogramAggregator", "HistogramValue",
     "HistogramQuantilePostAgg", "BloomFilterAggregator", "BloomFilterValue",
     "ProtobufInputRowParser", "TimeMinAggregator", "TimeMaxAggregator",
-    "load_uri_namespace",
+    "load_uri_namespace", "DistinctCountAggregator",
     "BloomDimFilter",
 ]
