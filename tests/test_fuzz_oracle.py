@@ -342,3 +342,28 @@ def test_fuzz_sharded_mesh_matches_local(case, segments, frames):
     mesh = make_mesh()
     sharded = QueryExecutor(segments, mesh=mesh).run(q)
     assert _norm(sharded) == _norm(local), (case, q.query_type)
+
+
+@pytest.mark.parametrize("case", range(8))
+def test_fuzz_disjoint_intervals_broker_and_mesh(case, segments, frames,
+                                                 fuzz_cluster):
+    """Random DISJOINT sub-intervals: interval clamping and bucket index
+    spaces must agree across local, broker-merged, and sharded-mesh
+    execution."""
+    from druid_tpu.parallel import make_mesh
+    rng = np.random.default_rng(40_000 + case)
+    flt, _ = _rand_filter(rng, frames)
+    specs, _ = _rand_aggs(rng)
+    DAY_MS = 86_400_000
+    # two disjoint day-aligned windows inside the week
+    a = int(rng.integers(0, 3))
+    b = int(rng.integers(a + 2, 7))
+    ivs = [Interval(WEEK.start + a * DAY_MS, WEEK.start + (a + 1) * DAY_MS),
+           Interval(WEEK.start + b * DAY_MS,
+                    WEEK.start + min(b + 2, 7) * DAY_MS)]
+    gran = ["all", "day"][int(rng.integers(0, 2))]
+    q = TimeseriesQuery.of("test", ivs, specs, granularity=gran, filter=flt)
+    local = QueryExecutor(segments).run(q)
+    assert _norm(fuzz_cluster.run(q)) == _norm(local), ("broker", case)
+    sharded = QueryExecutor(segments, mesh=make_mesh()).run(q)
+    assert _norm(sharded) == _norm(local), ("mesh", case)
