@@ -201,21 +201,51 @@ def cmd_coordinator(args) -> int:
     for i, url in enumerate(args.data_node or []):
         view.register(RemoteDataNodeClient(f"data{i}", url))
     view.sync_all()
+    leader = None
+    if args.ha:
+        # leader-elected HA: several coordinator processes share one
+        # metadata file; the lease latch picks one, the rest stand by
+        if args.metadata == ":memory:":
+            # a private in-memory store per process = every process wins
+            # its own election — the exact split-brain HA exists to prevent
+            raise SystemExit(
+                "--ha needs a SHARED lease store: pass --metadata "
+                "/path/to/metadata.db (':memory:' is per-process)")
+        from druid_tpu.coordination import (LeaderParticipant,
+                                            MetadataLeaseStore)
+        import socket
+        node_id = args.node_id or f"{socket.gethostname()}-{id(view):x}"
+        leader = LeaderParticipant(
+            MetadataLeaseStore(metadata), "coordinator", node_id,
+            lease_ms=args.lease_ms).start()
     coord = Coordinator(metadata, view, deep.pull, DynamicConfig(),
-                        async_loading=True)
+                        async_loading=True, leader=leader)
     print(f"coordinator running (period {args.period}s, "
-          f"{len(args.data_node or [])} node(s))", flush=True)
+          f"{len(args.data_node or [])} node(s)"
+          + (f", HA node [{leader.node_id}]" if leader else "") + ")",
+          flush=True)
+    from druid_tpu.cluster import StaleTermError
     try:
         while True:
-            stats = coord.run_once()
-            _reregister_missing(view, args.data_node or [])
-            view.sync_all()
+            try:
+                stats = coord.run_once()
+            except StaleTermError as e:
+                # deposed mid-cycle: the successor holds the term now —
+                # drop back to standby and keep heartbeating, don't die
+                print(f"deposed mid-cycle, standing by: {e}", flush=True)
+                time.sleep(args.period)
+                continue
+            if not stats.skipped_not_leader:
+                _reregister_missing(view, args.data_node or [])
+                view.sync_all()
             if stats.assigned or stats.dropped or stats.nodes_removed:
                 print(f"cycle: assigned={stats.assigned} "
                       f"dropped={stats.dropped} "
                       f"dead={stats.nodes_removed}", flush=True)
             time.sleep(args.period)
     except KeyboardInterrupt:
+        if leader is not None:
+            leader.stop()       # release the lease for fast failover
         coord.stop()
         return 0
 
@@ -340,6 +370,13 @@ def main(argv=None) -> int:
     s.add_argument("--storage-dir", default="./deep-storage")
     s.add_argument("--data-node", action="append")
     s.add_argument("--period", type=float, default=10.0)
+    s.add_argument("--ha", action="store_true",
+                   help="leader-elected HA over the shared metadata store")
+    s.add_argument("--node-id", default=None,
+                   help="this coordinator's latch identity (default: "
+                        "hostname-derived)")
+    s.add_argument("--lease-ms", type=int, default=15_000,
+                   help="leader lease duration; failover bound")
     s.set_defaults(fn=cmd_coordinator)
 
     s = sub.add_parser("router", help="run the query router")

@@ -7,7 +7,8 @@ from druid_tpu.cluster.coordinator import (Coordinator, DynamicConfig,
                                            IntervalDropRule, IntervalLoadRule,
                                            PeriodDropRule, PeriodLoadRule,
                                            rule_from_json)
-from druid_tpu.cluster.metadata import MetadataStore, SegmentDescriptor
+from druid_tpu.cluster.metadata import (MetadataStore, SegmentDescriptor,
+                                        StaleTermError)
 from druid_tpu.cluster.shardspec import (HashBasedNumberedShardSpec,
                                          LinearShardSpec, NoneShardSpec,
                                          NumberedShardSpec, ShardSpec,
@@ -27,7 +28,8 @@ __all__ = [
     "HashBasedNumberedShardSpec", "SingleDimensionShardSpec",
     "shardspec_from_json", "PartitionChunk", "PartitionHolder",
     "TimelineObjectHolder", "VersionedIntervalTimeline",
-    "MetadataStore", "SegmentDescriptor", "DataNode", "InventoryView",
+    "MetadataStore", "SegmentDescriptor", "StaleTermError", "DataNode",
+    "InventoryView",
     "descriptor_for", "Broker", "MissingSegmentsError", "LruCache",
     "Cache", "HybridCache", "RemoteCacheClient", "RemoteCacheServer",
     "CacheConfig", "Coordinator", "DynamicConfig", "ForeverLoadRule",

@@ -16,9 +16,12 @@ etag mechanism).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from collections import OrderedDict
 from typing import Dict, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
 
 
 class CacheStats:
@@ -27,6 +30,8 @@ class CacheStats:
         self.misses = 0
         self.evictions = 0
         self.puts = 0
+        #: puts a remote tier refused to ship (value not wire-serializable)
+        self.dropped_puts = 0
 
 
 class Cache:
@@ -124,13 +129,24 @@ class HybridCache(Cache):
 
 
 class RemoteCacheServer:
-    """Shared cache node: the memcached role. Length-prefixed pickle frames
-    over TCP — acceptable only on a trusted intra-cluster link, exactly
-    like memcached's own transcoded object protocol."""
+    """Shared cache node: the memcached role. Length-prefixed JSON frames
+    over TCP — data-only on the wire, so a peer that can reach the port
+    can at worst poison cache entries, never execute code (the pickle
+    frames this replaces were arbitrary-code-execution for anyone who
+    could connect). Values that do not JSON-serialize are dropped by the
+    client's put (a cache is allowed to forget)."""
 
     def __init__(self, max_entries: int = 100_000, port: int = 0,
                  host: str = "127.0.0.1"):
         import socketserver
+
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            # loud by design: there is no authentication on this protocol
+            log.warning(
+                "RemoteCacheServer binding to NON-LOOPBACK host %r — the "
+                "cache protocol is unauthenticated; anyone who can reach "
+                "this port can read and poison cache entries. Bind to "
+                "127.0.0.1 or firewall the port to the cluster.", host)
 
         store = LruCache(max_entries)
         self.store = store
@@ -153,7 +169,10 @@ class RemoteCacheServer:
                         else:
                             out = {"error": f"bad op {op!r}"}
                         _send_frame(self.request, out)
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError):
+                    # ValueError covers malformed frames (non-JSON bytes —
+                    # e.g. a legacy/hostile pickle payload): drop the
+                    # connection, never interpret the bytes
                     return
 
         class Server(socketserver.ThreadingTCPServer):
@@ -185,8 +204,9 @@ class RemoteCacheClient(Cache):
         self.stats = CacheStats()
         self._sock = None
         self._lock = threading.Lock()
+        self._warned_drop = False
 
-    def _call(self, req: dict):
+    def _call(self, req):
         import socket
         with self._lock:
             try:
@@ -195,7 +215,10 @@ class RemoteCacheClient(Cache):
                         (self.host, self.port), timeout=self.timeout)
                 _send_frame(self._sock, req)
                 return _recv_frame(self._sock)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, ValueError):
+                # ValueError: non-JSON reply (legacy/misbehaving peer) —
+                # the stream is desynced, so drop the socket; like any
+                # failure here it degrades to a miss, never a query error
                 try:
                     if self._sock is not None:
                         self._sock.close()
@@ -213,8 +236,26 @@ class RemoteCacheClient(Cache):
         return v
 
     def put(self, namespace, key, value):
-        self._call({"op": "put", "ns": namespace, "key": key,
-                    "value": value})
+        try:
+            # encode ONCE: serializability probe and wire bytes in one go
+            payload = _encode_frame({"op": "put", "ns": namespace,
+                                     "key": key, "value": value})
+        except (TypeError, ValueError):
+            # non-JSON-serializable value (e.g. device partial states):
+            # drop the put — remote tiers carry data-only entries. Counted
+            # (and logged once) so a pure-remote deployment whose values
+            # never serialize shows WHY its hit rate is zero, instead of
+            # silently recomputing everything forever.
+            self.stats.dropped_puts += 1
+            if not self._warned_drop:
+                self._warned_drop = True
+                log.warning(
+                    "remote cache dropping non-serializable puts (first: "
+                    "namespace %r, %s) — these entries only cache in a "
+                    "local tier; see CacheStats.dropped_puts", namespace,
+                    type(value).__name__)
+            return
+        self._call(payload)
         self.stats.puts += 1
 
     def invalidate_namespace(self, namespace):
@@ -230,24 +271,48 @@ class RemoteCacheClient(Cache):
                     self._sock = None
 
 
+#: refuse absurd frames before allocating for them (a hostile peer on the
+#: unauthenticated port must not be able to OOM the process with a header)
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
+def _frame_json_default(obj):
+    """Data-only lowering for the wire: numpy scalars/arrays become plain
+    JSON numbers/lists (the only non-builtin types result rows carry).
+    Anything else is a TypeError — the put is then dropped client-side."""
+    import numpy as np
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(f"not cacheable over the wire: {type(obj).__name__}")
+
+
+def _encode_frame(obj) -> bytes:
+    return json.dumps(obj, default=_frame_json_default).encode()
+
+
 def _send_frame(sock, obj) -> None:
-    import pickle
+    """`obj` may be pre-encoded bytes (a caller that already probed
+    serializability) or any JSON-able value."""
     import struct
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = obj if isinstance(obj, bytes) else _encode_frame(obj)
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
 def _recv_frame(sock):
-    import pickle
     import struct
     hdr = _recv_exact(sock, 4)
     if hdr is None:
         return None
     (n,) = struct.unpack(">I", hdr)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"cache frame of {n} bytes exceeds the "
+                              f"{MAX_FRAME_BYTES}-byte bound")
     body = _recv_exact(sock, n)
     if body is None:
         return None
-    return pickle.loads(body)
+    return json.loads(body.decode())
 
 
 def _recv_exact(sock, n: int):

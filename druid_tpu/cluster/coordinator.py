@@ -188,17 +188,25 @@ class CoordinatorStats:
     deleted: int = 0
     unassigned: int = 0
     nodes_removed: int = 0
+    #: True when the cycle was a no-op because this node is not the leader
+    skipped_not_leader: bool = False
+    #: fencing term the cycle's writes carried (-1 when unfenced)
+    leader_term: int = -1
 
 
 class Coordinator:
-    """Single-leader control loop (leadership election is trivial in-process;
-    multi-coordinator HA would take the same leader-latch approach as the
-    reference's CuratorDruidLeaderSelector)."""
+    """Leader-elected control loop. With a `leader` participant attached
+    (coordination.LeaderParticipant — the CuratorDruidLeaderSelector
+    analog) the duty cycle runs ONLY while holding the lease, and every
+    metadata write carries the lease's fencing term so a deposed
+    coordinator's in-flight writes are rejected by the store
+    (StaleTermError) instead of corrupting its successor's state."""
 
     def __init__(self, metadata: MetadataStore, view: InventoryView,
                  segment_source: Callable[[SegmentDescriptor], Segment],
                  config: Optional[DynamicConfig] = None,
-                 async_loading: bool = False):
+                 async_loading: bool = False,
+                 leader=None):
         """async_loading=True assigns loads through per-server
         LoadQueuePeons (bounded queues, background workers) instead of
         blocking the cycle on each segment pull — the reference's
@@ -209,7 +217,11 @@ class Coordinator:
         self.segment_source = segment_source
         self.config = config or DynamicConfig()
         self.async_loading = async_loading
+        self.leader = leader
         self._peons: Dict[str, "LoadQueuePeon"] = {}
+
+    def _fence(self) -> Optional[tuple]:
+        return self.leader.fence() if self.leader is not None else None
 
     def _peon_for(self, node: DataNode) -> "LoadQueuePeon":
         from druid_tpu.cluster.loadqueue import LoadQueuePeon
@@ -232,6 +244,14 @@ class Coordinator:
     def run_once(self, now_ms: Optional[int] = None) -> CoordinatorStats:
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         stats = CoordinatorStats()
+        if self.leader is not None:
+            # duty loops idle entirely on non-leaders — not even liveness
+            # probes run, so a standby is invisible to the cluster until
+            # promoted (DruidCoordinator.coordinatorLeaderSelector gating)
+            if not self.leader.is_leader():
+                stats.skipped_not_leader = True
+                return stats
+            stats.leader_term = self.leader.term
         # failure detection first: dead servers leave the view (their
         # announcements retract), so this same cycle's rule run sees the
         # replica deficit and re-replicates from deep storage
@@ -267,7 +287,8 @@ class Coordinator:
             for holder in tl.find_fully_overshadowed():
                 doomed += [c.obj.id for c in holder.partitions]
             if doomed:
-                stats.overshadowed_marked += self.metadata.mark_unused(doomed)
+                stats.overshadowed_marked += self.metadata.mark_unused(
+                    doomed, fence=self._fence())
 
     # ---- rules ----------------------------------------------------------
     def _rules_for(self, datasource: str) -> List[Rule]:
@@ -472,4 +493,4 @@ class Coordinator:
                 "SELECT id FROM segments WHERE used = 0 AND datasource = ?",
                 (datasource,))
             ids = [r[0] for r in cur.fetchall()]
-        return self.metadata.delete_segments(ids)
+        return self.metadata.delete_segments(ids, fence=self._fence())

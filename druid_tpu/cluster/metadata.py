@@ -31,6 +31,12 @@ class SegmentAllocationError(RuntimeError):
     committed segments (SegmentAllocateAction returns null there)."""
 
 
+class StaleTermError(RuntimeError):
+    """A fenced write carried a term older than the current lease term:
+    the writer's lease was taken over and it must stop acting as leader
+    (the fencing-token rejection of a zombie leader's writes)."""
+
+
 @dataclass(frozen=True)
 class SegmentDescriptor:
     """DataSegment analog (api/.../timeline/DataSegment.java): identity +
@@ -110,21 +116,150 @@ class MetadataStore:
               start INTEGER NOT NULL, end INTEGER NOT NULL,
               version TEXT NOT NULL, partition_num INTEGER NOT NULL,
               created_ms INTEGER NOT NULL);
+            CREATE TABLE IF NOT EXISTS leases (
+              service TEXT PRIMARY KEY, holder TEXT NOT NULL,
+              term INTEGER NOT NULL, expires_ms INTEGER NOT NULL,
+              meta TEXT);
+            CREATE TABLE IF NOT EXISTS fence_log (
+              id INTEGER PRIMARY KEY AUTOINCREMENT, service TEXT NOT NULL,
+              term INTEGER NOT NULL, holder TEXT NOT NULL, op TEXT NOT NULL,
+              created_ms INTEGER NOT NULL);
             """)
+
+    # ---- leader leases (coordination source of truth) -------------------
+    def try_acquire_lease(self, service: str, holder: str, now_ms: int,
+                          lease_ms: int, meta: Optional[dict] = None
+                          ) -> Optional[Tuple[int, int]]:
+        """Atomic acquire-or-renew of the leader lease for `service`.
+        Returns (term, expires_ms) when `holder` holds the lease after this
+        call, None when another holder's unexpired lease blocks it.
+
+        The term is the fencing token: it increments on every ownership
+        change (including re-acquiring one's own EXPIRED lease — the gap may
+        have admitted another writer), and stays fixed across renewals of a
+        live lease. Writes fenced with an old term are rejected by
+        check_fence even if the zombie still believes it leads."""
+        expires = now_ms + lease_ms
+        m = json.dumps(meta, sort_keys=True) if meta is not None else None
+        with self._lock:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                cur = self._conn.execute(
+                    "SELECT holder, term, expires_ms FROM leases "
+                    "WHERE service = ?", (service,))
+                row = cur.fetchone()
+                if row is None:
+                    self._conn.execute(
+                        "INSERT INTO leases(service, holder, term, "
+                        "expires_ms, meta) VALUES(?,?,1,?,?)",
+                        (service, holder, expires, m))
+                    self._conn.execute("COMMIT")
+                    return 1, expires
+                cur_holder, term, cur_expires = row
+                if cur_holder == holder and now_ms < cur_expires:
+                    # renewal of a live lease: same term
+                    self._conn.execute(
+                        "UPDATE leases SET expires_ms = ?, meta = ? "
+                        "WHERE service = ?", (expires, m, service))
+                    self._conn.execute("COMMIT")
+                    return int(term), expires
+                if now_ms < cur_expires:
+                    self._conn.execute("ROLLBACK")
+                    return None            # someone else holds it, live
+                # expired: takeover (by anyone, incl. the old holder)
+                self._conn.execute(
+                    "UPDATE leases SET holder = ?, term = term + 1, "
+                    "expires_ms = ?, meta = ? WHERE service = ?",
+                    (holder, expires, m, service))
+                self._conn.execute("COMMIT")
+                return int(term) + 1, expires
+            except BaseException:
+                try:
+                    self._conn.execute("ROLLBACK")
+                except sqlite3.OperationalError:
+                    pass
+                raise
+
+    def read_lease(self, service: str) -> Optional[dict]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT holder, term, expires_ms, meta FROM leases "
+                "WHERE service = ?", (service,))
+            row = cur.fetchone()
+            if row is None:
+                return None
+            return {"service": service, "holder": row[0], "term": int(row[1]),
+                    "expiresMs": int(row[2]),
+                    "meta": json.loads(row[3]) if row[3] else None}
+
+    def release_lease(self, service: str, holder: str) -> bool:
+        """Voluntary step-down (graceful shutdown): only the current holder
+        may release; the row stays (term history preserved) but expires
+        immediately so any standby's next heartbeat takes over."""
+        with self._lock, self._conn as c:
+            return c.execute(
+                "UPDATE leases SET expires_ms = 0 WHERE service = ? AND "
+                "holder = ?", (service, holder)).rowcount > 0
+
+    def _check_fence_locked(self, fence: Tuple[str, int, str],
+                            op: str, now_ms: int) -> None:
+        """Validate (service, term, holder) against the lease row and log
+        the accepted write — caller holds self._lock and an open txn (or
+        the implicit one of `with self._conn`). Raises StaleTermError when
+        the term is not the CURRENT term of the service's lease."""
+        service, term, holder = fence
+        cur = self._conn.execute(
+            "SELECT holder, term FROM leases WHERE service = ?", (service,))
+        row = cur.fetchone()
+        if row is None:
+            raise StaleTermError(
+                f"fenced write [{op}] for service [{service}] but no lease "
+                f"exists — writer [{holder}] was never elected")
+        cur_holder, cur_term = row[0], int(row[1])
+        if term != cur_term or holder != cur_holder:
+            raise StaleTermError(
+                f"stale fencing term for [{service}]: write [{op}] from "
+                f"[{holder}] term {term} rejected — current leader is "
+                f"[{cur_holder}] term {cur_term}")
+        self._conn.execute(
+            "INSERT INTO fence_log(service, term, holder, op, created_ms) "
+            "VALUES(?,?,?,?,?)", (service, term, holder, op, now_ms))
+
+    def fence_log(self, service: Optional[str] = None) -> List[dict]:
+        """Accepted fenced writes, oldest first — the audit trail the
+        single-writer-per-term safety tests assert over."""
+        with self._lock:
+            q = ("SELECT service, term, holder, op, created_ms FROM "
+                 "fence_log")
+            args: tuple = ()
+            if service is not None:
+                q += " WHERE service = ?"
+                args = (service,)
+            return [{"service": r[0], "term": int(r[1]), "holder": r[2],
+                     "op": r[3], "created": int(r[4])}
+                    for r in self._conn.execute(q + " ORDER BY id", args)]
 
     # ---- segments ------------------------------------------------------
     def publish_segments(self, descriptors: Sequence[SegmentDescriptor],
-                         datasource_meta_update: Optional[Tuple[str, Optional[dict], dict]] = None
+                         datasource_meta_update: Optional[Tuple[str, Optional[dict], dict]] = None,
+                         fence: Optional[Tuple[str, int, str]] = None
                          ) -> bool:
         """Transactionally insert segments; optionally CAS the datasource
         commit metadata (start_metadata → end_metadata) in the SAME
         transaction — the exactly-once publish of
         IndexerSQLMetadataStorageCoordinator.announceHistoricalSegments.
-        Returns False (and commits nothing) if the CAS comparison fails."""
+        Returns False (and commits nothing) if the CAS comparison fails.
+
+        fence: optional (service, term, holder) fencing token — the write
+        commits only if `term` is still the service's CURRENT lease term
+        (StaleTermError otherwise), in the same transaction, so a deposed
+        leader cannot race a commit past its successor's takeover."""
         now = int(time.time() * 1000)
         with self._lock:
             try:
                 self._conn.execute("BEGIN IMMEDIATE")
+                if fence is not None:
+                    self._check_fence_locked(fence, "publish_segments", now)
                 if datasource_meta_update is not None:
                     ds, expected, new = datasource_meta_update
                     cur = self._conn.execute(
@@ -172,16 +307,24 @@ class MetadataStore:
             return [SegmentDescriptor.from_json(json.loads(r[0]))
                     for r in cur.fetchall()]
 
-    def mark_unused(self, segment_ids: Sequence[str]) -> int:
+    def mark_unused(self, segment_ids: Sequence[str],
+                    fence: Optional[Tuple[str, int, str]] = None) -> int:
         with self._lock, self._conn as c:
+            if fence is not None:
+                self._check_fence_locked(fence, "mark_unused",
+                                         int(time.time() * 1000))
             n = 0
             for sid in segment_ids:
                 n += c.execute("UPDATE segments SET used = 0 WHERE id = ?",
                                (sid,)).rowcount
             return n
 
-    def mark_used(self, segment_ids: Sequence[str]) -> int:
+    def mark_used(self, segment_ids: Sequence[str],
+                  fence: Optional[Tuple[str, int, str]] = None) -> int:
         with self._lock, self._conn as c:
+            if fence is not None:
+                self._check_fence_locked(fence, "mark_used",
+                                         int(time.time() * 1000))
             n = 0
             for sid in segment_ids:
                 n += c.execute("UPDATE segments SET used = 1 WHERE id = ?",
@@ -198,9 +341,13 @@ class MetadataStore:
                  descriptor.id)).rowcount
             return n > 0
 
-    def delete_segments(self, segment_ids: Sequence[str]) -> int:
+    def delete_segments(self, segment_ids: Sequence[str],
+                        fence: Optional[Tuple[str, int, str]] = None) -> int:
         """Permanent removal (the kill-task step after mark_unused)."""
         with self._lock, self._conn as c:
+            if fence is not None:
+                self._check_fence_locked(fence, "delete_segments",
+                                         int(time.time() * 1000))
             n = 0
             for sid in segment_ids:
                 n += c.execute("DELETE FROM segments WHERE id = ?",
@@ -419,15 +566,24 @@ class MetadataStore:
 
     # ---- tasks / supervisors (used by the indexing service) ------------
     def insert_task(self, task_id: str, datasource: str, status: str,
-                    payload: dict) -> None:
+                    payload: dict,
+                    fence: Optional[Tuple[str, int, str]] = None) -> None:
         with self._lock, self._conn as c:
+            now = int(time.time() * 1000)
+            if fence is not None:
+                self._check_fence_locked(fence, "insert_task", now)
             c.execute("INSERT OR REPLACE INTO tasks(id, datasource, status, "
                       "created_ms, payload) VALUES(?,?,?,?,?)",
-                      (task_id, datasource, status, int(time.time() * 1000),
+                      (task_id, datasource, status, now,
                        json.dumps(payload)))
 
-    def update_task_status(self, task_id: str, status: str) -> None:
+    def update_task_status(self, task_id: str, status: str,
+                           fence: Optional[Tuple[str, int, str]] = None
+                           ) -> None:
         with self._lock, self._conn as c:
+            if fence is not None:
+                self._check_fence_locked(fence, "update_task_status",
+                                         int(time.time() * 1000))
             c.execute("UPDATE tasks SET status = ? WHERE id = ?",
                       (status, task_id))
 

@@ -1,0 +1,21 @@
+"""HA coordination: lease-based leader election, fencing, discovery.
+
+The control-plane counterpart of the reference's DruidLeaderSelector /
+CuratorDruidLeaderSelector / DruidLeaderClient triple, backed by the SQL
+metadata store instead of ZooKeeper, with fencing terms enforced at the
+metadata-write layer and a chaos harness for failover testing.
+"""
+from druid_tpu.coordination.chaos import (ChaosHarness, ManualClock,
+                                          PartitionedError)
+from druid_tpu.coordination.discovery import LeaderClient, NoLeaderError
+from druid_tpu.coordination.latch import (LeaderLease, LeaderMonitor,
+                                          LeaderParticipant, LeaseStore,
+                                          MetadataLeaseStore, NotLeaderError,
+                                          StaleTermError)
+
+__all__ = [
+    "ChaosHarness", "ManualClock", "PartitionedError",
+    "LeaderClient", "NoLeaderError",
+    "LeaderLease", "LeaderMonitor", "LeaderParticipant", "LeaseStore",
+    "MetadataLeaseStore", "NotLeaderError", "StaleTermError",
+]

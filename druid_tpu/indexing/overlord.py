@@ -12,16 +12,20 @@ Reference analogs (indexing-service/.../overlord/):
 """
 from __future__ import annotations
 
+import logging
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
-from druid_tpu.cluster.metadata import MetadataStore, SegmentDescriptor
+from druid_tpu.cluster.metadata import (MetadataStore, SegmentDescriptor,
+                                        StaleTermError)
 from druid_tpu.data.segment import Segment
 from druid_tpu.indexing.locks import TaskLock, TaskLockbox
 from druid_tpu.indexing.task import Task, TaskStatus
 from druid_tpu.storage.deep import DeepStorage, InMemoryDeepStorage
 from druid_tpu.utils.intervals import Interval, condense
+
+log = logging.getLogger(__name__)
 
 
 class TaskToolbox:
@@ -30,11 +34,17 @@ class TaskToolbox:
     tasks) the task runner to fan sub-tasks out on."""
 
     def __init__(self, metadata: MetadataStore, lockbox: TaskLockbox,
-                 deep_storage: DeepStorage, task_runner=None):
+                 deep_storage: DeepStorage, task_runner=None,
+                 fence_source: Optional[Callable[[], Optional[tuple]]] = None):
+        """fence_source: supplies the overlord's CURRENT (service, term,
+        holder) fencing token at publish time — read late, not captured at
+        toolbox construction, so a task that outlives a leadership change
+        publishes with the stale term and is rejected."""
         self.metadata = metadata
         self.lockbox = lockbox
         self.deep_storage = deep_storage
         self.task_runner = task_runner
+        self.fence_source = fence_source
 
     def lock(self, task: Task, intervals: Sequence[Interval],
              lock_type=None) -> Optional[TaskLock]:
@@ -65,42 +75,71 @@ class TaskToolbox:
         """SegmentTransactionalInsertAction: the revocation check and the
         metadata commit run in one lockbox critical section so a revoke
         cannot interleave between them (TaskLockbox.doInCriticalSection)."""
+        fence = self.fence_source() if self.fence_source is not None else None
         return self.lockbox.critical_section(
-            task.id, lambda: self.metadata.publish_segments(descriptors))
+            task.id, lambda: self.metadata.publish_segments(descriptors,
+                                                            fence=fence))
 
 
 class Overlord:
-    """Task queue + local thread runner + status persistence."""
+    """Task queue + local thread runner + status persistence.
+
+    With a `leader` participant attached (coordination.LeaderParticipant —
+    the TaskMaster leadership gating) task submission is accepted ONLY on
+    the leader (NotLeaderError carries the leader's URL for redirect), and
+    every task-metadata write and segment publish is fenced with the
+    current term, so tasks started under a deposed overlord cannot commit
+    past its successor's takeover."""
 
     def __init__(self, metadata: MetadataStore,
                  deep_storage: Optional[DeepStorage] = None,
-                 max_workers: int = 4):
+                 max_workers: int = 4, leader=None):
         self.metadata = metadata
         self.deep_storage = deep_storage or InMemoryDeepStorage()
         self.lockbox = TaskLockbox()
+        self.leader = leader
         self._pool = ThreadPoolExecutor(max_workers=max_workers)
         self._futures: Dict[str, Future] = {}
         self._statuses: Dict[str, TaskStatus] = {}
         self._lock = threading.Lock()
         self._listeners: List[Callable[[TaskStatus], None]] = []
 
+    def _fence(self) -> Optional[tuple]:
+        return self.leader.fence() if self.leader is not None else None
+
+    def _require_leader(self) -> None:
+        if self.leader is not None and not self.leader.is_leader():
+            from druid_tpu.coordination.latch import NotLeaderError
+            url = None
+            try:
+                lease = self.leader.store.read(self.leader.service)
+                if lease is not None:
+                    url = lease.url
+            except Exception:
+                pass
+            raise NotLeaderError(
+                f"overlord [{self.leader.node_id}] is not the leader",
+                leader_url=url)
+
     def toolbox(self) -> TaskToolbox:
         # sub-tasks get DEDICATED threads: a supervisor task blocks one of
         # the bounded pool's workers while awaiting its sub-tasks, so
         # scheduling those on the same pool deadlocks under exhaustion
         return TaskToolbox(self.metadata, self.lockbox, self.deep_storage,
-                           task_runner=_DedicatedSubtaskRunner(self))
+                           task_runner=_DedicatedSubtaskRunner(self),
+                           fence_source=self._fence)
 
     def add_listener(self, fn: Callable[[TaskStatus], None]) -> None:
         self._listeners.append(fn)
 
     # ---- submission -----------------------------------------------------
     def submit(self, task: Task) -> str:
+        self._require_leader()
         with self._lock:
             if task.id in self._futures:
                 return task.id
             self.metadata.insert_task(task.id, task.datasource, "RUNNING",
-                                      task.to_json())
+                                      task.to_json(), fence=self._fence())
             self._statuses[task.id] = TaskStatus(task.id, "RUNNING")
             self._futures[task.id] = self._pool.submit(self._run, task)
             return task.id
@@ -114,7 +153,13 @@ class Overlord:
             self.lockbox.release_all(task.id)
         with self._lock:
             self._statuses[task.id] = status
-        self.metadata.update_task_status(task.id, status.state)
+        try:
+            self.metadata.update_task_status(task.id, status.state,
+                                             fence=self._fence())
+        except StaleTermError as e:
+            # a deposed overlord may not record statuses — its successor
+            # re-adopts the task row; in-memory status stands
+            log.warning("status write for [%s] fenced off: %s", task.id, e)
         for fn in list(self._listeners):
             fn(status)
         return status
@@ -152,7 +197,8 @@ class _DedicatedSubtaskRunner:
         if task.id in self._threads:
             return task.id
         self.overlord.metadata.insert_task(task.id, task.datasource,
-                                           "RUNNING", task.to_json())
+                                           "RUNNING", task.to_json(),
+                                           fence=self.overlord._fence())
 
         def run():
             self._results[task.id] = self.overlord._run(task)

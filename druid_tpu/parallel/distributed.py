@@ -445,7 +445,12 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
                       kernels: List[AggKernel], vc_plans: Tuple):
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map          # jax >= 0.5
+        _check_kw = "check_vma"
+    except ImportError:                    # 0.4.x: experimental home,
+        from jax.experimental.shard_map import shard_map
+        _check_kw = "check_rep"            # and the old replication-check kw
     from jax.sharding import PartitionSpec as P
 
     bucket_mode = spec.bucket_mode
@@ -504,5 +509,5 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     f = shard_map(body, mesh=mesh,
                   in_specs=(P(axis, None), P(axis), P(axis, None, None),
                             P(axis), P()),
-                  out_specs=(P(), P()), check_vma=not has_fold)
+                  out_specs=(P(), P()), **{_check_kw: not has_fold})
     return jax.jit(f)

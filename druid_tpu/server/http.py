@@ -47,14 +47,33 @@ class QueryHttpServer:
 
     def __init__(self, lifecycle: QueryLifecycle, sql_executor=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 auth_chain=None):
+                 auth_chain=None, coordination=None, overlord=None):
         """auth_chain: optional server.security.AuthChain — requests
         authenticate at the HTTP boundary (401 on failure) and the
         resulting AuthenticationResult flows into the lifecycle, whose
-        authorizer makes the per-datasource decision (403)."""
+        authorizer makes the per-datasource decision (403).
+
+        coordination: optional {"coordinator"|"overlord":
+        LeaderParticipant} — adds the leader discovery endpoints
+        (/druid/coordinator/v1/leader, .../isLeader and the indexer
+        equivalents) and the DruidLeaderClient redirect contract: any
+        other coordinator/overlord API request on a NON-leader answers
+        307 with Location on the current leader (503 while no leader is
+        live). overlord: the local Overlord — leader-only task submission
+        (POST /druid/indexer/v1/task) and status reads serve from it."""
         self.lifecycle = lifecycle
         self.sql_executor = sql_executor
         self.auth_chain = auth_chain
+        self.coordination = coordination or {}
+        self.overlord = overlord
+        # one lease-liveness reader per hosted service — the SAME
+        # expiry/None semantics clients use (no duplicated logic here)
+        self._leader_clients = {}
+        if self.coordination:
+            from druid_tpu.coordination.discovery import LeaderClient
+            self._leader_clients = {
+                svc: LeaderClient(p.store, p.service, clock=p.clock)
+                for svc, p in self.coordination.items()}
         self.avatica = None
         if sql_executor is not None:
             from druid_tpu.server.avatica import AvaticaServer
@@ -95,6 +114,81 @@ class QueryHttpServer:
                     return False
                 return True
 
+            # ---- coordination (leader discovery + redirect) ------------
+            def _leader_lease(self, service: str):
+                """The current UNEXPIRED lease, or None (mid-election /
+                store unreachable) — read through the same LeaderClient
+                semantics redirecting clients use."""
+                return outer._leader_clients[service].leader()
+
+            def _redirect_to_leader(self, service: str) -> None:
+                """307 on the live leader (DruidLeaderClient contract);
+                503 while no leader is live — clients retry, they never
+                get a non-leader's answer."""
+                lease = self._leader_lease(service)
+                if lease is None or not lease.url:
+                    self._reply(503, {"error": "no live leader for "
+                                      f"[{service}]"})
+                    return
+                self.send_response(307)
+                self.send_header("Location",
+                                 lease.url.rstrip("/") + self.path)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _handle_coordination(self, service: str, payload) -> None:
+                """One coordinator/overlord API request (payload None for
+                GET). Leader/isLeader serve everywhere; everything else
+                redirects off non-leaders."""
+                p = outer.coordination[service]
+                prefix = ("/druid/coordinator/v1" if service == "coordinator"
+                          else "/druid/indexer/v1")
+                sub = self.path.rstrip("/")[len(prefix):]
+                if sub == "/leader":
+                    lease = self._leader_lease(service)
+                    if lease is None:
+                        self._reply(503, {"error": "no live leader for "
+                                          f"[{p.service}]"})
+                    else:
+                        self._reply(200, {"leader": lease.url,
+                                          "term": lease.term,
+                                          "holder": lease.holder})
+                    return
+                if sub == "/isLeader":
+                    # Druid's semantics: 200 on the leader, 404 elsewhere
+                    code = 200 if p.is_leader() else 404
+                    self._reply(code, {"leader": p.is_leader()})
+                    return
+                if not p.is_leader():
+                    self._redirect_to_leader(service)
+                    return
+                if service == "overlord" and outer.overlord is not None:
+                    from druid_tpu.coordination.latch import NotLeaderError
+                    if sub == "/task" and payload is not None:
+                        from druid_tpu.indexing.task import task_from_json
+                        try:
+                            tid = outer.overlord.submit(
+                                task_from_json(payload))
+                        except NotLeaderError:
+                            # deposed between is_leader() and submit()
+                            self._redirect_to_leader(service)
+                            return
+                        self._reply(200, {"task": tid})
+                        return
+                    if sub.startswith("/task/") and sub.endswith("/status") \
+                            and payload is None:
+                        tid = sub[len("/task/"):-len("/status")]
+                        st = outer.overlord.status(tid)
+                        if st is None:
+                            self._reply(404,
+                                        {"error": f"unknown task {tid!r}"})
+                        else:
+                            self._reply(200, {"task": tid,
+                                              "status": st.state})
+                        return
+                self._reply(404, {"error": "unknown path", "leader": True,
+                                  "term": p.term, "node": p.node_id})
+
             def do_GET(self):
                 if self.path == "/status":
                     self._reply(200, {"version": "druid-tpu-0.1",
@@ -103,6 +197,14 @@ class QueryHttpServer:
                                    "/druid/v2/datasources/"):
                     if self._authenticated():
                         self._reply(200, outer._datasources())
+                elif outer._coord_service(self.path) is not None:
+                    if self._authenticated():
+                        try:
+                            self._handle_coordination(
+                                outer._coord_service(self.path), None)
+                        except Exception as e:
+                            self._reply(500,
+                                        {"error": f"{type(e).__name__}: {e}"})
                 else:
                     self._reply(404, {"error": "unknown path"})
 
@@ -120,6 +222,10 @@ class QueryHttpServer:
                             self._reply(401, {"error": "unauthenticated"})
                             return
                         identity = auth
+                    svc = outer._coord_service(self.path)
+                    if svc is not None:
+                        self._handle_coordination(svc, payload)
+                        return
                     if self.path.rstrip("/") == "/druid/v2/sql/avatica":
                         if outer.avatica is None:
                             self._reply(404, {"error": "SQL not enabled"})
@@ -263,6 +369,16 @@ class QueryHttpServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _coord_service(self, path: str) -> Optional[str]:
+        """Which coordination service a path addresses (None when it is
+        not a coordination path or that service is not hosted here)."""
+        for prefix, svc in (("/druid/coordinator/v1", "coordinator"),
+                            ("/druid/indexer/v1", "overlord")):
+            if (path == prefix or path.startswith(prefix + "/")) \
+                    and svc in self.coordination:
+                return svc
+        return None
 
     def _datasources(self):
         r = self.lifecycle.runner

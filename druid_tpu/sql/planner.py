@@ -369,10 +369,23 @@ def _peel_varchar_casts(e):
     return e
 
 
-def _canonical_number(s: str) -> bool:
-    """Does this literal round-trip the numeric stringification? Only then
-    is CAST(numcol AS VARCHAR) = lit the same as numcol = number ('07'
-    and '7a' must NOT numeric-match)."""
+def _canonical_number(s: str, ctype: Optional[str] = None) -> bool:
+    """Does this literal round-trip the COLUMN TYPE's stringification?
+    Only then is CAST(numcol AS VARCHAR) = lit the same as numcol =
+    number. Long columns stringify via str(int): '7' matches, '7.0'/'07'/
+    '7a' never can. Double/float columns stringify via str(float): '7.0'
+    matches but '7' never can (the cast yields '7.0'). With no ctype,
+    either canonical form passes (pre-type-awareness callers)."""
+    if ctype == "long":
+        try:
+            return str(int(s)) == s
+        except ValueError:
+            return False
+    if ctype in ("float", "double"):
+        try:
+            return s in (str(float(s)), repr(float(s)))
+        except ValueError:
+            return False
     try:
         if str(int(s)) == s:
             return True
@@ -384,13 +397,26 @@ def _canonical_number(s: str) -> bool:
         return False
 
 
+class _NeverMatch:
+    """Sentinel from _unwrap_varchar_cast: the comparison is statically
+    false — the literal can never equal the column's stringification
+    (e.g. CAST(double AS VARCHAR) = '7', which stringifies to '7.0')."""
+
+
+_NEVER = _NeverMatch()
+
+
 def _unwrap_varchar_cast(e, table: str, schema: SqlSchema,
                          op: str = "=", literals=()):
     """CAST(x AS VARCHAR) unwraps ONLY where string-compare semantics
     equal the column's own: always for string columns (pure identity);
-    for numeric columns only under =/<>/IN with canonical numeric
-    literals (ordering and LIKE compare strings lexicographically —
-    numeric planning would return different rows)."""
+    for numeric columns only under =/<>/IN with literals canonical FOR
+    THAT TYPE (ordering and LIKE compare strings lexicographically —
+    numeric planning would return different rows). Non-canonical =/<>
+    literals return _NEVER: the equality is statically false, so the
+    caller plans zero rows (or all rows for <>) instead of handing the
+    engine a number-vs-string comparison that crashes or silently
+    mismatches (int('7.0') → ValueError → 500)."""
     inner = _peel_varchar_casts(e)
     if inner is e:
         return e
@@ -399,9 +425,10 @@ def _unwrap_varchar_cast(e, table: str, schema: SqlSchema,
     ctype = schema.type_of(table, inner.name)
     if ctype == "string":
         return inner
-    if op in ("=", "<>", "in") and literals \
-            and all(_canonical_number(str(v)) for v in literals):
-        return inner
+    if op in ("=", "<>", "in") and literals:
+        if all(_canonical_number(str(v), ctype) for v in literals):
+            return inner
+        return _NEVER
     if op in ("<", "<=", ">", ">="):
         # SQL compares the STRINGS lexicographically; numeric columns
         # have no dictionary to realize that on the device, and the
@@ -409,7 +436,7 @@ def _unwrap_varchar_cast(e, table: str, schema: SqlSchema,
         raise PlannerError(
             "lexicographic ordering over CAST(numeric AS VARCHAR) is not "
             "supported — compare the numeric column directly")
-    return e                  # =/<> non-canonical: expression path (false)
+    return e
 
 
 def _extraction_of(e, table: str, schema: SqlSchema):
@@ -458,8 +485,10 @@ def _extraction_of(e, table: str, schema: SqlSchema):
             return col, chain + (RegexExtractionFn(
                 f"(.{{0,{n}}})$", 1),)
         if nm == "TRIM" and len(node.args) == 1:
+            # SQL TRIM strips SPACE characters only — \s would also eat
+            # tabs/newlines and match values the reference would not
             return col, chain + (RegexExtractionFn(
-                r"^\s*(.*?)\s*$", 1),)
+                "^ *(.*?) *$", 1),)
         if nm in ("CHAR_LENGTH", "LENGTH", "STRLEN") \
                 and len(node.args) == 1:
             return col, chain + (StrlenExtractionFn(),)
@@ -503,11 +532,16 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         operand = _peel_varchar_casts(e.operand)
         if operand is not e.operand and isinstance(operand, P.Col) \
                 and schema.type_of(table, operand.name) != "string":
-            # CAST(numcol AS VARCHAR) IN (...): only canonical numeric
-            # strings can ever equal a stringified number — keep those,
-            # drop the rest (an empty remainder matches nothing)
+            # CAST(numcol AS VARCHAR) IN (...): only literals canonical
+            # for the COLUMN TYPE can ever equal its stringification —
+            # keep those, drop the rest ('7.0' against a long column, '7'
+            # against a double); an all-dropped list matches nothing
+            ctype = schema.type_of(table, operand.name)
             vals = tuple(_lit_str(v) for v in e.values
-                         if _canonical_number(_lit_str(v)))
+                         if _canonical_number(_lit_str(v), ctype))
+            if not vals:
+                return F.NotFilter(F.FalseFilter()) if e.negated \
+                    else F.FalseFilter()
             flt = F.InFilter(operand.name, vals)
             return F.NotFilter(flt) if e.negated else flt
         if isinstance(operand, P.Col):
@@ -557,6 +591,14 @@ def to_filter(e, table: str, schema: SqlSchema) -> F.DimFilter:
         if isinstance(l, P.Lit):
             r = _unwrap_varchar_cast(r, table, schema, op,
                                      (_lit_str(l),))
+        if l is _NEVER or r is _NEVER:
+            # statically-false equality: CAST(numcol AS VARCHAR) can never
+            # stringify to this literal — zero rows for =, all rows for <>
+            if op == "=":
+                return F.FalseFilter()
+            if op == "<>":
+                return F.TrueFilter()
+            return F.FalseFilter()   # unreachable: ordering ops raise
         if isinstance(r, P.Col) and not isinstance(l, P.Col):
             l, r = r, l
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)

@@ -2,11 +2,17 @@
 
 Reference analog: java-util/src/main/java/org/apache/druid/java/util/
 common/lifecycle/Lifecycle.java — services register in a stage
-(INIT → NORMAL → SERVER → ANNOUNCEMENTS), start runs stages in order and
-registration order within a stage, stop runs the exact reverse, and a
-failed start unwinds whatever already started. ANNOUNCEMENTS last means a
-node only becomes discoverable once everything beneath it is serving —
-the property the ad-hoc try/finally assemblies could not guarantee.
+(INIT → NORMAL → SERVER → COORDINATION → ANNOUNCEMENTS), start runs stages
+in order and registration order within a stage, stop runs the exact
+reverse, and a failed start unwinds whatever already started.
+ANNOUNCEMENTS last means a node only becomes discoverable once everything
+beneath it is serving — the property the ad-hoc try/finally assemblies
+could not guarantee. COORDINATION (leader-latch participation) sits after
+SERVER so a node only competes for leadership once its advertised
+endpoint is live, and before ANNOUNCEMENTS so a winning node is leading
+by the time it is discoverable; on stop the reverse order steps down from
+the latch (releasing the lease for fast standby promotion) before the
+HTTP server goes away.
 """
 from __future__ import annotations
 
@@ -22,7 +28,8 @@ class Stage(enum.IntEnum):
     INIT = 0            # metadata stores, config, extension registries
     NORMAL = 1          # coordinators, overlords, monitors
     SERVER = 2          # HTTP/socket servers begin accepting
-    ANNOUNCEMENTS = 3   # node announces itself into the cluster
+    COORDINATION = 3    # leader-latch participation (heartbeats begin)
+    ANNOUNCEMENTS = 4   # node announces itself into the cluster
 
 
 class Lifecycle:

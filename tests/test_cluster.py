@@ -165,6 +165,54 @@ def test_hybrid_remote_cache_through_broker(segments):
     assert b3.run(q) == first    # L1 still works
 
 
+def test_remote_cache_wire_is_data_only():
+    """The remote cache protocol carries JSON frames only (ADVICE round 5:
+    the pickle frames it replaces were remote code execution for anyone
+    who could reach the port): values round-trip as data, non-serializable
+    objects are dropped client-side, and a raw pickle payload is treated
+    as a malformed frame — never interpreted."""
+    import numpy as np
+    from druid_tpu.cluster import RemoteCacheClient, RemoteCacheServer
+    server = RemoteCacheServer().start()
+    try:
+        c = RemoteCacheClient("127.0.0.1", server.port)
+        rows = {"rows": [1, 2.5, "x"], "nested": {"a": [True, None]}}
+        c.put("ns", "k", rows)
+        assert c.get("ns", "k") == rows
+        # numpy values lower to plain JSON numbers on the wire
+        c.put("ns", "np", {"v": np.int64(7), "arr": np.arange(3)})
+        assert c.get("ns", "np") == {"v": 7, "arr": [0, 1, 2]}
+
+        # arbitrary objects do NOT ship (a cache may forget; it may not
+        # become a code channel) — the put degrades to a no-op
+        class Opaque:
+            pass
+        c.put("ns", "bad", Opaque())
+        assert c.get("ns", "bad") is None
+
+        # a hostile/legacy pickle frame is malformed JSON: the connection
+        # drops, nothing executes, and the server keeps serving others
+        import pickle
+        import socket
+        import struct
+        evil = pickle.dumps({"op": "get", "ns": "ns", "key": "k"})
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=2)
+        s.sendall(struct.pack(">I", len(evil)) + evil)
+        s.close()
+        assert c.get("ns", "k") == rows
+    finally:
+        server.stop()
+
+
+def test_remote_cache_warns_on_nonloopback_bind(caplog):
+    import logging
+    from druid_tpu.cluster import RemoteCacheServer
+    with caplog.at_level(logging.WARNING, logger="druid_tpu.cluster.cache"):
+        server = RemoteCacheServer(host="0.0.0.0")
+        server._server.server_close()
+    assert any("NON-LOOPBACK" in r.message for r in caplog.records)
+
+
 def test_segment_level_cache(cluster, segments):
     view, nodes, broker = cluster
     broker.cache_config = CacheConfig(use_result_cache=False,

@@ -1,0 +1,489 @@
+"""Coordination subsystem: leader election, bounded failover under
+injected faults (leader kill, heartbeat drop, registry partition),
+single-writer safety via fencing terms, duty-loop gating, discovery/
+redirect, and observability.
+
+Reference analogs under test: DruidLeaderSelector / CuratorDruidLeader
+Selector semantics (terms, listeners), DruidLeaderClient redirects, and
+the TaskMaster/DruidCoordinator leadership gating — over the lease-row
+latch in the SQL metadata store."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from druid_tpu.cluster import (Coordinator, DataNode, InventoryView,
+                               MetadataStore, SegmentDescriptor,
+                               StaleTermError)
+from druid_tpu.coordination import (ChaosHarness, LeaderClient, ManualClock,
+                                    LeaderParticipant, MetadataLeaseStore,
+                                    NoLeaderError, NotLeaderError)
+from druid_tpu.utils.intervals import Interval
+
+LEASE_MS = 1_000
+DAY = Interval.of("2026-01-01", "2026-01-02")
+
+
+def mk_fleet(n=3, service="coordinator"):
+    md = MetadataStore()
+    clock = ManualClock()
+    h = ChaosHarness.over_metadata(md, service, lease_ms=LEASE_MS,
+                                   clock=clock)
+    ps = [h.participant(f"node{i}",
+                        meta={"url": f"http://127.0.0.1:{9000 + i}"})
+          for i in range(n)]
+    return md, clock, h, ps
+
+
+def leaders_of(ps):
+    return [p.node_id for p in ps if p.is_leader()]
+
+
+def assert_single_writer_per_term(md, service):
+    """THE safety property: for every fencing term, all writes the store
+    accepted came from one holder (no dual leader ever wrote)."""
+    by_term = {}
+    for e in md.fence_log(service):
+        by_term.setdefault(e["term"], set()).add(e["holder"])
+    for term, holders in sorted(by_term.items()):
+        assert len(holders) == 1, \
+            f"dual writer in term {term}: {sorted(holders)}"
+
+
+# ---------------------------------------------------------------------------
+# election basics
+# ---------------------------------------------------------------------------
+
+def test_first_heartbeat_elects_exactly_one_leader():
+    md, clock, h, ps = mk_fleet()
+    h.tick_all()
+    assert len(leaders_of(ps)) == 1
+    leader = h.leader()
+    assert leader.term == 1
+    # further rounds are stable: nobody steals a live lease
+    for _ in range(5):
+        clock.advance(LEASE_MS // 3)
+        h.tick_all()
+        assert leaders_of(ps) == [leader.node_id]
+        assert leader.term == 1         # renewals never mint terms
+
+
+def test_graceful_release_promotes_standby_immediately():
+    md, clock, h, ps = mk_fleet()
+    first = h.await_leader()[0]
+    first.stop(release=True)            # voluntary step-down
+    promoted, intervals = h.await_leader(max_intervals=1)
+    assert promoted is not first
+    assert intervals <= 1.0             # no expiry wait after a release
+    assert promoted.term == 2
+
+
+def test_terms_are_monotonic_across_failovers():
+    md, clock, h, ps = mk_fleet()
+    seen = []
+    for _ in range(3):
+        leader, _ = h.await_leader()
+        seen.append(leader.term)
+        h.kill_leader()
+    assert seen == sorted(seen) and len(set(seen)) == 3
+
+
+# ---------------------------------------------------------------------------
+# the three injected faults: bounded failover + no dual leader
+# ---------------------------------------------------------------------------
+
+def _inject(h, fault):
+    leader = h.leader()
+    if fault == "kill":
+        h.kill_leader()
+    elif fault == "drop":
+        h.drop_heartbeats(leader.node_id)
+    elif fault == "partition":
+        h.partition(leader.node_id)
+    return leader
+
+
+@pytest.mark.parametrize("fault", ["kill", "drop", "partition"])
+def test_fault_promotes_standby_within_bounded_intervals(fault):
+    md, clock, h, ps = mk_fleet()
+    old = h.await_leader()[0]
+    old_term = old.term
+    _inject(h, fault)
+    # bounded failover: expiry (1 interval) + takeover heartbeat slack
+    promoted, intervals = h.await_leader(max_intervals=3, exclude=old)
+    assert promoted is not old
+    assert intervals <= 2.0, f"{fault}: promotion took {intervals} intervals"
+    assert promoted.term == old_term + 1
+    # the old leader self-fenced: a surviving-but-cut-off process must
+    # read itself as non-leader once its lease lapsed locally
+    assert not old.is_leader()
+    assert leaders_of(ps) == [promoted.node_id]
+
+
+@pytest.mark.parametrize("fault", ["kill", "drop", "partition"])
+def test_no_two_accepted_writes_share_a_term_across_holders(fault):
+    """Under every fault, drive BOTH the deposed leader and the promoted
+    one to write — the store must accept each term's writes from exactly
+    one holder, rejecting the zombie's with StaleTermError."""
+    md, clock, h, ps = mk_fleet()
+    old = h.await_leader()[0]
+    md.insert_task("t-pre", "ds", "RUNNING", {}, fence=old.fence())
+    stale_fence = old.fence()
+    _inject(h, fault)
+    promoted, _ = h.await_leader(max_intervals=3, exclude=old)
+    md.insert_task("t-post", "ds", "RUNNING", {}, fence=promoted.fence())
+    # the zombie's in-flight write (captured fence from its old term)
+    with pytest.raises(StaleTermError):
+        md.insert_task("t-zombie", "ds", "RUNNING", {}, fence=stale_fence)
+    with pytest.raises(StaleTermError):
+        md.publish_segments(
+            [SegmentDescriptor("ds", DAY, "v1")], fence=stale_fence)
+    assert_single_writer_per_term(md, "coordinator")
+    # and the rejected write really did not land
+    assert md.task("t-zombie") is None
+    assert md.used_segments("ds") == []
+
+
+def test_healed_node_rejoins_as_standby():
+    md, clock, h, ps = mk_fleet()
+    old = h.await_leader()[0]
+    h.partition(old.node_id)
+    promoted, _ = h.await_leader(max_intervals=3, exclude=old)
+    h.heal(old.node_id)
+    for _ in range(4):
+        clock.advance(LEASE_MS // 3)
+        h.tick_all()
+        # the healed node must NOT depose the live leader
+        assert leaders_of(ps) == [promoted.node_id]
+
+
+def test_fenced_write_requires_current_term_not_just_any_term():
+    md = MetadataStore()
+    store = MetadataLeaseStore(md)
+    clock = ManualClock()
+    a = LeaderParticipant(store, "svc", "a", lease_ms=LEASE_MS, clock=clock)
+    a.tick()
+    # a term from the FUTURE (never minted) is rejected too
+    with pytest.raises(StaleTermError):
+        md.mark_unused([], fence=("svc", a.term + 5, "a"))
+    # wrong holder under the right term is rejected
+    with pytest.raises(StaleTermError):
+        md.mark_unused([], fence=("svc", a.term, "impostor"))
+    # unknown service has no lease → nobody was ever elected
+    with pytest.raises(StaleTermError):
+        md.mark_unused([], fence=("other-svc", 1, "a"))
+
+
+# ---------------------------------------------------------------------------
+# duty-loop gating: coordinator + overlord idle on non-leaders
+# ---------------------------------------------------------------------------
+
+class _ProbeCountingNode(DataNode):
+    def __init__(self, name):
+        super().__init__(name)
+        self.pings = 0
+
+    def ping(self):
+        self.pings += 1
+        return True
+
+
+def test_coordinator_duty_loop_idles_on_non_leader():
+    md, clock, h, ps = mk_fleet(2)
+    leader, _ = h.await_leader()
+    standby = next(p for p in ps if p is not leader)
+
+    view = InventoryView()
+    node = _ProbeCountingNode("n0")
+    view.register(node)
+    coord = Coordinator(md, view, lambda d: None, leader=standby)
+    stats = coord.run_once(now_ms=clock())
+    assert stats.skipped_not_leader
+    assert stats.leader_term == -1
+    assert node.pings == 0          # not even liveness probes ran
+    assert md.fence_log("coordinator") == []
+
+    # promote the standby → the SAME coordinator object starts working
+    h.kill_leader()
+    promoted, _ = h.await_leader(max_intervals=3)
+    assert promoted is standby
+    stats = coord.run_once(now_ms=clock())
+    assert not stats.skipped_not_leader
+    assert stats.leader_term == standby.term
+    assert node.pings == 1
+
+
+def test_coordinator_writes_carry_fencing_term():
+    md, clock, h, ps = mk_fleet(1)
+    leader, _ = h.await_leader()
+    # two versions over one interval: v1 is fully overshadowed, so the
+    # duty cycle's mark_unused write goes through the fence
+    md.publish_segments([SegmentDescriptor("ds", DAY, "v1"),
+                         SegmentDescriptor("ds", DAY, "v2")])
+    coord = Coordinator(md, InventoryView(), lambda d: None, leader=leader)
+    stats = coord.run_once(now_ms=clock())
+    assert stats.overshadowed_marked == 1
+    log = md.fence_log("coordinator")
+    assert [e["op"] for e in log] == ["mark_unused"]
+    assert log[0]["term"] == leader.term
+    assert log[0]["holder"] == leader.node_id
+
+
+def test_overlord_rejects_submission_on_non_leader():
+    from druid_tpu.indexing import Overlord
+    from druid_tpu.indexing.task import KillTask
+    md, clock, h, ps = mk_fleet(2, service="overlord")
+    leader, _ = h.await_leader()
+    standby = next(p for p in ps if p is not leader)
+
+    ov = Overlord(md, leader=standby)
+    try:
+        with pytest.raises(NotLeaderError) as ei:
+            ov.submit(KillTask("ds", DAY))
+        # the rejection carries the live leader's URL for redirect
+        assert ei.value.leader_url == leader.meta["url"]
+        assert md.tasks() == []          # provably idle: nothing persisted
+    finally:
+        ov.shutdown()
+
+    ov2 = Overlord(md, leader=leader)
+    try:
+        tid = ov2.submit(KillTask("ds", DAY))
+        assert ov2.await_task(tid).state == "SUCCESS"
+        ops = [e["op"] for e in md.fence_log("overlord")]
+        assert "insert_task" in ops and "update_task_status" in ops
+        assert_single_writer_per_term(md, "overlord")
+    finally:
+        ov2.shutdown()
+
+
+def test_zombie_overlord_task_cannot_publish():
+    """A task started under overlord A publishes AFTER B took over: the
+    toolbox reads the fence late, so the publish carries A's stale term
+    and the store rejects it — the exactly-once boundary holds across
+    failover."""
+    from druid_tpu.indexing import Overlord
+    md, clock, h, ps = mk_fleet(2, service="overlord")
+    a_leader, _ = h.await_leader()
+    a = Overlord(md, leader=a_leader)
+    tb = a.toolbox()
+    try:
+        h.kill_leader()
+        h.await_leader(max_intervals=3)
+
+        class _T:                       # minimal task identity for publish
+            id = "t-zombie"
+        with pytest.raises(StaleTermError):
+            tb.publish(_T(), [SegmentDescriptor("ds", DAY, "v1")])
+        assert md.used_segments("ds") == []
+        assert_single_writer_per_term(md, "overlord")
+    finally:
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# discovery + redirect (DruidLeaderClient pattern)
+# ---------------------------------------------------------------------------
+
+def _get(url, expect_redirect=False):
+    req = urllib.request.Request(url, method="GET")
+
+    class _NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(_NoRedirect)
+    try:
+        with opener.open(req, timeout=10) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), \
+            json.loads(e.read() or b"null") if not expect_redirect else None
+
+
+@pytest.fixture
+def http_pair():
+    """Two QueryHttpServers fronting one overlord latch: s1's participant
+    leads, s2's stands by."""
+    from druid_tpu.indexing import Overlord
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+    md = MetadataStore()
+    clock = ManualClock()
+    h = ChaosHarness.over_metadata(md, "overlord", lease_ms=LEASE_MS,
+                                   clock=clock)
+    p1, p2 = h.participant("node1"), h.participant("node2")
+    servers, overlords = [], []
+    try:
+        for p in (p1, p2):
+            ov = Overlord(md, leader=p)
+            s = QueryHttpServer(QueryLifecycle(None),
+                                coordination={"overlord": p}, overlord=ov)
+            s.start()
+            p.meta["url"] = f"http://127.0.0.1:{s.port}"
+            servers.append(s)
+            overlords.append(ov)
+        p1.tick()                         # node1 wins
+        p2.tick()
+        assert p1.is_leader() and not p2.is_leader()
+        yield md, clock, h, (p1, p2), servers
+    finally:
+        for s in servers:
+            s.stop()
+        for ov in overlords:
+            ov.shutdown()
+
+
+def test_http_leader_discovery_and_redirect(http_pair):
+    md, clock, h, (p1, p2), (s1, s2) = http_pair
+    u1 = f"http://127.0.0.1:{s1.port}"
+    u2 = f"http://127.0.0.1:{s2.port}"
+    # /leader answers on BOTH nodes with the leader's advertised URL
+    for u in (u1, u2):
+        code, _, body = _get(u + "/druid/indexer/v1/leader")
+        assert code == 200 and body["leader"] == u1
+        assert body["term"] == p1.term
+    # isLeader: 200 on the leader, 404 on the standby (Druid semantics)
+    assert _get(u1 + "/druid/indexer/v1/isLeader")[0] == 200
+    code, _, body = _get(u2 + "/druid/indexer/v1/isLeader")
+    assert code == 404 and body["leader"] is False
+    # any other API path on the standby → 307 at the leader
+    code, headers, _ = _get(u2 + "/druid/indexer/v1/task/x/status",
+                            expect_redirect=True)
+    assert code == 307
+    assert headers["Location"] == u1 + "/druid/indexer/v1/task/x/status"
+
+
+def test_http_task_submit_runs_on_leader_only(http_pair):
+    md, clock, h, (p1, p2), (s1, s2) = http_pair
+    u2 = f"http://127.0.0.1:{s2.port}"
+    payload = {"type": "kill", "dataSource": "ds",
+               "interval": str(DAY), "id": "kill-1"}
+    # the LeaderClient resolves the leader from the lease row
+    client = LeaderClient(h.store, "overlord", clock=clock)
+    out = client.go("/druid/indexer/v1/task", payload)
+    assert out["task"] == "kill-1"
+    assert md.task("kill-1") is not None
+    # a client whose cached leader is STALE (pointing at the standby)
+    # follows the 307 to the real leader transparently
+    stale = LeaderClient(h.store, "overlord", clock=clock)
+    stale._cached_url = u2
+    out = stale.go("/druid/indexer/v1/task",
+                   {**payload, "id": "kill-2"})
+    assert out["task"] == "kill-2"
+    assert md.task("kill-2") is not None
+
+
+def test_leader_client_no_leader():
+    md = MetadataStore()
+    clock = ManualClock()
+    client = LeaderClient(MetadataLeaseStore(md), "overlord", clock=clock)
+    assert client.leader() is None
+    with pytest.raises(NoLeaderError):
+        client.request(lambda url: url, retries=2, backoff_s=0)
+
+
+def test_router_fronts_the_control_plane(http_pair):
+    """One stable router URL across failovers: the router re-resolves the
+    leader from the lease row (AsyncQueryForwardingServlet's /proxy)."""
+    from druid_tpu.server.router import (RouterHttpServer,
+                                         TieredBrokerSelector)
+    md, clock, h, (p1, p2), (s1, s2) = http_pair
+    selector = TieredBrokerSelector({"_default": ["http://127.0.0.1:1"]},
+                                    "_default")
+    router = RouterHttpServer(
+        selector, leader_clients={
+            "overlord": LeaderClient(h.store, "overlord", clock=clock)})
+    router.start()
+    try:
+        code, _, body = _get(router.url + "/druid/indexer/v1/leader")
+        assert code == 200
+        assert body["leader"] == f"http://127.0.0.1:{s1.port}"
+        # failover: kill node1's latch, node2 takes over; the SAME router
+        # URL now answers from node2
+        p1.kill()
+        clock.advance(LEASE_MS + 1)
+        p2.tick()
+        assert p2.is_leader()
+        code, _, body = _get(router.url + "/druid/indexer/v1/leader")
+        assert code == 200
+        assert body["leader"] == f"http://127.0.0.1:{s2.port}"
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# observability + lifecycle stage
+# ---------------------------------------------------------------------------
+
+def test_emitter_reports_transitions_and_lease_age():
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+    sink = InMemoryEmitter()
+    emitter = ServiceEmitter("coordinator", "localhost", sink)
+    md = MetadataStore()
+    clock = ManualClock()
+    h = ChaosHarness.over_metadata(md, "coordinator", lease_ms=LEASE_MS,
+                                   clock=clock)
+    p = h.participant("node0", emitter=emitter)
+    p.tick()
+    trans = sink.metrics("coordination/leader/transitions")
+    assert len(trans) == 1
+    assert trans[0].dims["event"] == "become" and trans[0].value == 1
+    clock.advance(400)
+    p.tick()
+    ages = sink.metrics("coordination/lease/ageMs")
+    assert ages and ages[-1].value == 400      # age at tick, pre-renew
+    # losing the lease emits the stop transition
+    p.drop_heartbeats = True
+    clock.advance(LEASE_MS + 1)
+    p.tick()
+    trans = sink.metrics("coordination/leader/transitions")
+    assert [e.dims["event"] for e in trans] == ["become", "stop"]
+    assert p.transitions == 2
+
+    # the MonitorScheduler-compatible monitor emits both observables
+    from druid_tpu.coordination import LeaderMonitor
+    LeaderMonitor(p).do_monitor(emitter)
+    assert sink.metrics("coordination/leader/transitions")[-1].value == 2
+    assert sink.metrics("coordination/lease/ageMs")[-1].dims["leader"] is False
+
+
+def test_become_and_stop_listeners_fire():
+    md, clock, h, ps = mk_fleet(1)
+    p = ps[0]
+    events = []
+    p.register_listener(on_become=lambda term: events.append(("up", term)),
+                        on_stop=lambda: events.append(("down", None)))
+    p.tick()
+    assert events == [("up", 1)]
+    p.drop_heartbeats = True
+    clock.advance(LEASE_MS + 1)
+    p.tick()
+    assert events == [("up", 1), ("down", None)]
+    # healed: re-election fires become again with the NEW term
+    p.drop_heartbeats = False
+    clock.advance(LEASE_MS + 1)
+    p.tick()
+    assert events[-1] == ("up", 2)
+
+
+def test_lifecycle_coordination_stage_ordering():
+    """COORDINATION sits between SERVER and ANNOUNCEMENTS: a node starts
+    competing for leadership only once its endpoint serves, and is
+    discoverable only after the latch is live; stop reverses."""
+    from druid_tpu.utils.lifecycle import Lifecycle, Stage
+    events = []
+
+    def h(name):
+        return dict(start=lambda: events.append(f"+{name}"),
+                    stop=lambda: events.append(f"-{name}"))
+
+    lc = Lifecycle()
+    lc.add(**h("announce"), stage=Stage.ANNOUNCEMENTS)
+    lc.add(**h("latch"), stage=Stage.COORDINATION)
+    lc.add(**h("http"), stage=Stage.SERVER)
+    lc.add(**h("meta"), stage=Stage.INIT)
+    lc.start()
+    lc.stop()
+    assert events == ["+meta", "+http", "+latch", "+announce",
+                      "-announce", "-latch", "-http", "-meta"]

@@ -414,6 +414,53 @@ def test_varchar_cast_unwrap_is_semantics_safe(sql):
             "SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) > '5'")
 
 
+def test_varchar_cast_canonicality_is_type_aware(sql):
+    """The literal must round-trip the COLUMN TYPE's stringification:
+    CAST(double AS VARCHAR) yields '0.0' never '0', CAST(long AS VARCHAR)
+    yields '7' never '7.0' — cross-type canonical literals are statically
+    false (zero rows), not numeric matches and not engine crashes
+    (int('7.0') used to 500)."""
+    cases = [
+        # double column: d1 has a 0.0 row — '0' must NOT match it
+        ("SELECT COUNT(*) FROM foo WHERE CAST(d1 AS VARCHAR) = '0'", 0),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(d1 AS VARCHAR) = '0.0'", 1),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(d1 AS VARCHAR) = '1.7'", 2),
+        # long column: float-canonical literals can never match (and must
+        # not crash the engine with int('7.0') → ValueError → 500)
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) = '7.0'", 0),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) <> '7.0'", 6),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) IN "
+         "('7.0', '9')", 1),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(l1 AS VARCHAR) IN "
+         "('7.0')", 0),
+        # float column: f1 has a 1.0 row — '1.0' matches, '1' cannot
+        ("SELECT COUNT(*) FROM foo WHERE CAST(f1 AS VARCHAR) = '1.0'", 1),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(f1 AS VARCHAR) = '1'", 0),
+        ("SELECT COUNT(*) FROM foo WHERE CAST(f1 AS VARCHAR) <> '1'", 6),
+    ]
+    for q, want in cases:
+        cols, rows = sql.execute(q)
+        assert rows[0][0] == want, (q, rows)
+
+
+def test_trim_strips_spaces_only():
+    """SQL TRIM semantics: space characters only — a tab survives, so
+    TRIM(col) filters must not match values the reference would not."""
+    b = SegmentBuilder("ws", IV)
+    b.add_columns(
+        np.asarray([T0, T0 + DAY, T0 + 2 * DAY], dtype=np.int64),
+        {"s": [" x", "\tx", "x "]}, {})
+    ws = SqlExecutor(QueryExecutor([b.build()]))
+    cols, rows = ws.execute("SELECT COUNT(*) FROM ws WHERE TRIM(s) = 'x'")
+    assert rows[0][0] == 2          # ' x' and 'x ' — NOT '\tx'
+    # the extraction fn itself: spaces trimmed, tab preserved
+    from druid_tpu.query.model import RegexExtractionFn
+    fn = RegexExtractionFn("^ *(.*?) *$", 1)
+    assert fn.apply(" x") == "x" and fn.apply("x ") == "x"
+    assert fn.apply("\tx") == "\tx"          # tab is NOT trimmed
+    assert fn.apply("  x  ") == "x"
+
+
 def test_strlen_strpos_in_expressions(sql):
     """CHAR_LENGTH/STRPOS over string dims ride per-dictionary-value
     numeric LUT gathers — usable inside any aggregate expression."""
