@@ -16,6 +16,7 @@ the same states would merge on-device via collectives (druid_tpu/parallel/).
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -292,7 +293,11 @@ class Broker:
                 raw += "|ctx:" + _json.dumps(ctx, sort_keys=True)
             return hashlib.sha1(raw.encode()).hexdigest()
         except Exception:
-            return None   # etag is an optimization, never a failure
+            # etag is an optimization, never a failure
+            logging.getLogger(__name__).debug(
+                "etag computation failed; serving without one",
+                exc_info=True)
+            return None
 
     def _all_replicatable(self, segments: List[SegmentDescriptor]) -> bool:
         """True when no queried segment is served by a realtime server.

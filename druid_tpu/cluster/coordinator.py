@@ -488,9 +488,5 @@ class Coordinator:
     # ---- kill (permanent deletion of unused segments) -------------------
     def kill_unused(self, datasource: str) -> int:
         """KillTask analog: permanently delete unused segments' metadata."""
-        with self.metadata._lock:
-            cur = self.metadata._conn.execute(
-                "SELECT id FROM segments WHERE used = 0 AND datasource = ?",
-                (datasource,))
-            ids = [r[0] for r in cur.fetchall()]
+        ids = [d.id for d in self.metadata.unused_segments(datasource)]
         return self.metadata.delete_segments(ids, fence=self._fence())

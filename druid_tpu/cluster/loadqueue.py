@@ -9,6 +9,7 @@ pile onto a node (maxSegmentsInNodeLoadingQueue).
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Dict, List, Optional, Set
@@ -123,7 +124,9 @@ class LoadQueuePeon:
                     try:
                         callback(ok)
                     except Exception:
-                        pass
+                        logging.getLogger(__name__).exception(
+                            "completion callback for [%s %s] failed",
+                            op, d.id)
                 with self._lock:
                     self._pending.discard(d.id)
                     if not self._pending:

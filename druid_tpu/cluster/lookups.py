@@ -10,12 +10,14 @@ the reference's periodic lookup management loop.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
 from druid_tpu.cluster.metadata import MetadataStore
 from druid_tpu.query.lookup import LookupReferencesManager
+from druid_tpu.utils.intervals import parse_period_ms
 
 _CONFIG_KEY = "lookups"
 
@@ -40,9 +42,8 @@ def _period_seconds(val) -> float:
     except (TypeError, ValueError):
         pass
     try:
-        from druid_tpu.utils.intervals import parse_period_ms
         return parse_period_ms(str(val)) / 1000.0
-    except Exception:
+    except (TypeError, ValueError):
         return 0.0
 
 
@@ -198,7 +199,11 @@ class LookupNodeSync:
         try:
             mapping = loader(ns)
         except Exception:
-            return False          # keep serving the last good mapping
+            # keep serving the last good mapping
+            logging.getLogger(__name__).warning(
+                "namespace load for lookup [%s] failed; keeping previous "
+                "mapping", name, exc_info=True)
+            return False
         self._ns_loaded[name] = now
         if not spec_changed and cur is not None \
                 and mapping == cur.mapping:

@@ -18,6 +18,7 @@ wire — shapes and dtypes are all plain host arrays by construction.
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -34,6 +35,8 @@ from druid_tpu.engine.engines import AggregatePartials, make_aggregate_partials
 from druid_tpu.query.model import (GroupByQuery, Query, TimeseriesQuery,
                                    TopNQuery)
 from druid_tpu.utils.intervals import Interval
+
+log = logging.getLogger(__name__)
 
 
 def descriptor_for(segment: Segment,
@@ -419,7 +422,10 @@ class InventoryView:
                 a += da
                 r += dr
             except Exception:
-                continue      # liveness handles dead nodes
+                # liveness handles dead nodes; keep syncing the rest
+                log.debug("inventory sync for [%s] failed", node.name,
+                          exc_info=True)
+                continue
         return a, r
 
     def check_liveness(self, failures_required: int = 1) -> List[str]:
@@ -446,6 +452,8 @@ class InventoryView:
                 return bool(ping()) if callable(ping) \
                     else bool(getattr(node, "alive", True))
             except Exception:
+                log.debug("liveness probe for [%s] raised", node.name,
+                          exc_info=True)
                 return False
 
         with ThreadPoolExecutor(max_workers=min(len(nodes), 16)) as pool:

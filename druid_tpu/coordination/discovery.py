@@ -12,12 +12,15 @@ Reference analogs:
 from __future__ import annotations
 
 import json
+import logging
 import time
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
 from druid_tpu.coordination.latch import LeaderLease, LeaseStore
+
+log = logging.getLogger(__name__)
 
 
 class NoLeaderError(RuntimeError):
@@ -43,6 +46,8 @@ class LeaderClient:
         try:
             lease = self.store.read(self.service)
         except Exception:
+            log.debug("lease read for [%s] failed; reporting no leader",
+                      self.service, exc_info=True)
             return None
         if lease is None or self.clock() >= lease.expires_ms:
             return None

@@ -193,6 +193,9 @@ class LeaderParticipant:
                         self.meta)
                 except Exception:
                     got = None        # partitioned from the lease store
+                    log.warning("lease store unreachable for [%s] from "
+                                "[%s]; treating as lost heartbeat",
+                                self.service, self.node_id, exc_info=True)
             if got is not None:
                 self._lease = got
                 self._last_renew_ms = now
@@ -269,7 +272,9 @@ class LeaderParticipant:
             try:
                 self.store.release(self.service, self.node_id)
             except Exception:
-                pass                   # store down: expiry handles it
+                # store down: expiry handles it
+                log.debug("lease release for [%s] failed; standbys take "
+                          "over on expiry", self.service, exc_info=True)
 
     def kill(self) -> None:
         """Simulated process death (chaos): heartbeats halt WITHOUT

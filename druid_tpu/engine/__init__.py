@@ -43,7 +43,10 @@ if _cc != "0":
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # cache is an optimization, never a failure
-        pass
+        import logging
+        logging.getLogger(__name__).debug(
+            "persistent XLA compile cache unavailable at %s", cache_dir,
+            exc_info=True)
 
 from druid_tpu.engine.executor import QueryExecutor  # noqa: E402
 

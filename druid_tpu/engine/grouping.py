@@ -38,9 +38,11 @@ measured rates on a v5e chip at 12.5M rows):
 """
 from __future__ import annotations
 
+import collections
 import logging
 import math
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -294,7 +296,16 @@ def make_group_spec(segment: Segment, intervals: Sequence[Interval],
 # Device program assembly + jit cache
 # ---------------------------------------------------------------------------
 
-_JIT_CACHE: Dict[str, object] = {}
+# Compiled per-segment programs keyed on the structure signature, LRU-bounded:
+# closures capture only plan structure (segment constants arrive via aux at
+# call time), but dropped query shapes should still release their executables.
+# The lock covers the whole get-or-build sequence: the broker fans segments
+# out over a thread pool, and an unsynchronized evict could race a
+# move_to_end into KeyError (jit() construction is lazy, so building under
+# the lock costs nothing — tracing happens at first call).
+_JIT_CACHE: "collections.OrderedDict[str, object]" = collections.OrderedDict()
+_JIT_CACHE_CAP = 128
+_JIT_CACHE_LOCK = threading.Lock()
 
 
 def plan_virtual_columns(segment: Segment, virtual_columns: Sequence
@@ -913,11 +924,16 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
     while True:
         sig = _structure_sig(spec, len(intervals), filter_node, kernels,
                              vc_plans)
-        fn = _JIT_CACHE.get(sig)
-        if fn is None:
-            fn = _build_device_fn(spec, len(intervals), filter_node, kernels,
-                                  vc_plans)
-            _JIT_CACHE[sig] = fn
+        with _JIT_CACHE_LOCK:
+            fn = _JIT_CACHE.get(sig)
+            if fn is None:
+                fn = _build_device_fn(spec, len(intervals), filter_node,
+                                      kernels, vc_plans)
+                _JIT_CACHE[sig] = fn
+                while len(_JIT_CACHE) > _JIT_CACHE_CAP:
+                    _JIT_CACHE.popitem(last=False)
+            else:
+                _JIT_CACHE.move_to_end(sig)
         try:
             counts, states = fn(arrays, aux)
             break

@@ -18,6 +18,7 @@ onto the CPU backend and the serving process keeps the chip.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -331,7 +332,9 @@ def peon_main(spec_path: str) -> int:
             try:
                 actions.post("/heartbeat", {"worker": f"peon-{task.id}"})
             except Exception:
-                pass
+                # overlord unreachable: its liveness view ages us out
+                logging.getLogger(__name__).debug(
+                    "heartbeat for peon-%s failed", task.id, exc_info=True)
             stop_hb.wait(spec.get("heartbeatPeriod", 5.0))
 
     threading.Thread(target=beat, daemon=True).start()

@@ -116,7 +116,8 @@ class Overlord:
                 if lease is not None:
                     url = lease.url
             except Exception:
-                pass
+                log.debug("could not resolve current leader url for "
+                          "redirect", exc_info=True)
             raise NotLeaderError(
                 f"overlord [{self.leader.node_id}] is not the leader",
                 leader_url=url)

@@ -35,7 +35,8 @@ def _build() -> bool:
              "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120)
         return True
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # no toolchain / compile failure: callers fall back to numpy paths
         return False
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,9 +39,12 @@ from druid_tpu.utils.intervals import Interval
 
 # Jitted sharded programs, LRU-bounded: entries capture kernel aux arrays in
 # their closures, so an unbounded cache would pin host memory across segment
-# generations.
+# generations. Locked: concurrent queries racing evict vs move_to_end would
+# KeyError (shard_map/jit construction is lazy, so building under the lock
+# is cheap).
 _FN_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict()
 _FN_CACHE_CAP = 64
+_CACHE_LOCK = threading.Lock()
 
 # Stacked device blocks pin whole segment sets in HBM — bound the cache (LRU)
 # so dropped segment generations / varying column subsets free their memory.
@@ -232,15 +236,16 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
 
     sig = _sharded_sig(mesh, axis, spec0, kds, filter_node, kernels,
                        len(intervals), vc_plans, K, R)
-    fn = _FN_CACHE.get(sig)
-    if fn is None:
-        fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
-                               kernels, vc_plans)
-        _FN_CACHE[sig] = fn
-        while len(_FN_CACHE) > _FN_CACHE_CAP:
-            _FN_CACHE.popitem(last=False)
-    else:
-        _FN_CACHE.move_to_end(sig)
+    with _CACHE_LOCK:
+        fn = _FN_CACHE.get(sig)
+        if fn is None:
+            fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
+                                   kernels, vc_plans)
+            _FN_CACHE[sig] = fn
+            while len(_FN_CACHE) > _FN_CACHE_CAP:
+                _FN_CACHE.popitem(last=False)
+        else:
+            _FN_CACHE.move_to_end(sig)
     counts, states = fn(stacked, time0s, iv_rel, bucket_off, aux)
 
     host_states = {k.name: k.host_from_device(st)
@@ -292,10 +297,11 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     # objects, so their id()s cannot be recycled while the entry lives.
     key = (tuple(id(s) for s in segments), columns, n_dev,
            tuple(d.id for d in mesh.devices.flat))
-    cached = _STACK_CACHE.get(key)
-    if cached is not None:
-        _STACK_CACHE.move_to_end(key)
-        return cached[:4]
+    with _CACHE_LOCK:
+        cached = _STACK_CACHE.get(key)
+        if cached is not None:
+            _STACK_CACHE.move_to_end(key)
+            return cached[:4]
 
     align = 1024
     R = max(align, max(((s.n_rows + align - 1) // align) * align
@@ -343,9 +349,12 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     dev_arrays = {k: jax.device_put(v, shard) for k, v in arrays.items()}
     dev_time0s = jax.device_put(time0s, shard1)
     result = (dev_arrays, dev_time0s, R, K)
-    _STACK_CACHE[key] = result + (tuple(segments),)
-    while len(_STACK_CACHE) > _STACK_CACHE_CAP:
-        _STACK_CACHE.popitem(last=False)
+    # stacking (device_put of whole segment sets) stays outside the lock;
+    # a concurrent duplicate build wastes work but cannot corrupt the LRU
+    with _CACHE_LOCK:
+        _STACK_CACHE[key] = result + (tuple(segments),)
+        while len(_STACK_CACHE) > _STACK_CACHE_CAP:
+            _STACK_CACHE.popitem(last=False)
     return result
 
 

@@ -14,6 +14,7 @@ materialized host-side anyway after device execution.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -349,6 +350,8 @@ class QueryHttpServer:
                     # lifecycle's abandoned-stream accounting fires
                     # deterministically, then drop the connection (the
                     # missing terminal chunk marks truncation)
+                    logging.getLogger(__name__).debug(
+                        "result stream aborted mid-flight", exc_info=True)
                     gen.close()
                     self.close_connection = True
 

@@ -15,6 +15,7 @@ between two Yielder steps.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -127,7 +128,8 @@ class QueryToken:
                 try:
                     fn()
                 except Exception:
-                    pass
+                    logging.getLogger(__name__).exception(
+                        "cancel propagation hook failed")
         threading.Thread(target=run, daemon=True).start()
 
     def cancel(self) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -190,6 +191,10 @@ class RouterHttpServer:
                         self._send(e.code, e.read())
                         return
                     except Exception:
+                        # re-resolve the leader and retry the next attempt
+                        logging.getLogger(__name__).debug(
+                            "control-plane proxy attempt for [%s] failed; "
+                            "invalidating cached leader", svc, exc_info=True)
                         client.invalidate()
                 self._send(503, json.dumps(
                     {"error": f"no reachable leader for [{svc}]"}).encode())

@@ -8,6 +8,7 @@ the INFORMATION_SCHEMA tables.
 """
 from __future__ import annotations
 
+import logging
 import time
 
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -101,6 +102,10 @@ class SqlExecutor:
             rows = self.qe.run(SegmentMetadataQuery.of(
                 datasource, merge=True, analysis_types=()))
         except Exception:
+            # schema stays numeric-default; queries still parse
+            logging.getLogger(__name__).debug(
+                "segment metadata scan for [%s] failed", datasource,
+                exc_info=True)
             return {}
         out: Dict[str, str] = {}
         for analysis in rows:
@@ -414,6 +419,10 @@ def _empty_agg_row(q) -> dict:
         try:
             fields[pa.name] = pa.compute(fields)
         except Exception:
+            # SQL NULL on an uncomputable post-agg (reference behavior)
+            logging.getLogger(__name__).debug(
+                "post-aggregator [%s] failed on empty-result fields",
+                pa.name, exc_info=True)
             fields[pa.name] = None
     return fields
 

@@ -1,0 +1,132 @@
+"""Compile-counter tests: repeated queries must NOT rebuild (and hence
+retrace/recompile) the jitted device programs — the hazard druidlint's
+jit-in-hot-path rule guards statically, asserted dynamically here.
+
+The counter wraps the builder functions (_build_device_fn /
+_build_sharded_fn): a second identical query must be served entirely from
+_JIT_CACHE / _FN_CACHE."""
+import collections
+
+import pytest
+
+from druid_tpu.engine import grouping
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.parallel import distributed, make_mesh, use_mesh
+from druid_tpu.query import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import (DefaultDimensionSpec, GroupByQuery,
+                                   TimeseriesQuery)
+from druid_tpu.utils.granularity import Granularity
+from druid_tpu.utils.intervals import Interval
+
+from conftest import DAY
+
+AGGS = [CountAggregator("rows"), LongSumAggregator("sumLong", "metLong")]
+
+
+class BuildCounter:
+    def __init__(self, fn):
+        self.fn = fn
+        self.count = 0
+
+    def __call__(self, *args, **kwargs):
+        self.count += 1
+        return self.fn(*args, **kwargs)
+
+
+@pytest.fixture
+def device_counter(monkeypatch):
+    """Fresh per-segment jit cache + counted builder."""
+    monkeypatch.setattr(grouping, "_JIT_CACHE", collections.OrderedDict())
+    counter = BuildCounter(grouping._build_device_fn)
+    monkeypatch.setattr(grouping, "_build_device_fn", counter)
+    return counter
+
+
+@pytest.fixture
+def sharded_counter(monkeypatch):
+    """Fresh sharded fn cache + counted builder."""
+    monkeypatch.setattr(distributed, "_FN_CACHE", collections.OrderedDict())
+    counter = BuildCounter(distributed._build_sharded_fn)
+    monkeypatch.setattr(distributed, "_build_sharded_fn", counter)
+    return counter
+
+
+def test_repeated_timeseries_compiles_once(segment, device_counter):
+    qe = QueryExecutor([segment])
+    q = TimeseriesQuery(datasource="test", intervals=[DAY],
+                        granularity=Granularity.HOUR, aggregations=AGGS)
+    first = qe.run(q)
+    assert device_counter.count == 1, "first query must build the program"
+    for _ in range(3):
+        assert qe.run(q) == first
+    assert device_counter.count == 1, (
+        f"repeated identical queries rebuilt the jitted program "
+        f"{device_counter.count - 1} extra time(s) — _JIT_CACHE regressed")
+
+
+def test_repeated_groupby_compiles_once(segment, device_counter):
+    qe = QueryExecutor([segment])
+    q = GroupByQuery(datasource="test", intervals=[DAY],
+                     granularity=Granularity.ALL,
+                     dimensions=[DefaultDimensionSpec("dimA", "dimA")],
+                     aggregations=AGGS)
+    first = qe.run(q)
+    built = device_counter.count
+    assert built >= 1
+    for _ in range(3):
+        assert qe.run(q) == first
+    assert device_counter.count == built, (
+        "repeated identical groupBys rebuilt the jitted program")
+
+
+def test_different_structure_builds_again_same_structure_reuses(
+        segment, device_counter):
+    """The cache key is the plan STRUCTURE: a different shape builds a new
+    program; re-running either shape reuses its entry."""
+    qe = QueryExecutor([segment])
+    q_hour = TimeseriesQuery(datasource="test", intervals=[DAY],
+                             granularity=Granularity.HOUR, aggregations=AGGS)
+    q_all = TimeseriesQuery(datasource="test", intervals=[DAY],
+                            granularity=Granularity.ALL, aggregations=AGGS)
+    qe.run(q_hour)
+    assert device_counter.count == 1
+    qe.run(q_all)
+    assert device_counter.count == 2
+    qe.run(q_hour)
+    qe.run(q_all)
+    assert device_counter.count == 2
+
+
+def test_repeated_sharded_query_compiles_once(segments, sharded_counter):
+    """The shard_map program (distributed.py) is likewise built exactly
+    once for repeated identical queries over the mesh."""
+    mesh = make_mesh()
+    q = TimeseriesQuery(datasource="test",
+                        intervals=[Interval.of("2026-01-01", "2026-01-05")],
+                        granularity=Granularity.DAY, aggregations=AGGS)
+    with use_mesh(mesh):
+        qe = QueryExecutor(segments)
+        first = qe.run(q)
+        assert sharded_counter.count == 1, (
+            "sharded path did not run (or built more than once)")
+        for _ in range(3):
+            assert qe.run(q) == first
+        assert sharded_counter.count == 1, (
+            "repeated identical sharded queries rebuilt the shard_map "
+            "program — _FN_CACHE regressed")
+
+
+def test_jit_cache_is_bounded(segment, device_counter, monkeypatch):
+    """The LRU cap evicts oldest structures instead of growing without
+    bound (compiled executables pin memory)."""
+    monkeypatch.setattr(grouping, "_JIT_CACHE_CAP", 1)
+    qe = QueryExecutor([segment])
+    q_hour = TimeseriesQuery(datasource="test", intervals=[DAY],
+                             granularity=Granularity.HOUR, aggregations=AGGS)
+    q_all = TimeseriesQuery(datasource="test", intervals=[DAY],
+                            granularity=Granularity.ALL, aggregations=AGGS)
+    qe.run(q_hour)
+    qe.run(q_all)
+    assert len(grouping._JIT_CACHE) == 1
+    qe.run(q_hour)   # evicted by q_all: must rebuild
+    assert device_counter.count == 3

@@ -1,0 +1,139 @@
+"""Tier-1 druidlint gate: the shipped tree must be clean of new findings,
+the analyzer must stay fast, and each rule must actually fire when its
+invariant is violated (a gate whose rules never fire is no gate).
+
+Reference for the pattern: the checkstyle/forbidden-apis gates the Java
+reference runs in its build — mechanical invariants, not review memory.
+"""
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.druidlint import (lint_paths, load_baseline,  # noqa: E402
+                             load_config, registered_rules)
+from tools.druidlint.core import split_by_baseline  # noqa: E402
+
+
+def test_tree_is_clean_and_fast():
+    """`python -m tools.druidlint --fail-on-new` exits 0 on the shipped
+    tree, and the full-package scan stays under the 10s budget."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--fail-on-new"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (
+        f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
+    assert elapsed < 10.0, f"druidlint scan took {elapsed:.1f}s (budget 10s)"
+
+
+def test_baseline_is_near_empty():
+    """Grandfathered findings must stay below 10 — the gate is strict."""
+    baseline = load_baseline(REPO_ROOT / "tools/druidlint/baseline.json")
+    assert len(baseline) < 10, (
+        f"baseline grew to {len(baseline)} findings — fix them instead of "
+        f"grandfathering")
+
+
+def test_baseline_has_no_stale_entries():
+    """Every baseline entry must still correspond to a real finding,
+    else fixed code leaves dead grandfather slots a regression could
+    silently reclaim."""
+    config = load_config(REPO_ROOT)
+    findings = lint_paths(REPO_ROOT, config)
+    baseline = load_baseline(REPO_ROOT / config.baseline)
+    _, _, stale = split_by_baseline(findings, baseline)
+    assert not stale, f"stale baseline entries: {stale}"
+
+
+# one canonical violation per rule: the gate must fail when any of these
+# patterns lands in the tree
+VIOLATIONS = {
+    "unfenced-metadata-write": (
+        "druid_tpu/cluster/coordinator.py",
+        "def duty(self):\n    self.metadata.publish_segments(descs)\n"),
+    "jit-in-hot-path": (
+        "druid_tpu/engine/hot.py",
+        "import jax\n"
+        "def per_segment(arrays):\n"
+        "    return jax.jit(lambda x: x + 1)(arrays)\n"),
+    "host-device-sync": (
+        "druid_tpu/engine/hot.py",
+        "import jax\n"
+        "def kernel(x):\n"
+        "    return float(x.sum())\n"
+        "fn = jax.jit(kernel)\n"),
+    "no-executable-deserialization": (
+        "druid_tpu/cluster/wire.py",
+        "import pickle\n"
+        "def decode(b):\n"
+        "    return pickle.loads(b)\n"),
+    "swallowed-exception": (
+        "druid_tpu/cluster/anything.py",
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"),
+    "lock-scope": (
+        "druid_tpu/cluster/anything.py",
+        "import time\n"
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        time.sleep(1)\n"),
+}
+
+
+@pytest.mark.parametrize("rule_name", sorted(VIOLATIONS))
+def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
+    """Introducing a violation of each rule makes the CLI exit non-zero."""
+    rel, source = VIOLATIONS[rule_name]
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(source)
+    empty_baseline = tmp_path / "baseline.json"
+    empty_baseline.write_text(json.dumps({"version": 1, "findings": []}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--root", str(tmp_path),
+         "--baseline", str(empty_baseline), "--fail-on-new", "--json",
+         "druid_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1, (
+        f"{rule_name}: expected failure, got rc={proc.returncode}\n"
+        f"{proc.stdout}{proc.stderr}")
+    rules_hit = {f["rule"] for f in json.loads(proc.stdout)["findings"]}
+    assert rule_name in rules_hit, (
+        f"expected {rule_name} among {rules_hit}")
+
+
+def test_rule_registry_is_complete():
+    """All six project rules are registered with severities."""
+    rules = registered_rules()
+    assert set(VIOLATIONS) <= set(rules)
+    for r in rules.values():
+        assert r.severity in ("error", "warning")
+
+
+def test_pycache_artifacts_are_ignored(tmp_path):
+    """A stale module under __pycache__ (or a .pyc) never produces
+    findings — scans must reflect the live tree only."""
+    bad = ("def f():\n"
+           "    try:\n"
+           "        g()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    cachedir = tmp_path / "druid_tpu" / "__pycache__"
+    cachedir.mkdir(parents=True)
+    (cachedir / "stale.py").write_text(bad)
+    (tmp_path / "druid_tpu" / "stale.cpython-310.pyc").write_text(bad)
+    config = load_config(tmp_path)
+    findings = lint_paths(tmp_path, config, ["druid_tpu"])
+    assert findings == []

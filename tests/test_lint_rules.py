@@ -1,0 +1,489 @@
+"""Per-rule druidlint unit tests: positive + negative synthetic snippets
+for each rule, suppression-comment behavior, config parsing, and baseline
+round-trip semantics."""
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.druidlint import check_source  # noqa: E402
+from tools.druidlint.core import (Finding, LintConfig, load_baseline,  # noqa: E402
+                                  load_config, save_baseline,
+                                  split_by_baseline, _read_druidlint_table)
+
+
+def rules_hit(source, path="druid_tpu/x.py", config=None):
+    return {f.rule for f in check_source(textwrap.dedent(source),
+                                         path, config)}
+
+
+# ---- unfenced-metadata-write ---------------------------------------------
+
+DUTY = "druid_tpu/cluster/coordinator.py"
+
+
+def test_unfenced_write_flagged():
+    src = """
+    def cycle(self):
+        self.metadata.mark_unused(ids)
+    """
+    assert "unfenced-metadata-write" in rules_hit(src, DUTY)
+
+
+def test_fenced_write_ok():
+    src = """
+    def cycle(self):
+        self.metadata.mark_unused(ids, fence=self._fence())
+    """
+    assert "unfenced-metadata-write" not in rules_hit(src, DUTY)
+
+
+def test_unfenced_write_outside_duty_module_ok():
+    src = """
+    def cycle(self):
+        self.metadata.mark_unused(ids)
+    """
+    assert "unfenced-metadata-write" not in rules_hit(
+        src, "druid_tpu/ingest/streaming.py")
+
+
+@pytest.mark.parametrize("mutator", ["publish_segments", "delete_segments",
+                                     "insert_task", "update_task_status",
+                                     "mark_used"])
+def test_every_fenced_mutator_is_checked(mutator):
+    src = f"""
+    def cycle(self):
+        self.metadata.{mutator}(x)
+    """
+    assert "unfenced-metadata-write" in rules_hit(src, DUTY)
+
+
+# ---- jit-in-hot-path ------------------------------------------------------
+
+ENGINE = "druid_tpu/engine/foo.py"
+
+
+def test_jit_per_call_flagged():
+    src = """
+    import jax
+    def per_segment(arrays):
+        return jax.jit(lambda x: x + 1)(arrays)
+    """
+    assert "jit-in-hot-path" in rules_hit(src, ENGINE)
+
+
+def test_shard_map_per_call_flagged():
+    src = """
+    from jax.experimental.shard_map import shard_map
+    def per_query(body, mesh):
+        return shard_map(body, mesh=mesh)
+    """
+    assert "jit-in-hot-path" in rules_hit(src, ENGINE)
+
+
+def test_jit_at_module_level_ok():
+    src = """
+    import jax
+    compiled = jax.jit(lambda x: x + 1)
+    """
+    assert "jit-in-hot-path" not in rules_hit(src, ENGINE)
+
+
+def test_jit_behind_module_cache_ok():
+    """The grouping.py/distributed.py idiom: builder + module-level cache."""
+    src = """
+    import jax
+    _CACHE = {}
+    def _build(sig):
+        return jax.jit(lambda x: x + 1)
+    def run(sig, arrays):
+        fn = _CACHE.get(sig)
+        if fn is None:
+            fn = _build(sig)
+            _CACHE[sig] = fn
+        return fn(arrays)
+    """
+    assert "jit-in-hot-path" not in rules_hit(src, ENGINE)
+
+
+def test_jit_behind_lru_cache_ok():
+    src = """
+    import functools
+    import jax
+    @functools.lru_cache(maxsize=64)
+    def _build(sig):
+        return jax.jit(lambda x: x + 1)
+    def run(sig, arrays):
+        return _build(sig)(arrays)
+    """
+    assert "jit-in-hot-path" not in rules_hit(src, ENGINE)
+
+
+def test_builder_with_unguarded_call_site_flagged():
+    """One cached call site does not excuse an uncached one."""
+    src = """
+    import jax
+    _CACHE = {}
+    def _build(sig):
+        return jax.jit(lambda x: x + 1)
+    def cached(sig):
+        _CACHE[sig] = _build(sig)
+        return _CACHE[sig]
+    def uncached(sig, arrays):
+        return _build(sig)(arrays)
+    """
+    assert "jit-in-hot-path" in rules_hit(src, ENGINE)
+
+
+# ---- host-device-sync -----------------------------------------------------
+
+def test_item_in_traced_fn_flagged():
+    src = """
+    import jax
+    def kernel(x):
+        return x.sum().item()
+    fn = jax.jit(kernel)
+    """
+    assert "host-device-sync" in rules_hit(src, ENGINE)
+
+
+def test_np_asarray_in_traced_fn_flagged():
+    src = """
+    import jax
+    import numpy as np
+    def kernel(x):
+        return np.asarray(x)
+    fn = jax.jit(kernel)
+    """
+    assert "host-device-sync" in rules_hit(src, ENGINE)
+
+
+def test_float_on_traced_value_flagged():
+    src = """
+    import jax
+    def kernel(x):
+        return float(x.sum())
+    fn = jax.jit(kernel)
+    """
+    assert "host-device-sync" in rules_hit(src, ENGINE)
+
+
+def test_traced_closure_is_transitively_checked():
+    """A helper called from a traced body is itself traced."""
+    src = """
+    import jax
+    def helper(x):
+        return x.tolist()
+    def kernel(x):
+        return helper(x)
+    fn = jax.jit(kernel)
+    """
+    assert "host-device-sync" in rules_hit(src, ENGINE)
+
+
+def test_host_helper_ok():
+    src = """
+    import numpy as np
+    def host_post(state):
+        return np.asarray(state).item()
+    """
+    assert "host-device-sync" not in rules_hit(src, ENGINE)
+
+
+def test_sync_outside_device_modules_ok():
+    src = """
+    import jax
+    def kernel(x):
+        return float(x.sum())
+    fn = jax.jit(kernel)
+    """
+    assert "host-device-sync" not in rules_hit(
+        src, "druid_tpu/cluster/broker.py")
+
+
+# ---- no-executable-deserialization ---------------------------------------
+
+WIRE = "druid_tpu/cluster/wire.py"
+
+
+@pytest.mark.parametrize("src,needle", [
+    ("import pickle\n", "import"),
+    ("from pickle import loads\n", "import"),
+    ("import marshal\n", "import"),
+    ("def f(b):\n    return eval(b)\n", "eval"),
+    ("def f(b):\n    exec(b)\n", "exec"),
+    ("class C:\n    def __reduce__(self):\n        return (C, ())\n",
+     "__reduce__"),
+])
+def test_executable_deserialization_flagged(src, needle):
+    assert "no-executable-deserialization" in rules_hit(src, WIRE)
+
+
+def test_server_modules_are_wire_facing():
+    assert "no-executable-deserialization" in rules_hit(
+        "import pickle\n", "druid_tpu/server/avatica.py")
+
+
+def test_json_on_wire_ok():
+    src = """
+    import json
+    def decode(b):
+        return json.loads(b)
+    """
+    assert rules_hit(src, WIRE) == set()
+
+
+def test_pickle_outside_wire_modules_ok():
+    assert "no-executable-deserialization" not in rules_hit(
+        "import pickle\n", "druid_tpu/storage/format.py")
+
+
+# ---- swallowed-exception --------------------------------------------------
+
+def test_silent_pass_flagged():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+    """
+    assert "swallowed-exception" in rules_hit(src)
+
+
+def test_bare_except_flagged():
+    src = """
+    def f():
+        try:
+            g()
+        except:
+            return None
+    """
+    assert "swallowed-exception" in rules_hit(src)
+
+
+def test_logged_handler_ok():
+    src = """
+    import logging
+    def f():
+        try:
+            g()
+        except Exception:
+            logging.getLogger(__name__).warning("ctx", exc_info=True)
+    """
+    assert "swallowed-exception" not in rules_hit(src)
+
+
+def test_reraise_ok():
+    src = """
+    def f():
+        try:
+            g()
+        except BaseException:
+            cleanup()
+            raise
+    """
+    assert "swallowed-exception" not in rules_hit(src)
+
+
+def test_recorded_exception_ok():
+    """Capturing `as e` and recording it observes the failure."""
+    src = """
+    def f(failures):
+        try:
+            g()
+        except Exception as e:
+            failures.append(str(e))
+    """
+    assert "swallowed-exception" not in rules_hit(src)
+
+
+def test_narrow_except_ok():
+    src = """
+    def f():
+        try:
+            g()
+        except (ValueError, KeyError):
+            pass
+    """
+    assert "swallowed-exception" not in rules_hit(src)
+
+
+# ---- lock-scope -----------------------------------------------------------
+
+def test_sleep_under_lock_flagged():
+    src = """
+    import time
+    def f(self):
+        with self._lock:
+            time.sleep(0.1)
+    """
+    assert "lock-scope" in rules_hit(src)
+
+
+def test_emit_under_lock_flagged():
+    src = """
+    def f(self):
+        with self._lock:
+            self.emitter.emit_metric("m", 1.0)
+    """
+    assert "lock-scope" in rules_hit(src)
+
+
+def test_sql_under_lock_flagged():
+    src = """
+    def f(self):
+        with self._lock:
+            self._conn.execute("SELECT 1")
+    """
+    assert "lock-scope" in rules_hit(src)
+
+
+def test_metadata_store_sql_exempt():
+    """metadata.py's lock serializes its sqlite conn — by design."""
+    src = """
+    def f(self):
+        with self._lock:
+            self._conn.execute("SELECT 1")
+    """
+    assert "lock-scope" not in rules_hit(src, "druid_tpu/cluster/metadata.py")
+
+
+def test_deferred_body_under_lock_ok():
+    """A def/lambda created under the lock runs later, outside it."""
+    src = """
+    import time
+    def f(self):
+        with self._lock:
+            def later():
+                time.sleep(1)
+            self.hooks.append(later)
+    """
+    assert "lock-scope" not in rules_hit(src)
+
+
+def test_compute_under_lock_ok():
+    src = """
+    def f(self):
+        with self._lock:
+            self.counter += 1
+            snapshot = dict(self.state)
+        self.emitter.emit_metric("m", 1.0)
+    """
+    assert "lock-scope" not in rules_hit(src)
+
+
+# ---- suppression ----------------------------------------------------------
+
+def test_inline_suppression_silences_named_rule():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:  # druidlint: disable=swallowed-exception
+            pass
+    """
+    assert "swallowed-exception" not in rules_hit(src)
+
+
+def test_inline_suppression_is_rule_specific():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:  # druidlint: disable=lock-scope
+            pass
+    """
+    assert "swallowed-exception" in rules_hit(src)
+
+
+def test_disable_all_silences_line():
+    src = """
+    import time
+    def f(self):
+        with self._lock:
+            time.sleep(1)  # druidlint: disable=all
+    """
+    assert rules_hit(src) == set()
+
+
+# ---- baseline round-trip --------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    findings = [
+        Finding("swallowed-exception", "druid_tpu/a.py", 10, 5, "m1",
+                "warning"),
+        Finding("lock-scope", "druid_tpu/b.py", 20, 9, "m2", "warning"),
+    ]
+    path = tmp_path / "baseline.json"
+    save_baseline(path, findings)
+    loaded = load_baseline(path)
+    assert set(loaded) == {f.key for f in findings}
+
+    # same findings: nothing new, nothing stale
+    new, old, stale = split_by_baseline(findings, loaded)
+    assert (new, stale) == ([], []) and len(old) == 2
+
+    # one fixed, one fresh: fixed shows stale, fresh shows new
+    fresh = Finding("lock-scope", "druid_tpu/c.py", 3, 1, "m3", "warning")
+    new, old, stale = split_by_baseline([findings[0], fresh], loaded)
+    assert new == [fresh]
+    assert stale == [findings[1].key]
+    assert old == [findings[0]]
+
+
+def test_empty_baseline_file_means_everything_is_new(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "findings": []}))
+    f = Finding("lock-scope", "druid_tpu/a.py", 1, 1, "m", "warning")
+    new, old, stale = split_by_baseline([f], load_baseline(path))
+    assert new == [f] and old == [] and stale == []
+
+
+# ---- config ---------------------------------------------------------------
+
+def test_pyproject_table_parsing(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [project]
+        name = "x"
+
+        [tool.druidlint]
+        include = ["druid_tpu", "tools"]
+        duty-modules = [
+            "druid_tpu/cluster/coordinator.py",
+            "druid_tpu/indexing/overlord.py",
+        ]
+        baseline = "tools/druidlint/baseline.json"
+
+        [tool.other]
+        ignored = true
+    """))
+    cfg = load_config(tmp_path)
+    assert cfg.include == ["druid_tpu", "tools"]
+    assert cfg.duty_modules[1] == "druid_tpu/indexing/overlord.py"
+    assert cfg.baseline == "tools/druidlint/baseline.json"
+
+
+def test_unknown_config_key_rejected(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.druidlint]\nrulez = [\"swallowed-exception\"]\n")
+    with pytest.raises(ValueError, match="unknown"):
+        load_config(tmp_path)
+
+
+def test_unknown_rule_name_rejected():
+    cfg = LintConfig(rules=["no-such-rule"])
+    with pytest.raises(ValueError, match="unknown rules"):
+        check_source("x = 1\n", "druid_tpu/x.py", cfg)
+
+
+def test_repo_config_loads_and_enables_all_rules():
+    cfg = load_config(REPO_ROOT)
+    assert len(cfg.enabled_rules()) >= 6
+    table = _read_druidlint_table(REPO_ROOT / "pyproject.toml")
+    assert "include" in table

@@ -1,6 +1,8 @@
 """Reduction-strategy equivalence: mm / windowed / blocked / mixed must all
 produce identical results (reference semantics are strategy-independent —
 GroupByQueryEngineV2 vs vectorized engines return the same rows)."""
+import collections
+
 import numpy as np
 import pytest
 
@@ -300,7 +302,7 @@ def test_pallas_compile_failure_falls_back(monkeypatch):
     monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 0)
     monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
     monkeypatch.setattr(pallas_agg, "_BROKEN", None)
-    monkeypatch.setattr(grouping, "_JIT_CACHE", {})
+    monkeypatch.setattr(grouping, "_JIT_CACHE", collections.OrderedDict())
 
     def boom(*a, **k):
         raise RuntimeError("Mosaic failed to compile TPU kernel")

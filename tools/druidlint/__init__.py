@@ -1,0 +1,19 @@
+"""druidlint — project-invariant static analysis for druid-tpu.
+
+An AST-based analyzer (stdlib only) that mechanically enforces the
+invariants the codebase otherwise holds by convention: fenced control-plane
+writes, retrace-free engine hot paths, no executable deserialization on the
+wire, no silently swallowed exceptions, and no blocking work under locks.
+
+Usage:
+    python -m tools.druidlint [--fail-on-new] [paths...]
+
+Rules live in rules.py; configuration in pyproject.toml [tool.druidlint];
+grandfathered findings in baseline.json. See README "Static analysis".
+"""
+from tools.druidlint.core import (Finding, LintConfig, check_source,
+                                  lint_paths, load_baseline, load_config,
+                                  registered_rules)
+
+__all__ = ["Finding", "LintConfig", "check_source", "lint_paths",
+           "load_baseline", "load_config", "registered_rules"]

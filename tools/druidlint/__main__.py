@@ -1,0 +1,100 @@
+"""druidlint CLI.
+
+    python -m tools.druidlint                    # report every finding
+    python -m tools.druidlint --fail-on-new      # tier-1 gate: only
+                                                 # non-baselined findings fail
+    python -m tools.druidlint --update-baseline  # grandfather current state
+    python -m tools.druidlint --list-rules
+    python -m tools.druidlint druid_tpu/engine   # restrict scan paths
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from tools.druidlint.core import (lint_paths, load_baseline, load_config,
+                                  registered_rules, save_baseline,
+                                  split_by_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="druidlint")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: config include list)")
+    ap.add_argument("--root", default=".",
+                    help="repo root (pyproject.toml + baseline live here)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: config)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="fail only on findings absent from the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and args.paths:
+        # a partial scan would overwrite (and so silently drop) every
+        # grandfathered finding in the unscanned files
+        print("druidlint: --update-baseline requires a full scan — do not "
+              "pass explicit paths with it", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for name, r in sorted(registered_rules().items()):
+            doc = (r.check.__doc__ or r.description).strip().split("\n")[0]
+            print(f"{name} [{r.severity}]: {doc}")
+        return 0
+
+    root = Path(args.root).resolve()
+    try:
+        config = load_config(root)
+    except ValueError as e:
+        print(f"druidlint: config error: {e}", file=sys.stderr)
+        return 2
+    baseline_path = Path(args.baseline) if args.baseline \
+        else root / config.baseline
+
+    t0 = time.monotonic()
+    findings = lint_paths(root, config, args.paths or None)
+    elapsed = time.monotonic() - t0
+
+    if args.update_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"druidlint: baseline updated with {len(findings)} "
+              f"finding(s) at {baseline_path}")
+        return 0
+
+    if args.fail_on_new:
+        baseline = load_baseline(baseline_path)
+        new, old, stale = split_by_baseline(findings, baseline)
+        report = new
+    else:
+        new, old, stale = findings, [], []
+        report = findings
+
+    if args.as_json:
+        print(json.dumps({"findings": [f.to_json() | {"col": f.col,
+                                                      "severity": f.severity}
+                                       for f in report],
+                          "grandfathered": len(old),
+                          "stale_baseline": stale}, indent=2))
+    else:
+        for f in report:
+            print(f.format())
+        for key in stale:
+            print(f"druidlint: note: baseline entry no longer fires "
+                  f"(remove it): {key}")
+        label = "new finding(s)" if args.fail_on_new else "finding(s)"
+        print(f"druidlint: {len(report)} {label}, {len(old)} "
+              f"grandfathered, {len(stale)} stale baseline entr(ies) "
+              f"in {elapsed:.2f}s")
+    return 1 if report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

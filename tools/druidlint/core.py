@@ -1,0 +1,339 @@
+"""druidlint core: rule registry, config, suppressions, baseline, runner.
+
+Design notes:
+  * Findings key on (rule, path, line) — the same identity scheme the
+    baseline file uses, so `--fail-on-new` is a set difference.
+  * Suppression is per physical line: a `# druidlint: disable=<rule>[,..]`
+    comment on the line a finding anchors to silences it (`disable=all`
+    silences every rule on that line). Suppressions are for invariant-
+    preserving exceptions the rule cannot see (e.g. an availability probe
+    that must never raise); anything else belongs in the baseline or gets
+    fixed.
+  * Config comes from pyproject.toml [tool.druidlint]; the container's
+    Python (3.10) predates tomllib, so a minimal single-table parser
+    handles the subset this project writes (strings, string arrays, ints,
+    bools). Unknown keys are rejected loudly — a typoed option silently
+    disabling a rule would defeat the gate.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*druidlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.line}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.severity}] {self.rule}: {self.message}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass
+class Rule:
+    name: str
+    severity: str
+    description: str
+    check: Callable[["ModuleContext"], Iterable[Finding]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, severity: str, description: str):
+    """Register a rule. The decorated function receives a ModuleContext and
+    yields Findings (built via ctx.finding)."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"unknown severity {severity!r} for rule {name!r}")
+
+    def deco(fn):
+        _RULES[name] = Rule(name, severity, fn.__doc__ or description, fn)
+        return fn
+    return deco
+
+
+def registered_rules() -> Dict[str, Rule]:
+    from tools.druidlint import rules as _rules  # noqa: F401 (registration)
+    return dict(_RULES)
+
+
+# ---- configuration -------------------------------------------------------
+
+_DEFAULT_CONFIG = {
+    "include": ["druid_tpu", "tools", "bench.py", "__graft_entry__.py"],
+    "exclude": ["**/__pycache__/**", "*.pyc"],
+    "rules": [],                        # empty = all registered rules
+    "baseline": "tools/druidlint/baseline.json",
+    # unfenced-metadata-write: leader-duty modules whose MetadataStore
+    # mutations must thread a fencing term
+    "duty-modules": ["druid_tpu/cluster/coordinator.py",
+                     "druid_tpu/indexing/overlord.py"],
+    # no-executable-deserialization: modules that face the wire
+    "wire-modules": ["druid_tpu/cluster/wire.py",
+                     "druid_tpu/cluster/cache.py",
+                     "druid_tpu/server/*"],
+    # host-device-sync: modules whose traced functions are device code
+    "device-modules": ["druid_tpu/engine/*", "druid_tpu/parallel/*"],
+    # lock-scope: modules exempted because the lock EXISTS to serialize the
+    # blocking resource (metadata.py's lock guards its one sqlite conn)
+    "lock-scope-exclude": ["druid_tpu/cluster/metadata.py"],
+}
+
+
+@dataclass
+class LintConfig:
+    include: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["include"]))
+    exclude: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["exclude"]))
+    rules: List[str] = field(default_factory=list)
+    baseline: str = _DEFAULT_CONFIG["baseline"]
+    duty_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["duty-modules"]))
+    wire_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["wire-modules"]))
+    device_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["device-modules"]))
+    lock_scope_exclude: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["lock-scope-exclude"]))
+
+    def enabled_rules(self) -> Dict[str, Rule]:
+        all_rules = registered_rules()
+        if not self.rules:
+            return all_rules
+        unknown = set(self.rules) - set(all_rules)
+        if unknown:
+            raise ValueError(f"unknown rules in config: {sorted(unknown)}")
+        return {n: r for n, r in all_rules.items() if n in self.rules}
+
+
+def _parse_toml_value(raw: str):
+    raw = raw.strip()
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return ast.literal_eval(raw)
+    except (ValueError, SyntaxError):
+        raise ValueError(f"unsupported TOML value for druidlint: {raw!r}")
+
+
+def _read_druidlint_table(pyproject: Path) -> Dict[str, object]:
+    """Minimal parser for the [tool.druidlint] table (no tomllib on 3.10):
+    key = <string | int | bool | [string, ...]>, arrays may span lines."""
+    out: Dict[str, object] = {}
+    if not pyproject.exists():
+        return out
+    in_table = False
+    pending_key, pending_val = None, ""
+    header = re.compile(r"^\[([^\]]+)\]\s*(#.*)?$")
+    for line in pyproject.read_text().splitlines():
+        stripped = line.strip()
+        m = header.match(stripped)
+        if m:
+            in_table = m.group(1).strip() == "tool.druidlint"
+            continue
+        if not in_table or not stripped or stripped.startswith("#"):
+            continue
+        if pending_key is not None:
+            pending_val += " " + stripped
+            if stripped.endswith("]"):
+                out[pending_key] = _parse_toml_value(pending_val)
+                pending_key, pending_val = None, ""
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("[") and not val.endswith("]"):
+            pending_key, pending_val = key, val
+            continue
+        out[key] = _parse_toml_value(val)
+    if pending_key is not None:
+        raise ValueError(f"unterminated array for [tool.druidlint] "
+                         f"key {pending_key!r}")
+    return out
+
+
+def load_config(root: Path) -> LintConfig:
+    table = _read_druidlint_table(root / "pyproject.toml")
+    cfg = LintConfig()
+    known = {k.replace("_", "-") for k in vars(cfg)}
+    unknown = set(table) - known
+    if unknown:
+        raise ValueError(f"unknown [tool.druidlint] keys: {sorted(unknown)}")
+    for key, val in table.items():
+        setattr(cfg, key.replace("-", "_"), val)
+    return cfg
+
+
+# ---- per-module context ---------------------------------------------------
+
+class ModuleContext:
+    """Everything a rule needs about one module: path, AST (with parent
+    links), source lines, config."""
+
+    def __init__(self, path: str, source: str, config: LintConfig):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.config = config
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self._rule: Optional[Rule] = None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def path_matches(self, patterns: List[str]) -> bool:
+        return any(fnmatch.fnmatch(self.path, pat) or self.path == pat
+                   for pat in patterns)
+
+    def finding(self, node: ast.AST, message: str) -> Finding:
+        assert self._rule is not None
+        return Finding(self._rule.name, self.path,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1,
+                       message, self._rule.severity)
+
+
+def _suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def check_source(source: str, path: str,
+                 config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint one module given as a string — the unit-test entry point."""
+    config = config or LintConfig()
+    ctx = ModuleContext(path, source, config)
+    suppressed = _suppressions(ctx.lines)
+    findings: List[Finding] = []
+    for r in config.enabled_rules().values():
+        ctx._rule = r
+        for f in r.check(ctx):
+            lines_rules = suppressed.get(f.line, ())
+            if "all" in lines_rules or f.rule in lines_rules:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---- file collection + runner --------------------------------------------
+
+def _excluded(rel: str, config: LintConfig) -> bool:
+    return any(fnmatch.fnmatch(rel, pat) for pat in config.exclude)
+
+
+def collect_files(root: Path, config: LintConfig,
+                  paths: Optional[List[str]] = None) -> List[Path]:
+    roots = paths if paths else config.include
+    out: List[Path] = []
+    seen: Set[Path] = set()
+    for entry in roots:
+        p = (root / entry) if not Path(entry).is_absolute() else Path(entry)
+        if p.is_dir():
+            candidates: Iterator[Path] = sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            candidates = iter([p])
+        else:
+            continue
+        for c in candidates:
+            try:
+                rel = c.relative_to(root).as_posix()
+            except ValueError:
+                # outside the root (scratch file): rules keyed on repo
+                # paths simply won't match it
+                rel = c.as_posix()
+            if c in seen or _excluded(rel, config):
+                continue
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def lint_paths(root: Path, config: Optional[LintConfig] = None,
+               paths: Optional[List[str]] = None) -> List[Finding]:
+    config = config or load_config(root)
+    findings: List[Finding] = []
+    for f in collect_files(root, config, paths):
+        try:
+            rel = f.relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            findings.extend(check_source(source, rel, config))
+        except SyntaxError as e:
+            findings.append(Finding("syntax-error", rel, e.lineno or 1,
+                                    (e.offset or 0) + 1, str(e.msg),
+                                    "error"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---- baseline -------------------------------------------------------------
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    out = {}
+    for entry in data.get("findings", []):
+        key = f"{entry['rule']}:{entry['path']}:{entry['line']}"
+        out[key] = entry
+    return out
+
+
+def save_baseline(path: Path, findings: List[Finding]) -> None:
+    data = {"version": 1,
+            "findings": [f.to_json() for f in findings]}
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+
+
+def split_by_baseline(findings: List[Finding], baseline: Dict[str, dict]):
+    """Returns (new, grandfathered, stale-baseline-keys)."""
+    new = [f for f in findings if f.key not in baseline]
+    old = [f for f in findings if f.key in baseline]
+    stale = sorted(set(baseline) - {f.key for f in findings})
+    return new, old, stale

@@ -1,0 +1,389 @@
+"""druidlint rules — each one encodes a real hazard in this tree.
+
+Rules receive a ModuleContext and yield Findings. They are deliberately
+syntactic: no import resolution, no type inference. Where a rule needs a
+semantic boundary (which modules are leader-duty code, which face the
+wire), that boundary is configuration, not guesswork.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.druidlint.core import Finding, ModuleContext, rule
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.jit', 'self._lock');
+    non-name parts collapse to '?'."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) + "()"
+    return "?"
+
+
+def _terminal(node: ast.AST) -> str:
+    """Last identifier of a possibly-dotted expression."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+# ---- unfenced-metadata-write ---------------------------------------------
+
+FENCED_MUTATORS = {"publish_segments", "mark_unused", "mark_used",
+                   "delete_segments", "insert_task", "update_task_status"}
+
+
+@rule("unfenced-metadata-write", "error",
+      "lease-protected MetadataStore mutation without a fencing term")
+def check_unfenced_metadata_write(ctx: ModuleContext) -> Iterable[Finding]:
+    """In leader-duty modules (config `duty-modules`), every call to a
+    fence-capable MetadataStore mutator must pass `fence=` — a deposed
+    leader that writes without threading its term bypasses StaleTermError
+    and breaks single-writer-per-term."""
+    if not ctx.path_matches(ctx.config.duty_modules):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal(node.func)
+        if name in FENCED_MUTATORS and isinstance(node.func, ast.Attribute):
+            if not any(kw.arg == "fence" for kw in node.keywords):
+                yield ctx.finding(
+                    node, f"{name}() without fence= — thread the leader's "
+                          f"(service, term, holder) so stale-term writes "
+                          f"are rejected")
+
+
+# ---- jit-in-hot-path ------------------------------------------------------
+
+_JIT_CTORS = {"jit", "pjit", "pmap", "shard_map", "xmap"}
+_CACHE_DECORATORS = {"lru_cache", "cache"}
+
+
+def _decorator_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for d in getattr(fn, "decorator_list", []):
+        if isinstance(d, ast.Call):
+            d = d.func
+        out.add(_terminal(d))
+    return out
+
+
+def _call_is_cache_guarded(ctx: ModuleContext, call: ast.Call) -> bool:
+    """True when the builder call's result is memoized: either stored
+    directly into a subscript of a cache (`CACHE[k] = build(...)`), passed
+    to `.setdefault`, or assigned to a variable that is then stored into a
+    subscript (`fn = build(...); CACHE[sig] = fn`) within the same scope."""
+    scope = ctx.enclosing_function(call) or ctx.tree
+    parent = ctx.parent(call)
+    if isinstance(parent, ast.Call) and \
+            _terminal(parent.func) == "setdefault":
+        return True
+    bound: Optional[str] = None
+    if isinstance(parent, ast.Assign):
+        if any(isinstance(t, ast.Subscript) for t in parent.targets):
+            return True
+        if len(parent.targets) == 1 and isinstance(parent.targets[0],
+                                                   ast.Name):
+            bound = parent.targets[0].id
+    if bound is None:
+        return False
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Subscript) for t in node.targets) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == bound:
+            return True
+    return False
+
+
+@rule("jit-in-hot-path", "error",
+      "jax.jit/shard_map constructed per call instead of cached")
+def check_jit_in_hot_path(ctx: ModuleContext) -> Iterable[Finding]:
+    """`jax.jit` / `shard_map` / `pmap` construction inside a function body
+    re-traces (and on TPU recompiles) on every call — per-query/per-segment
+    paths must construct once at module level, behind functools.lru_cache,
+    or behind a module-level cache (`fn = CACHE.get(sig)` / `CACHE[sig] =
+    build(...)`). A builder function is accepted when every call site in the
+    module stores its result into such a cache."""
+    jit_calls: List[ast.Call] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _terminal(node.func) in _JIT_CTORS:
+            jit_calls.append(node)
+    if not jit_calls:
+        return
+
+    # all Call sites per function name, for builder-guard analysis
+    calls_by_name: Dict[str, List[ast.Call]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            calls_by_name.setdefault(node.func.id, []).append(node)
+
+    for call in jit_calls:
+        fn = ctx.enclosing_function(call)
+        if fn is None:
+            continue                       # module level: traced once
+        if isinstance(fn, _FUNC_DEFS) and \
+                _decorator_names(fn) & _CACHE_DECORATORS:
+            continue                       # memoized builder
+        fname = fn.name if isinstance(fn, _FUNC_DEFS) else "<lambda>"
+        sites = calls_by_name.get(fname, [])
+        if sites and all(_call_is_cache_guarded(ctx, s) for s in sites):
+            continue                       # every call site memoizes
+        ctor = _terminal(call.func)
+        yield ctx.finding(
+            call, f"{ctor}() constructed inside {fname}() — cache the "
+                  f"compiled callable (lru_cache or a module-level cache "
+                  f"keyed on the static structure) so repeated "
+                  f"queries/segments do not retrace")
+
+
+# ---- host-device-sync -----------------------------------------------------
+
+_TRACE_ENTRIES = {"jit", "pjit", "pmap", "vmap", "shard_map", "scan",
+                  "while_loop", "fori_loop", "cond", "checkpoint", "remat",
+                  "grad", "value_and_grad", "custom_vjp", "custom_jvp"}
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_SYNC_METHODS = {"item", "tolist"}
+_NUMPY_MATERIALIZERS = {"asarray", "array", "copy"}
+
+
+def _collect_traced_functions(ctx: ModuleContext) -> List[ast.AST]:
+    """Function defs whose bodies are traced device code: seeds are
+    functions passed (by name) to jit/vmap/shard_map/scan/... or decorated
+    with them; closure is taken over bare-name calls within traced bodies
+    (a helper invoked during tracing is itself traced)."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_DEFS):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced: Set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) in _TRACE_ENTRIES:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    traced.update(defs_by_name.get(arg.id, []))
+        if isinstance(node, _FUNC_DEFS) and \
+                _decorator_names(node) & _TRACE_ENTRIES:
+            traced.add(node)
+
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    for d in defs_by_name.get(node.func.id, []):
+                        if d not in traced:
+                            traced.add(d)
+                            changed = True
+    return sorted(traced, key=lambda n: n.lineno)
+
+
+@rule("host-device-sync", "error",
+      "host sync / host materialization inside traced device code")
+def check_host_device_sync(ctx: ModuleContext) -> Iterable[Finding]:
+    """Inside functions traced by jit/vmap/shard_map/scan (config
+    `device-modules`), `.item()`, `.tolist()`, `np.asarray`/`np.array`, and
+    `float()`/`int()`/`bool()` on traced values either fail at trace time
+    or force a device→host transfer per call — keep kernel bodies on
+    device and do host conversion outside the traced region."""
+    if not ctx.path_matches(ctx.config.device_modules):
+        return
+    for fn in _collect_traced_functions(ctx):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _SYNC_METHODS:
+                yield ctx.finding(
+                    node, f".{func.attr}() in traced function "
+                          f"{getattr(fn, 'name', '<fn>')}() forces a "
+                          f"host sync")
+            elif isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in _NUMPY_NAMES \
+                    and func.attr in _NUMPY_MATERIALIZERS:
+                yield ctx.finding(
+                    node, f"np.{func.attr}() in traced function "
+                          f"{getattr(fn, 'name', '<fn>')}() materializes "
+                          f"on host — use jnp inside device code")
+            elif isinstance(func, ast.Name) \
+                    and func.id in ("float", "int", "bool") \
+                    and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                yield ctx.finding(
+                    node, f"{func.id}() on a traced value in "
+                          f"{getattr(fn, 'name', '<fn>')}() forces a "
+                          f"host sync (concretization)")
+
+
+# ---- no-executable-deserialization ---------------------------------------
+
+_BANNED_SERDE_MODULES = {"pickle", "cPickle", "dill", "marshal", "shelve"}
+_BANNED_CALLS = {"eval", "exec"}
+_REDUCE_HOOKS = {"__reduce__", "__reduce_ex__"}
+
+
+@rule("no-executable-deserialization", "error",
+      "executable payload deserialization in a wire-facing module")
+def check_no_executable_deserialization(ctx: ModuleContext
+                                        ) -> Iterable[Finding]:
+    """Wire-facing modules (config `wire-modules`) must never deserialize
+    executable payloads: no pickle/dill/marshal/shelve, no eval/exec, no
+    __reduce__ hooks. A hostile peer's bytes may at worst poison data,
+    never execute code (see cluster/wire.py's tensor-bundle format)."""
+    if not ctx.path_matches(ctx.config.wire_modules):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in _BANNED_SERDE_MODULES:
+                    yield ctx.finding(
+                        node, f"import {alias.name} — executable "
+                              f"deserialization is banned on the wire")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and \
+                    node.module.split(".")[0] in _BANNED_SERDE_MODULES:
+                yield ctx.finding(
+                    node, f"from {node.module} import ... — executable "
+                          f"deserialization is banned on the wire")
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in _BANNED_CALLS:
+                yield ctx.finding(
+                    node, f"{func.id}() in a wire-facing module")
+            elif isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in _BANNED_SERDE_MODULES:
+                yield ctx.finding(
+                    node, f"{func.value.id}.{func.attr}() in a "
+                          f"wire-facing module")
+        elif isinstance(node, _FUNC_DEFS) and node.name in _REDUCE_HOOKS:
+            yield ctx.finding(
+                node, f"{node.name} defined in a wire-facing module — "
+                      f"reduce hooks are pickle's code-execution vector")
+
+
+# ---- swallowed-exception --------------------------------------------------
+
+_BROAD_TYPES = {"Exception", "BaseException"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "fatal", "log"}
+_EMIT_METHODS = {"emit", "emit_metric", "emit_alert"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(_terminal(e) in _BROAD_TYPES for e in t.elts)
+    return _terminal(t) in _BROAD_TYPES
+
+
+def _handler_observes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in (_LOG_METHODS | _EMIT_METHODS):
+            return True
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name \
+                and isinstance(node.ctx, ast.Load):
+            return True             # exception bound AND used (recorded)
+    return False
+
+
+@rule("swallowed-exception", "warning",
+      "broad except that neither logs, re-raises, nor records the error")
+def check_swallowed_exception(ctx: ModuleContext) -> Iterable[Finding]:
+    """Bare `except:` and `except Exception:` handlers must observe the
+    failure: log it with context, emit it, re-raise, or capture-and-record
+    the bound exception. Silent `pass`/`continue` hides real faults (a
+    partitioned lease store looks identical to a healthy idle one)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                and not _handler_observes(node):
+            what = "bare except" if node.type is None else \
+                f"except {_dotted(node.type)}"
+            yield ctx.finding(
+                node, f"{what} swallows the error — log with context, "
+                      f"narrow the type, or re-raise")
+
+
+# ---- lock-scope -----------------------------------------------------------
+
+_BLOCKING_ATTRS = _EMIT_METHODS | {"sleep", "urlopen"}
+_BLOCKING_PREFIXES = ("requests.", "subprocess.", "urllib.request.")
+_SQL_ATTRS = {"execute", "executemany", "executescript"}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _terminal(expr).lower()
+    return ("lock" in name or "mutex" in name) and "unlock" not in name
+
+
+@rule("lock-scope", "warning",
+      "blocking call (emit / sleep / I/O / SQL) while holding a lock")
+def check_lock_scope(ctx: ModuleContext) -> Iterable[Finding]:
+    """Emitter calls, sleeps, HTTP, subprocesses, and SQL execution inside
+    a `with <lock>:` body serialize unrelated threads behind one slow
+    operation (and deadlock when the callee re-enters). Compute under the
+    lock, do the blocking work outside it. Modules whose lock exists to
+    serialize the blocking resource itself are exempt via
+    `lock-scope-exclude`."""
+    if ctx.path_matches(ctx.config.lock_scope_exclude):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_is_lockish(item.context_expr) for item in node.items):
+            continue
+        for sub in ast.walk(node):
+            # deferred bodies run after the with-block: not under the lock
+            if isinstance(sub, _FUNC_DEFS + (ast.Lambda,)) :
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            if _enclosed_in_deferred(ctx, sub, node):
+                continue
+            dotted = _dotted(sub.func)
+            attr = _terminal(sub.func)
+            if attr in _BLOCKING_ATTRS \
+                    or dotted.startswith(_BLOCKING_PREFIXES):
+                yield ctx.finding(
+                    sub, f"{dotted}() while holding "
+                         f"{_dotted(node.items[0].context_expr)} — move "
+                         f"the blocking call outside the lock")
+            elif attr in _SQL_ATTRS and isinstance(sub.func, ast.Attribute):
+                yield ctx.finding(
+                    sub, f"SQL {attr}() while holding "
+                         f"{_dotted(node.items[0].context_expr)} — "
+                         f"queries under an unrelated lock serialize "
+                         f"readers behind the store")
+
+
+def _enclosed_in_deferred(ctx: ModuleContext, node: ast.AST,
+                          stop: ast.AST) -> bool:
+    cur = ctx.parent(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, _FUNC_DEFS + (ast.Lambda,)):
+            return True
+        cur = ctx.parent(cur)
+    return False
