@@ -1,0 +1,109 @@
+"""Engine ⇄ linter shared contracts — the single source of truth tracecheck
+(tools/druidlint/tracecheck.py) validates the Pallas + XLA engine layer
+against.
+
+Everything here is a plain Python constant: this module MUST stay importable
+without jax/numpy so the linter can load it standalone (by file path, no
+package import, no x64 side effects). The engine imports the same names, so
+a kernel edit that changes a contract changes exactly one place — and the
+tier-1 lint gate re-checks every declared invariant against the new value.
+
+Contract families:
+  * tile geometry   — lane width, pallas block/window constants
+  * capacity        — pallas group/field/slot caps + the VMEM tile budget
+  * dtype lattice   — byte widths, 64-bit dtypes, reduce-identity table
+  * AggKernel shape — required methods per reduce_kind
+  * symbol bounds   — value ranges for names the abstract interpreter
+                      cannot derive from the kernel module's own statements
+"""
+
+# ---- tile geometry --------------------------------------------------------
+
+LANE = 128            # TPU lane width: the last dim of every VMEM tile
+SUBLANE = 8           # float32 sublane count (min tile is (8, 128))
+
+BLK_SMALL_W = 2048    # pallas rows per block when the window is narrow
+BLK_WIDE_W = 1024     # pallas rows per block for wide windows
+SPAN_BLOCK = 1024     # block size Projection.max_span is measured over
+MAX_W = 1024          # widest supported aligned key window
+
+# ---- capacity -------------------------------------------------------------
+
+#: hard cap on num_total for the pallas strategy: the FULL accumulator grid
+#: for every output slot stays resident in VMEM across the whole grid, so
+#: the group space must be bounded for the vmem-budget contract to hold.
+MAX_PALLAS_GROUPS = 1 << 17
+
+#: max distinct value columns streamed into the kernel (one VMEM input tile
+#: each, alongside the key tile).
+MAX_PALLAS_FIELDS = 8
+
+#: max output slots (out_defs): 1 counts grid + at most 2 slots per op
+#: (the int32 lo/hi limb pair) across MAX_PALLAS_FIELDS ops.
+MAX_PALLAS_SLOTS = 1 + 2 * MAX_PALLAS_FIELDS
+
+#: per-core VMEM (v4/v5e/v5p class chips) and the budget the declared tiles
+#: must fit in. The budget is deliberately below the physical size: pallas
+#: double-buffers input tiles and Mosaic needs scratch headroom.
+#: Override per-repo via [tool.druidlint] vmem-cap-bytes.
+VMEM_BYTES = 16 * 1024 * 1024
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+#: widest element the pallas kernel ever tiles: ops accept int32/float32
+#: only (pallas_op eligibility) and pallas-accum-dtype bans 64-bit inside
+#: the kernel body, so 4 bytes bounds every declared tile.
+PALLAS_MAX_TILE_DTYPE_BYTES = 4
+
+# ---- dtype lattice --------------------------------------------------------
+
+DTYPE_BYTES = {
+    "bool": 1, "int8": 1, "uint8": 1,
+    "int16": 2, "uint16": 2, "float16": 2, "bfloat16": 2,
+    "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+
+#: dtypes that silently truncate to 32-bit under JAX's default
+#: x64-disabled mode (the x64-dtype rule's subject).
+X64_DTYPES = ("int64", "uint64", "float64")
+
+#: reduce identity literal → the accumulator dtype it belongs to. A dtype
+#: constructor applied to one of these extreme values inside the pallas
+#: module must use exactly this dtype (pallas-accum-dtype): the int-min
+#: identity / key sentinel is int32 2**31-1, the int-max identity is int32
+#: -(2**31), the float min/max identities are float32 ±inf.
+REDUCE_IDENTITIES = {
+    2 ** 31 - 1: "int32",
+    -(2 ** 31): "int32",
+    float("inf"): "float32",
+    float("-inf"): "float32",
+}
+
+# ---- AggKernel shape ------------------------------------------------------
+
+#: every concrete AggKernel subclass must define these (agg-contract).
+AGG_REQUIRED_METHODS = ("signature", "update", "combine", "empty_state")
+
+#: additionally required when the class's effective reduce_kind is "fold"
+#: (the base-class default): the sharded merge all_gathers states and folds
+#: them pairwise on device.
+AGG_FOLD_REQUIRED = ("device_combine",)
+
+# ---- symbol bounds for the abstract interpreter ---------------------------
+
+#: name → (lo, hi, multiple_of). Bounds for values tracecheck cannot derive
+#: from the scanned module's own assignments: function parameters and
+#: results of host-side planning calls. These ARE engine contracts —
+#: plan_window returns blk ≤ BLK_SMALL_W and a 128-aligned W ≤ MAX_W,
+#: usable() rejects num_total > MAX_PALLAS_GROUPS, and pallas_reduce
+#: asserts the field/slot caps — so the static bounds and the runtime
+#: checks cannot drift apart.
+SYMBOL_BOUNDS = {
+    "span": (1, MAX_W, 1),
+    "num_total": (1, MAX_PALLAS_GROUPS, 1),
+    "n": (1, 1 << 31, 1),
+    "BLK": (BLK_WIDE_W, BLK_SMALL_W, LANE),
+    "W": (LANE, MAX_W, LANE),
+    "len(uniq_fields)": (0, MAX_PALLAS_FIELDS, 1),
+    "len(out_defs)": (1, MAX_PALLAS_SLOTS, 1),
+}

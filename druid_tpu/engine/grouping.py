@@ -338,16 +338,26 @@ def eval_virtual_columns(arrays: Dict, t_abs, vc_plans, it=None) -> Dict:
     columns (reference: ExpressionVirtualColumn) into fused XLA elementwise
     ops; string-comparison LUTs stream in from the aux iterator `it`.
     Shared by the per-segment and sharded program builders."""
+    import jax
     import jax.numpy as jnp
 
+    # x64 gate: under JAX's default x64-disabled mode an astype(jnp.int64)
+    # silently produces int32 — request the wide dtypes only when the flag
+    # is actually on (engine/__init__ enables it), and name the narrow
+    # dtypes explicitly otherwise so the truncation is a stated contract,
+    # not an accident.
+    if jax.config.jax_enable_x64:
+        long_dt, double_dt = jnp.int64, jnp.float64
+    else:
+        long_dt, double_dt = jnp.int32, jnp.float32
     bindings = dict(arrays)
     bindings["__time"] = t_abs
     arrays = dict(arrays)
     for name, expr, out_type, n_luts in vc_plans:
         bindings["__luts"] = [next(it) for _ in range(n_luts)]
         val = expr.evaluate(bindings)
-        dt = {"long": jnp.int64, "double": jnp.float64,
-              "float": jnp.float32}.get(out_type, jnp.float64)
+        dt = {"long": long_dt, "double": double_dt,
+              "float": jnp.float32}.get(out_type, double_dt)
         arrays[name] = jnp.asarray(val).astype(dt)
         bindings[name] = arrays[name]
     return arrays
@@ -634,7 +644,7 @@ def _projection_strategy(proj: Projection, kernels: Sequence[AggKernel],
     kernel on TPU, the XLA windowed path elsewhere, scatter as last resort."""
     from druid_tpu.engine import pallas_agg
     span = proj.max_span
-    if pallas_agg.usable(kernels, col_dtypes, span):
+    if pallas_agg.usable(kernels, col_dtypes, span, num_total):
         return "pallas", span
     for w in WINDOW_CHOICES:
         if span <= w:
@@ -742,7 +752,9 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
 
         if vc_plans:
             time0 = next(it)
-            arrays = eval_virtual_columns(arrays, t.astype(jnp.int64) + time0,
+            # absolute __time needs all 64 bits (epoch millis overflow
+            # int32); engine/__init__ enables x64 before any trace runs
+            arrays = eval_virtual_columns(arrays, t.astype(jnp.int64) + time0,  # druidlint: disable=x64-dtype
                                           vc_plans, it)
 
         # time-in-intervals

@@ -240,7 +240,10 @@ class SumKernel(AggKernel):
             lo, hi = segment.column_minmax(spec.field)
             max_abs = max(abs(lo), abs(hi), 1)
             r = (2 ** 30) // max_abs
-            self.chunk_rows = max(1024, (r // 1024) * 1024)
+            # the bound only holds when ≥1024 rows fit under 2^30: values
+            # above ~2^20 would wrap the int32 partial inside ONE chunk —
+            # stay on the general int64 path instead of flooring the chunk
+            self.chunk_rows = (r // 1024) * 1024 if r >= 1024 else 0
             base = min(int(lo), 0)
             span = int(hi) - base
             nl = max(1, (span.bit_length() + 6) // 7)
@@ -346,7 +349,9 @@ class SumKernel(AggKernel):
 
             def body(acc, xs):
                 vb, kb = xs
-                return acc + _seg_sum(vb, kb, num).astype(jnp.int64), None
+                # int64 accumulation at group granularity IS the exact-sum
+                # contract (chunk analysis above); x64 is globally on
+                return acc + _seg_sum(vb, kb, num).astype(jnp.int64), None  # druidlint: disable=x64-dtype
 
             # derive the zero carry from the data so it inherits the
             # varying-axis type under shard_map (a plain zeros init is

@@ -33,10 +33,10 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-BLK_SMALL_W = 2048    # rows per block when the window is narrow
-BLK_WIDE_W = 1024
-SPAN_BLOCK = 1024     # block size Projection.max_span is measured over
-MAX_W = 1024          # widest supported aligned window
+from druid_tpu.engine.contracts import (BLK_SMALL_W, BLK_WIDE_W,
+                                        MAX_PALLAS_FIELDS, MAX_PALLAS_GROUPS,
+                                        MAX_PALLAS_SLOTS, MAX_W, SPAN_BLOCK)
+
 _FORCE_INTERPRET = False
 _BROKEN: Optional[str] = None
 
@@ -91,13 +91,41 @@ def plan_window(span: int) -> Tuple[int, int]:
     return 0, 0
 
 
-def usable(kernels: Sequence, col_dtypes: Dict, span: int) -> bool:
+#: ops that read one value column (a VMEM input tile each)
+_VALUE_OPS = ("sum_i32", "sum_f32", "min_i32", "max_i32", "min_f32",
+              "max_f32")
+
+
+def op_fields(ops: Sequence) -> list:
+    """Distinct value columns the kernel streams in, sorted (the in-spec
+    layout pallas_reduce builds)."""
+    return sorted({op[1] for op in ops if op[0] in _VALUE_OPS})
+
+
+def op_slots(ops: Sequence) -> int:
+    """Output slot count pallas_reduce's out_defs will have: the counts
+    grid + a lo/hi limb pair per int32 sum + one grid per other value op."""
+    return 1 + sum(2 if op[0] == "sum_i32" else
+                   1 if op[0] in _VALUE_OPS else 0
+                   for op in ops)
+
+
+def usable(kernels: Sequence, col_dtypes: Dict, span: int,
+           num_total: int) -> bool:
     if not backend_ok():
+        return False
+    if num_total > MAX_PALLAS_GROUPS:
+        # the full accumulator grid lives in VMEM across the whole grid;
+        # beyond the contract cap the vmem-budget guarantee no longer holds
         return False
     blk, _ = plan_window(span)
     if not blk:
         return False
-    return all(k.pallas_op(col_dtypes) is not None for k in kernels)
+    ops = [k.pallas_op(col_dtypes) for k in kernels]
+    if not all(o is not None for o in ops):
+        return False
+    return len(op_fields(ops)) <= MAX_PALLAS_FIELDS \
+        and op_slots(ops) <= MAX_PALLAS_SLOTS
 
 
 def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
@@ -116,6 +144,8 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
 
     BLK, W = plan_window(span)
     assert BLK, f"span {span} too wide for the pallas window"
+    assert num_total <= MAX_PALLAS_GROUPS, \
+        f"num_total {num_total} above the pallas group cap (vmem contract)"
     R = BLK // 128
     Wr = W // 128
     SENTINEL = jnp.int32(2**31 - 1)
@@ -134,13 +164,11 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
     keyx = jnp.where(mask, key.astype(jnp.int32), SENTINEL)
     keyx = pad_rows(keyx, SENTINEL).reshape(n2 // 128, 128)
 
-    # kernel inputs: key + one value column per op that reads one
-    in_fields = []
-    for op in ops:
-        if op[0] in ("sum_i32", "sum_f32", "min_i32", "max_i32", "min_f32",
-                     "max_f32"):
-            in_fields.append(op[1])
-    uniq_fields = sorted(set(in_fields))
+    # kernel inputs: key + one value column per op that reads one (the
+    # same layout helper usable() sized the plan with)
+    uniq_fields = op_fields(ops)
+    assert len(uniq_fields) <= MAX_PALLAS_FIELDS, \
+        f"{len(uniq_fields)} value columns exceed the pallas field cap"
     field_ix = {f: i for i, f in enumerate(uniq_fields)}
     vals2 = [pad_rows(arrays[f], np.array(0, arrays[f].dtype))
              .reshape(n2 // 128, 128) for f in uniq_fields]
@@ -171,6 +199,13 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
         elif op[0] in ("zero", "empty"):
             pass
     slot_ix = {name: j for j, (name, _) in enumerate(out_defs)}
+    # the builder above is authoritative; op_slots() (which usable() sized
+    # the plan with) must agree, so a new op kind cannot drift between them
+    assert len(out_defs) == op_slots(ops), \
+        f"out_defs {len(out_defs)} != op_slots {op_slots(ops)} — a new " \
+        f"pallas op kind updated one layout but not the other"
+    assert len(out_defs) <= MAX_PALLAS_SLOTS, \
+        f"{len(out_defs)} output slots exceed the pallas slot cap"
 
     def kernel(key_ref, *refs):
         vrefs = refs[:len(uniq_fields)]

@@ -416,8 +416,10 @@ def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
                          for i in range(k_local)]
                 st = functools.reduce(kernel.device_combine, parts)
         else:
+            # cross-segment integer sums widen to int64 before the fold —
+            # exactness contract, x64 globally on (engine/__init__)
             st = jax.tree.map(
-                lambda x: (x.astype(jnp.int64)
+                lambda x: (x.astype(jnp.int64)  # druidlint: disable=x64-dtype
                            if jnp.issubdtype(x.dtype, jnp.integer)
                            else x).sum(axis=0), stacked_state)
 
@@ -429,7 +431,8 @@ def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
     if kind == "sum":
         def local(x):
             if jnp.issubdtype(x.dtype, jnp.integer):
-                x = x.astype(jnp.int64)
+                # int64 before psum: exactness contract, x64 globally on
+                x = x.astype(jnp.int64)  # druidlint: disable=x64-dtype
             return x.sum(axis=0)
         st = jax.tree.map(local, stacked_state)
         return jax.tree.map(lambda x: lax.psum(x, axis), st)
@@ -474,9 +477,10 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
 
         if vc_plans:
             # expressions may reference absolute __time — the one consumer
-            # of 64-bit per-row time
+            # of 64-bit per-row time (epoch millis overflow int32; x64 is
+            # globally on via engine/__init__)
             arrays = eval_virtual_columns(
-                arrays, t.astype(jnp.int64) + time0, vc_plans, it)
+                arrays, t.astype(jnp.int64) + time0, vc_plans, it)  # druidlint: disable=x64-dtype
 
         # int32 relative bounds — no 64-bit elementwise time math
         within = (t[:, None] >= iv_rel[None, :, 0]) \
@@ -505,7 +509,8 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
         counts, states = jax.vmap(
             lambda a, t0, ivr, boff: per_segment(a, t0, ivr, boff, aux))(
                 stacked, time0s, iv_rel, bucket_off)
-        counts = jax.lax.psum(counts.astype(jnp.int64).sum(axis=0), axis)
+        # int64 count totals across devices: exactness, x64 globally on
+        counts = jax.lax.psum(counts.astype(jnp.int64).sum(axis=0), axis)  # druidlint: disable=x64-dtype
         merged = tuple(
             _merge_states(k, st, axis, n_dev, k_local)
             for k, st in zip(kernels, states))

@@ -89,6 +89,49 @@ VIOLATIONS = {
         "def f(self):\n"
         "    with self._lock:\n"
         "        time.sleep(1)\n"),
+    # ---- tracecheck rules ----
+    "pallas-tile-shape": (
+        "druid_tpu/engine/pallas_agg.py",
+        "from jax.experimental import pallas as pl\n"
+        "grid_spec = pl.GridSpec(\n"
+        "    grid=(8,),\n"
+        "    in_specs=[pl.BlockSpec((8, 64), lambda i: (i, 0))],\n"
+        ")\n"),
+    "pallas-accum-dtype": (
+        "druid_tpu/engine/pallas_agg.py",
+        "import jax.numpy as jnp\n"
+        "ident = jnp.float32(2**31 - 1)\n"),
+    "vmem-budget": (
+        "druid_tpu/engine/pallas_agg.py",
+        "from jax.experimental import pallas as pl\n"
+        "grid_spec = pl.GridSpec(\n"
+        "    grid=(8,),\n"
+        "    in_specs=[pl.BlockSpec((32768, 128), lambda i: (i, 0))],\n"
+        ")\n"),
+    "x64-dtype": (
+        "druid_tpu/engine/hot.py",
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.int64)\n"
+        "fn = jax.jit(f)\n"),
+    "agg-contract": (
+        "druid_tpu/ext/badkernel.py",
+        "from druid_tpu.engine.kernels import AggKernel\n"
+        "class BadKernel(AggKernel):\n"        # fold default, no
+        "    def signature(self):\n"           # device_combine
+        "        return \"bad\"\n"
+        "    def update(self, cols, mask, keys, num, aux):\n"
+        "        return None\n"
+        "    def combine(self, a, b):\n"
+        "        return a\n"
+        "    def empty_state(self, n):\n"
+        "        return None\n"),
+    "preferred-element-type": (
+        "druid_tpu/engine/hot.py",
+        "from jax import lax\n"
+        "def f(a, b):\n"
+        "    return lax.dot_general(a, b, (((1,), (0,)), ((), ())))\n"),
 }
 
 
@@ -115,9 +158,11 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 
 
 def test_rule_registry_is_complete():
-    """All six project rules are registered with severities."""
+    """All project rules (six control-plane + six tracecheck) plus the
+    unused-suppression audit are registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
+    assert "unused-suppression" in rules
     for r in rules.values():
         assert r.severity in ("error", "warning")
 

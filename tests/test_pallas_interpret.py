@@ -1,0 +1,150 @@
+"""Unit-level pallas_reduce parity in interpret mode: the fused kernel run
+through `pl.pallas_call(..., interpret=True)` on CPU must match the XLA
+segment-reduction semantics exactly for count/sum/min/max over the same
+synthetic sorted projection — so contract violations the static tracecheck
+pass cannot see (arithmetic bugs, limb-flush drift) still fail off-chip in
+tier-1, not on the chip suite.
+
+The executor-level equivalents live in test_strategies.py; these tests call
+pallas_reduce directly so a failure pinpoints the kernel, not the plan."""
+import numpy as np
+import pytest
+
+import druid_tpu.engine  # noqa: F401  (x64 on before jax numerics)
+from druid_tpu.data.segment import ValueType
+from druid_tpu.engine import pallas_agg
+from druid_tpu.engine.kernels import (CountKernel, MinMaxKernel, SumKernel,
+                                      make_kernel)
+from druid_tpu.query.aggregators import (CountAggregator,
+                                         FloatSumAggregator,
+                                         LongMaxAggregator,
+                                         LongMinAggregator,
+                                         LongSumAggregator)
+
+INT32_MAX = 2 ** 31 - 1
+INT32_MIN = -(2 ** 31)
+
+
+def _sorted_projection(rng, n, groups, lo, hi):
+    """Sorted compact keys (the Projection layout) + value columns."""
+    key = np.sort(rng.integers(0, groups, size=n)).astype(np.int32)
+    mask = rng.random(n) < 0.9
+    vlong = rng.integers(lo, hi, size=n).astype(np.int32)
+    vfloat = rng.normal(0.0, 100.0, size=n).astype(np.float32)
+    # span exactly as Projection measures it: max key spread per
+    # SPAN_BLOCK-row block
+    pad = (-n) % pallas_agg.SPAN_BLOCK
+    kp = np.concatenate([key, np.full(pad, key[-1], np.int32)]) if pad else key
+    kb = kp.reshape(-1, pallas_agg.SPAN_BLOCK)
+    span = int((kb.max(axis=1) - kb.min(axis=1) + 1).max())
+    return key, mask, vlong, vfloat, span
+
+
+def _ground_truth(key, mask, vlong, vfloat, num_total):
+    counts = np.zeros(num_total, np.int64)
+    lsum = np.zeros(num_total, np.int64)
+    fsum = np.zeros(num_total, np.float64)
+    lmin = np.full(num_total, INT32_MAX, np.int64)
+    lmax = np.full(num_total, INT32_MIN, np.int64)
+    np.add.at(counts, key[mask], 1)
+    np.add.at(lsum, key[mask], vlong[mask].astype(np.int64))
+    np.add.at(fsum, key[mask], vfloat[mask].astype(np.float64))
+    np.minimum.at(lmin, key[mask], vlong[mask].astype(np.int64))
+    np.maximum.at(lmax, key[mask], vlong[mask].astype(np.int64))
+    return counts, lsum, fsum, lmin, lmax
+
+
+def _kernels(chunk_rows):
+    kc = CountKernel(CountAggregator("rows"))
+    ks = SumKernel(LongSumAggregator("lsum", "vlong"), ValueType.LONG)
+    ks.chunk_rows = chunk_rows        # what segment staging derives on-disk
+    kf = SumKernel(FloatSumAggregator("fsum", "vfloat"), ValueType.FLOAT)
+    kmin = MinMaxKernel(LongMinAggregator("lmin", "vlong"),
+                        ValueType.LONG, False)
+    kmax = MinMaxKernel(LongMaxAggregator("lmax", "vlong"),
+                        ValueType.LONG, True)
+    return [kc, ks, kf, kmin, kmax]
+
+
+def _run_pallas(key, mask, vlong, vfloat, kernels, num_total, span,
+                monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    col_dtypes = {"vlong": np.dtype(np.int32), "vfloat": np.dtype(np.float32)}
+    assert pallas_agg.usable(kernels, col_dtypes, span, num_total)
+    counts, states = pallas_agg.pallas_reduce(
+        {"vlong": jnp.asarray(vlong), "vfloat": jnp.asarray(vfloat)},
+        jnp.asarray(mask), jnp.asarray(key), kernels, num_total, span)
+    return np.asarray(counts), [np.asarray(s) for s in states]
+
+
+def test_interpret_parity_count_sum_min_max(monkeypatch):
+    rng = np.random.default_rng(11)
+    key, mask, vlong, vfloat, span = _sorted_projection(
+        rng, 20_000, 300, -1000, 1000)
+    num_total = 512
+    counts, states = _run_pallas(key, mask, vlong, vfloat,
+                                 _kernels(chunk_rows=1 << 20), num_total,
+                                 span, monkeypatch)
+    gt_counts, gt_lsum, gt_fsum, gt_lmin, gt_lmax = _ground_truth(
+        key, mask, vlong, vfloat, num_total)
+    np.testing.assert_array_equal(counts.astype(np.int64), gt_counts)
+    np.testing.assert_array_equal(np.asarray(states[0], np.int64), gt_counts)
+    np.testing.assert_array_equal(np.asarray(states[1], np.int64), gt_lsum)
+    np.testing.assert_allclose(states[2], gt_fsum, rtol=1e-5, atol=1e-2)
+    # min/max states carry int32 identities for empty groups — exactly the
+    # contract identities declared in engine/contracts.py
+    np.testing.assert_array_equal(states[3].astype(np.int64), gt_lmin)
+    np.testing.assert_array_equal(states[4].astype(np.int64), gt_lmax)
+
+
+def test_interpret_limb_flush_exact_over_int32(monkeypatch):
+    """Totals far above int32 must survive the lo/hi limb flushes exactly
+    (chunk_rows small → flush every couple of blocks)."""
+    rng = np.random.default_rng(7)
+    key, mask, vlong, vfloat, span = _sorted_projection(
+        rng, 80_000, 6, 200_000, 260_000)
+    num_total = 8
+    counts, states = _run_pallas(key, mask, vlong, vfloat,
+                                 _kernels(chunk_rows=4096), num_total,
+                                 span, monkeypatch)
+    gt_counts, gt_lsum, *_ = _ground_truth(key, mask, vlong, vfloat,
+                                           num_total)
+    assert gt_lsum.max() > 2 ** 31          # the sums genuinely overflow
+    np.testing.assert_array_equal(counts.astype(np.int64), gt_counts)
+    np.testing.assert_array_equal(np.asarray(states[1], np.int64), gt_lsum)
+
+
+def test_usable_rejects_contract_cap_violations():
+    """usable() enforces the same caps contracts.py declares for the static
+    pass — group cap and ineligible dtypes fall back to XLA strategies."""
+    from druid_tpu.engine import contracts
+    kernels = _kernels(chunk_rows=1 << 20)
+    dts = {"vlong": np.dtype(np.int32), "vfloat": np.dtype(np.float32)}
+    pallas_agg.force_interpret(True)
+    try:
+        assert pallas_agg.usable(kernels, dts, 16, 512)
+        assert not pallas_agg.usable(kernels, dts, 16,
+                                     contracts.MAX_PALLAS_GROUPS + 1)
+        assert not pallas_agg.usable(kernels, dts, pallas_agg.MAX_W + 1, 512)
+        # float64 column: SumKernel(FLOAT) has no pallas op for it
+        assert not pallas_agg.usable(
+            kernels, {"vlong": np.dtype(np.int32),
+                      "vfloat": np.dtype(np.float64)}, 16, 512)
+    finally:
+        pallas_agg.force_interpret(False)
+
+
+def test_make_kernel_chunked_long_sum_matches_unit_setup():
+    """The chunk_rows the unit tests pin by hand is what segment staging
+    actually derives for an int32-staged long column (keeps the fixture
+    honest against the SumKernel analysis)."""
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.utils.intervals import Interval
+    seg = DataGenerator(
+        (ColumnSpec("metLong", "long", low=-1000, high=1000),),
+        seed=3).segment(4096, Interval.of("2026-01-01", "2026-01-02"))
+    k = make_kernel(LongSumAggregator("lsum", "metLong"), seg)
+    assert isinstance(k, SumKernel)
+    assert k.chunk_rows >= 2048         # pallas-eligible per pallas_op
+    assert k.pallas_op({"metLong": np.dtype(np.int32)}) is not None

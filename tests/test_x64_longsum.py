@@ -1,0 +1,81 @@
+"""x64 regression gate: long aggregates must stay exact for values near
+2**31. The engine force-enables jax_enable_x64 (engine/__init__.py) and
+eval_virtual_columns gates its long/double dtype mapping on that flag — if
+either regresses (x64 off, or the virtual-column "long" mapping drifting to
+a 32-bit or float dtype), sums of values near 2**31 silently truncate or
+round. These tests pin the exact-int64 contract end to end."""
+import numpy as np
+
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import (CountAggregator, LongMaxAggregator,
+                                         LongSumAggregator)
+from druid_tpu.query.model import (DefaultDimensionSpec,
+                                   ExpressionVirtualColumn, GroupByQuery,
+                                   TimeseriesQuery)
+from druid_tpu.utils.intervals import Interval
+
+INTERVAL = Interval.of("2026-01-01", "2026-01-02")
+NEAR_31 = 2 ** 31 - 9
+
+
+def _segments(n=6_000):
+    schema = (
+        ColumnSpec("dimA", "string", cardinality=5),
+        ColumnSpec("metBig", "long", low=NEAR_31 - 40, high=NEAR_31),
+    )
+    return DataGenerator(schema, seed=5).segments(2, n // 2, INTERVAL)
+
+
+def test_x64_enabled_for_engine():
+    import jax
+    import druid_tpu.engine  # noqa: F401
+    assert jax.config.jax_enable_x64, \
+        "engine/__init__ must enable x64 before any trace"
+
+
+def test_long_sum_exact_near_2_31():
+    segments = _segments()
+    q = GroupByQuery.of(
+        "bench", [INTERVAL], [DefaultDimensionSpec("dimA")],
+        [CountAggregator("n"), LongSumAggregator("s", "metBig"),
+         LongMaxAggregator("mx", "metBig")], granularity="all")
+    rows = QueryExecutor(segments).run(q)
+    want_sum = {}
+    want_max = {}
+    for seg in segments:
+        vals = seg.metrics["metBig"].values.astype(np.int64)
+        col = seg.dims["dimA"]
+        for gid, g in enumerate(col.dictionary.values):
+            m = col.ids == gid
+            want_sum[g] = want_sum.get(g, 0) + int(vals[m].sum())
+            if m.any():
+                want_max[g] = max(want_max.get(g, -2**63), int(vals[m].max()))
+    assert rows
+    for r in rows:
+        e = r["event"]
+        g = e["dimA"]
+        # every per-group total exceeds int32 — int64 is load-bearing
+        assert e["s"] > 2 ** 31
+        assert e["s"] == want_sum[g], g
+        assert e["mx"] == want_max[g], g
+
+
+def test_virtual_column_long_cast_exact_near_2_31():
+    """The eval_virtual_columns "long" dtype mapping (the x64-dtype true
+    positive this PR fixed) must produce exact int64 values: summing a
+    virtual long near 2**31 cannot truncate (int32 drift) or round
+    (float32 drift rounds 2**31-odd to a multiple of 256)."""
+    segments = _segments()
+    vc = ExpressionVirtualColumn("vbig", "metBig + 1", "long")
+    q = TimeseriesQuery.of(
+        "bench", [INTERVAL],
+        [CountAggregator("n"), LongSumAggregator("s", "vbig")],
+        granularity="all", virtual_columns=[vc])
+    rows = QueryExecutor(segments).run(q)
+    want = sum(int(seg.metrics["metBig"].values.astype(np.int64).sum())
+               + seg.n_rows for seg in segments)
+    assert len(rows) == 1
+    got = rows[0]["result"]["s"]
+    assert got > 2 ** 31
+    assert got == want

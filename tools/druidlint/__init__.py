@@ -8,8 +8,11 @@ wire, no silently swallowed exceptions, and no blocking work under locks.
 Usage:
     python -m tools.druidlint [--fail-on-new] [paths...]
 
-Rules live in rules.py; configuration in pyproject.toml [tool.druidlint];
-grandfathered findings in baseline.json. See README "Static analysis".
+Control-plane rules live in rules.py; the engine-layer shape/dtype/VMEM
+contract rules (abstract interpretation against
+druid_tpu/engine/contracts.py) in tracecheck.py; configuration in
+pyproject.toml [tool.druidlint]; grandfathered findings in baseline.json.
+See README "Static analysis".
 """
 from tools.druidlint.core import (Finding, LintConfig, check_source,
                                   lint_paths, load_baseline, load_config,

@@ -35,13 +35,19 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--only", default=None, metavar="RULE[,RULE...]",
+                    help="run only these rules (comma-separated)")
+    ap.add_argument("--report-unused-suppressions", action="store_true",
+                    help="also report disable pragmas that suppress nothing")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the mtime-keyed per-file scan cache")
     args = ap.parse_args(argv)
 
-    if args.update_baseline and args.paths:
-        # a partial scan would overwrite (and so silently drop) every
-        # grandfathered finding in the unscanned files
+    if args.update_baseline and (args.paths or args.only):
+        # a partial scan (by path OR by rule subset) would overwrite — and
+        # so silently drop — every grandfathered finding it didn't re-find
         print("druidlint: --update-baseline requires a full scan — do not "
-              "pass explicit paths with it", file=sys.stderr)
+              "pass explicit paths or --only with it", file=sys.stderr)
         return 2
 
     if args.list_rules:
@@ -56,11 +62,26 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"druidlint: config error: {e}", file=sys.stderr)
         return 2
+    if args.only:
+        config.rules = [r.strip() for r in args.only.split(",") if r.strip()]
+        unknown = set(config.rules) - set(registered_rules())
+        if unknown:
+            print(f"druidlint: unknown rules in --only: {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+    if args.report_unused_suppressions:
+        config.report_unused_suppressions = True
     baseline_path = Path(args.baseline) if args.baseline \
         else root / config.baseline
+    cache_path = None if args.no_cache else root / ".druidlint-cache.json"
 
     t0 = time.monotonic()
-    findings = lint_paths(root, config, args.paths or None)
+    try:
+        findings = lint_paths(root, config, args.paths or None,
+                              cache_path=cache_path)
+    except ValueError as e:
+        print(f"druidlint: {e}", file=sys.stderr)
+        return 2
     elapsed = time.monotonic() - t0
 
     if args.update_baseline:

@@ -77,6 +77,7 @@ def rule(name: str, severity: str, description: str):
 
 def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import rules as _rules  # noqa: F401 (registration)
+    from tools.druidlint import tracecheck as _tracecheck  # noqa: F401
     return dict(_RULES)
 
 
@@ -100,6 +101,14 @@ _DEFAULT_CONFIG = {
     # lock-scope: modules exempted because the lock EXISTS to serialize the
     # blocking resource (metadata.py's lock guards its one sqlite conn)
     "lock-scope-exclude": ["druid_tpu/cluster/metadata.py"],
+    # tracecheck: modules holding pallas kernels (tile/accum/vmem rules)
+    "pallas-modules": ["druid_tpu/engine/pallas_agg.py"],
+    # tracecheck: modules defining AggKernel subclasses (agg-contract)
+    "kernel-modules": ["druid_tpu/engine/kernels.py", "druid_tpu/ext/*"],
+    # tracecheck: VMEM tile budget in bytes; 0 = contracts.VMEM_BUDGET_BYTES
+    "vmem-cap-bytes": 0,
+    # unused-suppression audit (CLI --report-unused-suppressions)
+    "report-unused-suppressions": False,
 }
 
 
@@ -119,6 +128,15 @@ class LintConfig:
         default_factory=lambda: list(_DEFAULT_CONFIG["device-modules"]))
     lock_scope_exclude: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["lock-scope-exclude"]))
+    pallas_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["pallas-modules"]))
+    kernel_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["kernel-modules"]))
+    vmem_cap_bytes: int = 0
+    report_unused_suppressions: bool = False
+    #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
+    #: (set by load_config/lint_paths, not a pyproject key)
+    root: str = "."
 
     def enabled_rules(self) -> Dict[str, Rule]:
         all_rules = registered_rules()
@@ -178,12 +196,13 @@ def _read_druidlint_table(pyproject: Path) -> Dict[str, object]:
 def load_config(root: Path) -> LintConfig:
     table = _read_druidlint_table(root / "pyproject.toml")
     cfg = LintConfig()
-    known = {k.replace("_", "-") for k in vars(cfg)}
+    known = {k.replace("_", "-") for k in vars(cfg)} - {"root"}
     unknown = set(table) - known
     if unknown:
         raise ValueError(f"unknown [tool.druidlint] keys: {sorted(unknown)}")
     for key, val in table.items():
         setattr(cfg, key.replace("-", "_"), val)
+    cfg.root = str(root)
     return cfg
 
 
@@ -244,14 +263,45 @@ def check_source(source: str, path: str,
     config = config or LintConfig()
     ctx = ModuleContext(path, source, config)
     suppressed = _suppressions(ctx.lines)
+    used: Set[tuple] = set()            # (line, rule-or-"all") that matched
     findings: List[Finding] = []
-    for r in config.enabled_rules().values():
+    enabled = config.enabled_rules()
+    for r in enabled.values():
         ctx._rule = r
         for f in r.check(ctx):
             lines_rules = suppressed.get(f.line, ())
-            if "all" in lines_rules or f.rule in lines_rules:
+            if "all" in lines_rules:
+                used.add((f.line, "all"))
+                continue
+            if f.rule in lines_rules:
+                used.add((f.line, f.rule))
                 continue
             findings.append(f)
+    if config.report_unused_suppressions and "unused-suppression" in enabled:
+        sev = enabled["unused-suppression"].severity
+        all_rules = set(registered_rules())
+        for line, names in sorted(suppressed.items()):
+            if "unused-suppression" in names:
+                continue            # the audit's own pragma silences it
+            for name in sorted(names):
+                if (line, name) in used:
+                    continue
+                if name == "all":
+                    # only auditable when every rule ran this pass
+                    if config.rules:
+                        continue
+                    msg = ("disable=all suppresses no finding on this "
+                           "line — remove the dead pragma")
+                elif name not in all_rules:
+                    msg = (f"disable={name} names no registered rule — "
+                           f"a typoed pragma suppresses nothing")
+                elif name not in enabled:
+                    continue        # rule not run: usage unknowable
+                else:
+                    msg = (f"disable={name} suppresses no finding on "
+                           f"this line — remove the dead pragma")
+                findings.append(Finding("unused-suppression", path, line,
+                                        1, msg, sev))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -289,9 +339,56 @@ def collect_files(root: Path, config: LintConfig,
     return out
 
 
+def _cache_meta_sig(root: Path, config: LintConfig) -> str:
+    """Identity of everything findings depend on besides the scanned file:
+    the analyzer sources (rules + core + tracecheck), the engine contracts
+    module, and the effective config. Any drift drops the whole cache."""
+    from tools.druidlint.tracecheck import contracts_path  # lazy: no cycle
+    parts = [repr(sorted((k, v) for k, v in vars(config).items()))]
+    tool_files = sorted(Path(__file__).parent.glob("*.py"))
+    contracts = contracts_path(str(root))
+    if contracts is not None:
+        tool_files.append(contracts)
+    for p in tool_files:
+        try:
+            st = p.stat()
+            parts.append(f"{p.name}:{st.st_mtime_ns}:{st.st_size}")
+        except OSError:
+            parts.append(f"{p.name}:gone")
+    return "|".join(parts)
+
+
+def _finding_from_cache(entry: dict) -> Finding:
+    return Finding(entry["rule"], entry["path"], entry["line"],
+                   entry["col"], entry["message"], entry["severity"])
+
+
+def _finding_to_cache(f: Finding) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message, "severity": f.severity}
+
+
 def lint_paths(root: Path, config: Optional[LintConfig] = None,
-               paths: Optional[List[str]] = None) -> List[Finding]:
+               paths: Optional[List[str]] = None,
+               cache_path: Optional[Path] = None) -> List[Finding]:
+    """Lint the tree. With `cache_path`, per-file findings are reused when
+    the file's (mtime, size) and the analyzer/config identity are unchanged
+    — the full-tree scan stays inside the tier-1 time budget even with the
+    symbolic-shape rules enabled. Rules are strictly per-module, so file
+    identity is a sound cache key."""
     config = config or load_config(root)
+    config.root = str(root)
+    cache: Dict[str, dict] = {}
+    meta_sig = None
+    if cache_path is not None:
+        meta_sig = _cache_meta_sig(root, config)
+        try:
+            data = json.loads(cache_path.read_text())
+            if data.get("version") == 1 and data.get("meta") == meta_sig:
+                cache = data.get("files", {})
+        except (OSError, ValueError):
+            cache = {}
+    out_files: Dict[str, dict] = {}
     findings: List[Finding] = []
     for f in collect_files(root, config, paths):
         try:
@@ -299,15 +396,41 @@ def lint_paths(root: Path, config: Optional[LintConfig] = None,
         except ValueError:
             rel = f.as_posix()
         try:
+            st = f.stat()
+            key = f"{st.st_mtime_ns}:{st.st_size}"
+        except OSError:
+            key = "gone"
+        hit = cache.get(rel)
+        if hit is not None and hit.get("key") == key:
+            file_findings = [_finding_from_cache(e)
+                             for e in hit["findings"]]
+            findings.extend(file_findings)
+            out_files[rel] = hit
+            continue
+        try:
             source = f.read_text()
         except (OSError, UnicodeDecodeError):
             continue
         try:
-            findings.extend(check_source(source, rel, config))
+            file_findings = check_source(source, rel, config)
         except SyntaxError as e:
-            findings.append(Finding("syntax-error", rel, e.lineno or 1,
-                                    (e.offset or 0) + 1, str(e.msg),
-                                    "error"))
+            file_findings = [Finding("syntax-error", rel, e.lineno or 1,
+                                     (e.offset or 0) + 1, str(e.msg),
+                                     "error")]
+        findings.extend(file_findings)
+        out_files[rel] = {"key": key,
+                          "findings": [_finding_to_cache(x)
+                                       for x in file_findings]}
+    if cache_path is not None:
+        # merge over the loaded cache: a restricted-path scan must not
+        # truncate the full tree's entries (stale files re-key on read;
+        # deleted files linger harmlessly until the next meta change)
+        cache.update(out_files)
+        try:
+            cache_path.write_text(json.dumps(
+                {"version": 1, "meta": meta_sig, "files": cache}))
+        except OSError:
+            pass                      # cache is best-effort, never fatal
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

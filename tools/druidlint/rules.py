@@ -157,11 +157,16 @@ _SYNC_METHODS = {"item", "tolist"}
 _NUMPY_MATERIALIZERS = {"asarray", "array", "copy"}
 
 
-def _collect_traced_functions(ctx: ModuleContext) -> List[ast.AST]:
+def _collect_traced_functions(ctx: ModuleContext,
+                              extra_entries: frozenset = frozenset()
+                              ) -> List[ast.AST]:
     """Function defs whose bodies are traced device code: seeds are
     functions passed (by name) to jit/vmap/shard_map/scan/... or decorated
     with them; closure is taken over bare-name calls within traced bodies
-    (a helper invoked during tracing is itself traced)."""
+    (a helper invoked during tracing is itself traced). `extra_entries`
+    widens the seed set (tracecheck adds pallas_call so kernel bodies are
+    treated as traced code)."""
+    entries = _TRACE_ENTRIES | extra_entries
     defs_by_name: Dict[str, List[ast.AST]] = {}
     for node in ast.walk(ctx.tree):
         if isinstance(node, _FUNC_DEFS):
@@ -170,12 +175,12 @@ def _collect_traced_functions(ctx: ModuleContext) -> List[ast.AST]:
     traced: Set[ast.AST] = set()
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Call) and \
-                _terminal(node.func) in _TRACE_ENTRIES:
+                _terminal(node.func) in entries:
             for arg in list(node.args) + [kw.value for kw in node.keywords]:
                 if isinstance(arg, ast.Name):
                     traced.update(defs_by_name.get(arg.id, []))
         if isinstance(node, _FUNC_DEFS) and \
-                _decorator_names(node) & _TRACE_ENTRIES:
+                _decorator_names(node) & entries:
             traced.add(node)
 
     changed = True
@@ -387,3 +392,20 @@ def _enclosed_in_deferred(ctx: ModuleContext, node: ast.AST,
             return True
         cur = ctx.parent(cur)
     return False
+
+
+# ---- unused-suppression ---------------------------------------------------
+
+
+@rule("unused-suppression", "warning",
+      "druidlint disable pragma that suppresses nothing")
+def check_unused_suppression(ctx: ModuleContext) -> Iterable[Finding]:
+    """A `# druidlint: disable=<rule>` comment that silences no finding is
+    dead weight: burned-clean files accumulate pragmas that hide future
+    regressions on that line, and a typoed rule name suppresses nothing at
+    all. Findings are generated by core.check_source (the only place that
+    knows which suppressions matched); the registration here gives the rule
+    a severity, `--list-rules` visibility, and `--only` addressability.
+    Reported only under `--report-unused-suppressions` (config
+    `report-unused-suppressions`)."""
+    return ()
