@@ -53,12 +53,36 @@ class RemoteQueryError(RuntimeError):
 
 
 class DataNodeServer:
-    """Serves one DataNode's query surface over HTTP."""
+    """Serves one DataNode's query surface over HTTP.
+
+    Observability/pool plumbing: `emitter` (a ServiceEmitter) wires the
+    device-pool and batched-execution monitors — segment/devicePool/hitRate,
+    segment/devicePool/evictedBytes, query/batch/segments,
+    query/batch/fillRatio — on a MonitorScheduler owned by this server
+    (start()/stop() manage it; metrics_tick() drives it manually in tests).
+    `device_pool_bytes` sets the process-wide HBM budget staged segment
+    blocks LRU-evict against (the data node is where segments live, so its
+    server is where the budget is configured — the analog of the
+    historical's druid.server.maxSize)."""
 
     def __init__(self, node: DataNode, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, emitter=None,
+                 device_pool_bytes: Optional[int] = None,
+                 monitor_period_seconds: float = 60.0):
         self.node = node
         self.query_manager = QueryManager()
+        self.emitter = emitter
+        self._monitors = None
+        if device_pool_bytes is not None:
+            from druid_tpu.data.devicepool import device_pool
+            device_pool().configure(device_pool_bytes)
+        if emitter is not None:
+            from druid_tpu.data.devicepool import DevicePoolMonitor
+            from druid_tpu.engine.batching import BatchMetricsMonitor
+            from druid_tpu.utils.emitter import MonitorScheduler
+            self._monitors = MonitorScheduler(
+                emitter, [DevicePoolMonitor(), BatchMetricsMonitor()],
+                period_seconds=monitor_period_seconds)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -178,13 +202,23 @@ class DataNodeServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def metrics_tick(self) -> None:
+        """Drive the pool/batch monitors once (tests; the scheduler drives
+        them periodically after start())."""
+        if self._monitors is not None:
+            self._monitors.tick()
+
     def start(self) -> "DataNodeServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self._monitors is not None:
+            self._monitors.start()
         return self
 
     def stop(self) -> None:
+        if self._monitors is not None:
+            self._monitors.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -165,9 +165,11 @@ class DataNode:
         spaces align across nodes). Per-segment partials are cached when the
         segment cache is enabled (CachingQueryRunner analog).
 
-        `check` (cancel/timeout probe) runs between per-segment device
-        calls; with a mesh active the segments fuse into one sharded program
-        which is uninterruptible once launched."""
+        `check` (cancel/timeout probe) runs at every dispatch boundary —
+        between per-segment programs, between batched shape-bucket
+        dispatches, before the single sharded program (the engine threads
+        it through make_aggregate_partials); an individual device program
+        is uninterruptible once launched."""
         if not self.alive:
             raise ConnectionError(f"server [{self.name}] is down")
         segs, served = self._select(segment_ids)
@@ -175,17 +177,19 @@ class DataNode:
                      and self.cache_config.cacheable(query)
                      and self.cache_config.use_segment_cache)
         if not use_cache:
-            if (check is None and not (self.emitter is not None
-                                       and self.per_segment_metrics)) \
+            if not (self.emitter is not None and self.per_segment_metrics) \
                     or self.mesh is not None or len(segs) <= 1:
                 t0, c0 = time.monotonic(), time.thread_time()
-                ap = make_aggregate_partials(query, segs, clamp=False)
+                ap = make_aggregate_partials(query, segs, clamp=False,
+                                             check=check)
                 if segs:
-                    # fused/mesh execution: one timing over the whole set
+                    # fused/mesh/batched execution: one timing over the set
                     self._emit_segment(
                         query, f"{len(segs)}-segments",
                         (time.monotonic() - t0) * 1e3,
                         (time.thread_time() - c0) * 1e3, cached=False)
+                if check is not None:
+                    check()
             else:
                 parts = []
                 for s in segs:

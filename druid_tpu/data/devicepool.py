@@ -1,0 +1,224 @@
+"""Process-wide byte-budgeted pool of device-resident segment data.
+
+Reference analog: the historicals keeping segments mmapped and page-cached
+under one OS-level memory budget (SegmentLoaderLocalCacheManager + the page
+cache), rather than each segment bounding its own little cache. TPU-first
+translation: staged DeviceBlocks and derived padded device arrays pin HBM;
+the pool LRU-evicts by ACTUAL array bytes against one configurable budget,
+so cache pressure is a single observable number instead of per-segment
+entry counts (the old count-capped Segment._device_cache).
+
+Entries are owned by a Segment (via an opaque owner token); a segment being
+garbage-collected purges its entries through a weakref finalizer, so dropped
+segment generations release HBM without any explicit unload call.
+
+Stats (hits/misses/evictions/evictedBytes/residentBytes) feed the
+`segment/devicePool/*` emitter metrics (DevicePoolMonitor below, wired by
+cluster/dataserver.py).
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from druid_tpu.utils.emitter import Monitor
+
+
+def _default_budget() -> int:
+    env = os.environ.get("DRUID_TPU_DEVICE_POOL_BYTES")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    # lazy: importing the engine package at module-import time would cycle
+    # (engine -> data.segment -> devicepool); at first-use time the engine
+    # is importable and its x64 side effect is the intended global anyway
+    from druid_tpu.engine.contracts import DEVICE_POOL_BUDGET_BYTES
+    return DEVICE_POOL_BUDGET_BYTES
+
+
+def entry_bytes(value) -> int:
+    """Actual device bytes a pool entry pins: DeviceBlocks count their
+    array dict, containers count their leaves, arrays their nbytes."""
+    if value is None:
+        return 0
+    arrays = getattr(value, "arrays", None)
+    if isinstance(arrays, dict):
+        return sum(entry_bytes(v) for v in arrays.values())
+    if isinstance(value, dict):
+        return sum(entry_bytes(v) for v in value.values())
+    if isinstance(value, (tuple, list)):
+        return sum(entry_bytes(v) for v in value)
+    nbytes = getattr(value, "nbytes", None)
+    return int(nbytes) if nbytes is not None else 0
+
+
+@dataclass
+class PoolStats:
+    hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    evicted_bytes: int = 0
+    resident_bytes: int = 0
+    entries: int = 0
+    budget_bytes: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class DeviceSegmentPool:
+    """Byte-budgeted LRU over (owner, key) -> device value."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._budget = budget_bytes            # None -> resolve lazily
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Tuple, Tuple[object, int]]" \
+            = collections.OrderedDict()
+        self._owner_keys: Dict[int, Set[Tuple]] = {}
+        self._owner_seq = itertools.count(1)
+        self._resident = 0
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._evicted_bytes = 0
+
+    # ---- configuration --------------------------------------------------
+    @property
+    def budget_bytes(self) -> int:
+        """Resolved budget; <= 0 means unbounded (no eviction)."""
+        if self._budget is None:
+            self._budget = _default_budget()
+        return self._budget
+
+    def configure(self, budget_bytes: Optional[int]) -> None:
+        """Set the byte budget (None re-resolves env/contract default;
+        <= 0 disables eviction) and trims immediately."""
+        with self._lock:
+            self._budget = budget_bytes
+            budget = self.budget_bytes
+            if budget > 0:
+                self._evict_to(budget, keep=None)
+
+    # ---- owner registry -------------------------------------------------
+    def register_owner(self, obj) -> int:
+        """Opaque token for `obj`'s entries; a weakref finalizer purges
+        them when `obj` is collected (dropped segments release HBM)."""
+        token = next(self._owner_seq)
+        weakref.finalize(obj, self.purge_owner, token)
+        return token
+
+    def purge_owner(self, owner: int) -> int:
+        """Drop every entry owned by `owner`; returns bytes released.
+        Purges are bookkeeping, not cache pressure: they do not count as
+        evictions."""
+        freed = 0
+        with self._lock:
+            for key in self._owner_keys.pop(owner, ()):
+                value = self._entries.pop(key, None)
+                if value is not None:
+                    freed += value[1]
+            self._resident -= freed
+        return freed
+
+    # ---- cache surface --------------------------------------------------
+    def get_or_build(self, owner: int, key: Tuple, build: Callable[[], object]):
+        """LRU get; on miss, `build()` runs OUTSIDE the lock (staging does
+        device_put) — a concurrent duplicate build wastes work but cannot
+        corrupt the accounting (the replaced entry's bytes are subtracted)."""
+        full_key = (owner,) + tuple(key)
+        with self._lock:
+            hit = self._entries.get(full_key)
+            if hit is not None:
+                self._entries.move_to_end(full_key)
+                self._hits += 1
+                return hit[0]
+            self._misses += 1
+        value = build()
+        nbytes = entry_bytes(value)
+        with self._lock:
+            old = self._entries.pop(full_key, None)
+            if old is not None:
+                self._resident -= old[1]
+            self._entries[full_key] = (value, nbytes)
+            self._owner_keys.setdefault(owner, set()).add(full_key)
+            self._resident += nbytes
+            budget = self.budget_bytes
+            if budget > 0:
+                self._evict_to(budget, keep=full_key)
+        return value
+
+    def _evict_to(self, budget: int, keep: Optional[Tuple]) -> None:
+        """Caller holds the lock. `keep` (the just-inserted entry) survives
+        even when it alone exceeds the budget — the query running right now
+        must not have its own block evicted from under it."""
+        while self._resident > budget and self._entries:
+            key = next(iter(self._entries))
+            if key == keep:
+                if len(self._entries) == 1:
+                    return
+                self._entries.move_to_end(key)
+                continue
+            _, nbytes = self._entries.pop(key)
+            # key[0] is the owner token (get_or_build prefixes it)
+            self._owner_keys.get(key[0], set()).discard(key)
+            self._resident -= nbytes
+            self._evictions += 1
+            self._evicted_bytes += nbytes
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._owner_keys.clear()
+            self._resident = 0
+
+    # ---- observability --------------------------------------------------
+    def snapshot(self) -> PoolStats:
+        with self._lock:
+            return PoolStats(hits=self._hits, misses=self._misses,
+                             evictions=self._evictions,
+                             evicted_bytes=self._evicted_bytes,
+                             resident_bytes=self._resident,
+                             entries=len(self._entries),
+                             budget_bytes=self.budget_bytes)
+
+
+_POOL = DeviceSegmentPool()
+
+
+def device_pool() -> DeviceSegmentPool:
+    """The process-wide pool every Segment stages through."""
+    return _POOL
+
+
+class DevicePoolMonitor(Monitor):
+    """Emits `segment/devicePool/*` metrics per tick: the hit RATE over the
+    tick window (only when there was traffic — an idle pool emits no rate),
+    delta hit/miss/evicted counters, and resident gauges."""
+
+    def __init__(self, pool: Optional[DeviceSegmentPool] = None):
+        self.pool = pool or device_pool()
+        self._last = PoolStats()
+
+    def do_monitor(self, emitter):
+        s = self.pool.snapshot()
+        last, self._last = self._last, s
+        d_hits = s.hits - last.hits
+        d_misses = s.misses - last.misses
+        if d_hits + d_misses > 0:
+            emitter.metric("segment/devicePool/hitRate",
+                           d_hits / (d_hits + d_misses))
+        emitter.metric("segment/devicePool/hits", d_hits)
+        emitter.metric("segment/devicePool/misses", d_misses)
+        emitter.metric("segment/devicePool/evictedBytes",
+                       s.evicted_bytes - last.evicted_bytes)
+        emitter.metric("segment/devicePool/residentBytes", s.resident_bytes)
+        emitter.metric("segment/devicePool/entries", s.entries)

@@ -20,7 +20,6 @@ TPU-first design, replacing the per-row Cursor pull model:
 """
 from __future__ import annotations
 
-import collections
 import enum
 import threading
 from dataclasses import dataclass, field
@@ -36,8 +35,6 @@ from druid_tpu.utils.intervals import Interval
 # f32 min tile is (8, 128); pad row counts to a multiple of 8*128 so 1-D
 # columns reshape cleanly into (sublane, lane) tiles on device.
 DEFAULT_ROW_ALIGN = 1024
-#: max HBM-resident cache entries per segment (staged blocks + device aux)
-DEVICE_CACHE_CAP = 8
 
 
 class ValueType(enum.Enum):
@@ -197,11 +194,13 @@ class Segment:
         self.time_ordered = True if time_ordered is None else bool(time_ordered)
         self.min_time = int(self.time_ms.min()) if self.n_rows else 0
         self.max_time = int(self.time_ms.max()) if self.n_rows else 0
-        # LRU-bounded: entries pin HBM (staged blocks, padded device keys);
-        # query-dependent cache keys (interval tuples, projections) would
-        # otherwise grow without bound under e.g. sliding-window dashboards
-        self._device_cache: "collections.OrderedDict[Tuple, DeviceBlock]" = \
-            collections.OrderedDict()
+        # device-resident data (staged blocks, padded device keys) lives in
+        # the process-wide byte-budgeted pool: one HBM budget across all
+        # segments, LRU by actual bytes, entries purged when this segment
+        # is collected (data/devicepool.py)
+        from druid_tpu.data.devicepool import device_pool
+        self._pool = device_pool()
+        self._pool_owner = self._pool.register_owner(self)
         self._aux_cache: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
 
@@ -239,30 +238,33 @@ class Segment:
                      perm_key=None) -> DeviceBlock:
         """Stage (a subset of) columns to device, padded to static shape.
 
-        Staging is cached per (columns, row_align, device, perm_key); repeated
-        queries over the same segment hit HBM-resident arrays — the analog of
-        the reference keeping segments mmapped and page-cached
+        Staging is cached per (columns, row_align, device, perm_key) in the
+        process-wide byte-budgeted device pool; repeated queries over the
+        same segment hit HBM-resident arrays — the analog of the reference
+        keeping segments mmapped and page-cached
         (server/.../SegmentLoaderLocalCacheManager.java).
 
         `perm` applies a row permutation host-side before staging (the sorted
         projection path); callers must pass a stable hashable `perm_key`
         identifying it so the cache can distinguish layouts.
-        """
-        import jax
-        import jax.numpy as jnp
 
+        `row_align` also serves the batched multi-segment path: staging with
+        row_align >= n_rows pads to EXACTLY row_align rows, so batch-mates on
+        the same ladder rung stack into one [K, R] program.
+        """
         if perm is not None and perm_key is None:
             raise ValueError("device_block(perm=...) requires perm_key")
         if columns is None:
             columns = list(self.dims.keys()) + list(self.metrics.keys())
-        key = (tuple(sorted(set(columns))), row_align,
+        key = ("block", tuple(sorted(set(columns))), row_align,
                getattr(device, "id", None), perm_key)
-        with self._lock:
-            cached = self._device_cache.get(key)
-            if cached is not None:
-                self._device_cache.move_to_end(key)
-        if cached is not None:
-            return cached
+        return self._pool.get_or_build(
+            self._pool_owner, key,
+            lambda: self._stage_block(columns, row_align, device, perm))
+
+    def _stage_block(self, columns: Sequence[str], row_align: int,
+                     device, perm: Optional[np.ndarray]) -> DeviceBlock:
+        import jax
 
         pad_n = max(row_align, ((self.n_rows + row_align - 1) // row_align) * row_align)
         time0 = self.interval.start
@@ -302,33 +304,17 @@ class Segment:
 
         put = (lambda a: jax.device_put(a, device)) if device is not None \
             else jax.device_put
-        block = DeviceBlock(
+        return DeviceBlock(
             segment_id=self.id, n_rows=self.n_rows, padded_rows=pad_n,
             time0=time0, arrays={k: put(v) for k, v in arrays.items()},
             dictionaries=dictionaries,
         )
-        with self._lock:
-            self._device_cache[key] = block
-            self._device_cache.move_to_end(key)
-            while len(self._device_cache) > DEVICE_CACHE_CAP:
-                self._device_cache.popitem(last=False)
-        return block
 
     def device_cached(self, key: Tuple, fn):
-        """Memoize a derived DEVICE array through the same bounded LRU as
-        staged blocks (HBM entries must not accumulate per query shape)."""
-        key = ("aux",) + key
-        with self._lock:
-            if key in self._device_cache:
-                self._device_cache.move_to_end(key)
-                return self._device_cache[key]
-        value = fn()
-        with self._lock:
-            self._device_cache[key] = value
-            self._device_cache.move_to_end(key)
-            while len(self._device_cache) > DEVICE_CACHE_CAP:
-                self._device_cache.popitem(last=False)
-        return value
+        """Memoize a derived DEVICE array through the same byte-budgeted
+        pool as staged blocks (HBM entries must not accumulate per query
+        shape)."""
+        return self._pool.get_or_build(self._pool_owner, ("aux",) + key, fn)
 
     def column_minmax(self, name: str) -> Tuple[int, int]:
         """Cached (min, max) of a numeric column (0, 0 when empty)."""

@@ -54,6 +54,40 @@ VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 #: the kernel body, so 4 bytes bounds every declared tile.
 PALLAS_MAX_TILE_DTYPE_BYTES = 4
 
+# ---- batched multi-segment execution --------------------------------------
+
+#: max segments stacked into ONE batched device dispatch (engine/batching.py).
+#: Bounds both the stacked [K, R] working set and the worst-case host-side
+#: slice/post loop per dispatch.
+BATCH_MAX_SEGMENTS = 64
+
+#: below this many shape-compatible segments a batch never forms: one
+#: stacked program would dispatch exactly as many device calls as the
+#: per-segment path while paying an extra compile.
+BATCH_MIN_SEGMENTS = 2
+
+#: rows per segment above which batching stops paying: per-segment dispatch
+#: overhead is amortized by compute alone, and the in-program stack of a
+#: huge [K, R] block would double its HBM footprint for no win.
+BATCH_MAX_SEGMENT_ROWS = 1 << 21
+
+#: base rung of the padded-row ladder (must equal data.segment's
+#: DEFAULT_ROW_ALIGN — asserted by engine/batching.py at import). Rungs are
+#: powers of two times this, so at most
+#: log2(BATCH_MAX_SEGMENT_ROWS / BATCH_ROW_ALIGN) + 1 row shapes exist per
+#: plan structure — the compile-count bound of the batched path.
+BATCH_ROW_ALIGN = 1024
+
+# ---- device segment pool --------------------------------------------------
+
+#: default HBM byte budget for the process-wide device segment pool
+#: (data/devicepool.py): staged DeviceBlocks + derived padded device arrays
+#: LRU-evict by ACTUAL array bytes once the pool passes this. Deliberately
+#: far below a v5e/v5p core's HBM so query working sets (stacked batches,
+#: accumulator grids) always have headroom. Override via the
+#: DRUID_TPU_DEVICE_POOL_BYTES env var or DeviceSegmentPool.configure().
+DEVICE_POOL_BUDGET_BYTES = 4 * 1024 ** 3
+
 # ---- dtype lattice --------------------------------------------------------
 
 DTYPE_BYTES = {

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from druid_tpu.data.segment import Segment, ValueType
+from druid_tpu.engine import batching
 from druid_tpu.engine.filters import host_mask
 from druid_tpu.engine.grouping import KeyDim, run_grouped_aggregate
 from druid_tpu.engine.merge import merge_partials
@@ -221,19 +222,37 @@ def _vectorized_postaggs(postaggs, value_arrays: Dict[str, np.ndarray]):
     return out
 
 
-def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg):
+def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg,
+                   check=None):
     """Produce (partials, dim_values): ONE sharded device program when a mesh
-    is active and the segments agree on plan constants, else the per-segment
-    path merged host-side."""
+    is active and the segments agree on plan constants; else batched
+    multi-segment dispatches over shape-compatible segments (one jitted
+    program per shape bucket with the per-segment body unrolled inside it —
+    deliberately NOT vmapped, see engine/batching.py); else the per-segment
+    path. All variants merge host-side except the sharded one.
+
+    `check` (cancel/timeout probe) runs at every dispatch boundary: between
+    per-segment programs, between batched shape-bucket dispatches, and
+    before the single sharded program."""
+    if check is not None:
+        check()
     merged = distributed.try_sharded(segs, intervals, query.granularity,
                                      kds_per_seg, query.aggregations,
                                      query.filter, query.virtual_columns)
     if merged is not None:
         return [merged], [vals_per_seg[0]]
-    partials = [run_grouped_aggregate(
-        s, intervals, query.granularity, kds, query.aggregations,
-        query.filter, virtual_columns=query.virtual_columns)
-        for s, kds in zip(segs, kds_per_seg)]
+    partials = batching.run_with_batching(
+        segs, intervals, query.granularity, kds_per_seg, query.aggregations,
+        query.filter, query.virtual_columns, context=query.context_map,
+        check=check)
+    if partials is None:
+        partials = []
+        for s, kds in zip(segs, kds_per_seg):
+            if check is not None and partials:
+                check()
+            partials.append(run_grouped_aggregate(
+                s, intervals, query.granularity, kds, query.aggregations,
+                query.filter, virtual_columns=query.virtual_columns))
     return partials, list(vals_per_seg)
 
 
@@ -270,10 +289,12 @@ class AggregatePartials:
 
 
 def make_aggregate_partials(query, segments: Sequence[Segment],
-                            clamp: bool = True) -> AggregatePartials:
+                            clamp: bool = True,
+                            check=None) -> AggregatePartials:
     """Produce partial states for a timeseries/topN/groupBy query over local
     segments. `clamp=False` is used by the broker path: it pre-bounds the
-    query intervals globally so bucket index spaces align across nodes."""
+    query intervals globally so bucket index spaces align across nodes.
+    `check` (optional cancel/timeout probe) fires at dispatch boundaries."""
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
     if clamp and not query.granularity.is_all:
@@ -300,7 +321,8 @@ def make_aggregate_partials(query, segments: Sequence[Segment],
     else:
         raise TypeError(f"not an aggregate query: {type(query).__name__}")
     partials, dim_values = _make_partials(segs, intervals, query,
-                                          kds_per_seg, vals_per_seg)
+                                          kds_per_seg, vals_per_seg,
+                                          check=check)
     spans = [(s.min_time, s.max_time) for s in segs]
     return AggregatePartials(partials, dim_values, spans, intervals)
 

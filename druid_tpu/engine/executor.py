@@ -50,12 +50,22 @@ class QueryExecutor:
     """Runs queries over an in-process set of segments, grouped by datasource."""
 
     def __init__(self, segments: Optional[Sequence[Segment]] = None,
-                 mesh=None):
+                 mesh=None, device_pool_bytes: Optional[int] = None):
         """`mesh`: optional jax.sharding.Mesh — when set, eligible grouped
         aggregations run as one sharded device program over it (the
-        processing-pool analog, DruidProcessingModule.java:115)."""
+        processing-pool analog, DruidProcessingModule.java:115). Without a
+        mesh, shape-compatible segments batch into one device dispatch per
+        shape bucket (engine/batching.py; disable per query with context
+        {"batchSegments": false}).
+
+        `device_pool_bytes`: optional HBM budget for the process-wide
+        device segment pool (staged blocks LRU-evict by actual bytes past
+        it); None keeps the current/default budget."""
         self._by_ds: Dict[str, List[Segment]] = {}
         self.mesh = mesh
+        if device_pool_bytes is not None:
+            from druid_tpu.data.devicepool import device_pool
+            device_pool().configure(device_pool_bytes)
         for s in segments or ():
             self.add_segment(s)
 

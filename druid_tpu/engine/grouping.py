@@ -831,6 +831,139 @@ def _assemble_aux(spec: GroupSpec, segment: Segment, intervals: Sequence[Interva
     return tuple(aux)
 
 
+# ---------------------------------------------------------------------------
+# Shared multi-segment (stacked) execution pieces
+#
+# Both stacked executions — the batched program (engine/batching.py, the
+# per-segment body UNROLLED inside one jit) and the sharded shard_map
+# program (parallel/distributed.py, vmapped within each shard) — run ONE
+# device program over many segments. They share the per-segment traced
+# body and the aux layout below, so keying/filter/update semantics cannot
+# diverge from each other (and both call fuse_filter_update, so they
+# cannot diverge from the per-segment program either).
+# ---------------------------------------------------------------------------
+
+def make_stacked_segment_fn(spec: GroupSpec, kds: Sequence[KeyDim],
+                            filter_node: Optional[FilterNode],
+                            kernels: Sequence[AggKernel],
+                            vc_plans: Tuple = ()):
+    """Traced per-segment body for stacked execution: segment-specific
+    origins (time0, relative interval bounds, bucket origin) arrive as
+    mapped-axis arguments instead of aux constants, so one closure serves
+    every segment in the stack. Returns RAW (counts, states) — callers
+    apply device_post/host_post as their merge discipline requires."""
+    import jax.numpy as jnp
+
+    bucket_mode = spec.bucket_mode
+    num_total = spec.num_total
+    dim_cols = tuple(d.column for d in kds)
+    has_remap = tuple(d.remap is not None for d in kds)
+
+    def per_segment(arrays, time0, iv_rel, bucket_off, aux):
+        it = iter(aux)
+        t = arrays["__time_offset"]
+        mask = arrays["__valid"]
+
+        if vc_plans:
+            # expressions may reference absolute __time — the one consumer
+            # of 64-bit per-row time (epoch millis overflow int32; x64 is
+            # globally on via engine/__init__)
+            arrays = eval_virtual_columns(
+                arrays, t.astype(jnp.int64) + time0, vc_plans, it)  # druidlint: disable=x64-dtype
+
+        # int32 relative bounds — no 64-bit elementwise time math
+        within = (t[:, None] >= iv_rel[None, :, 0]) \
+            & (t[:, None] < iv_rel[None, :, 1])
+        mask = mask & jnp.any(within, axis=1)
+
+        if bucket_mode == "all":
+            key = jnp.zeros(t.shape, dtype=jnp.int32)
+        else:
+            period = next(it)
+            nb = next(it)
+            b = (t - bucket_off) // period
+            mask = mask & (b >= 0) & (b < nb)
+            key = b.astype(jnp.int32)
+
+        return fuse_filter_update(arrays, mask, key, it, dim_cols, has_remap,
+                                  filter_node, kernels, num_total,
+                                  strategy=spec.strategy, window=spec.window)
+
+    return per_segment
+
+
+def assemble_stacked_aux(spec: GroupSpec, kds: Sequence[KeyDim],
+                         f_aux: Sequence[np.ndarray],
+                         k_aux: Sequence[np.ndarray],
+                         granularity: Granularity,
+                         vc_luts: Sequence[np.ndarray] = ()) -> Tuple:
+    """Aux stream for make_stacked_segment_fn's reads: interval bounds and
+    bucket origins arrive as per-segment mapped args (NOT aux); only shared
+    plan constants live here. vc string-LUTs lead (consumed inside
+    eval_virtual_columns first)."""
+    aux: List[np.ndarray] = list(vc_luts)
+    if spec.bucket_mode == "uniform":
+        aux.append(np.asarray(granularity.period_ms, dtype=np.int32))
+        aux.append(np.asarray(spec.num_buckets, dtype=np.int32))
+    for d in kds:
+        if d.column is None:
+            continue
+        if d.remap is not None:
+            aux.append(d.remap.astype(np.int32))
+        aux.append(np.asarray(d.cardinality, dtype=np.int32))
+    aux.extend(f_aux)
+    aux.extend(k_aux)
+    return tuple(aux)
+
+
+def aux_equal(a: Sequence[np.ndarray], b: Sequence[np.ndarray]) -> bool:
+    """Plan-constant equality across segments (stacked-eligibility checks)."""
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype != y.dtype or x.shape != y.shape or not np.array_equal(x, y):
+            return False
+    return True
+
+
+def keydims_equal(a: Sequence[KeyDim], b: Sequence[KeyDim]) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x.column != y.column or x.cardinality != y.cardinality:
+            return False
+        if (x.remap is None) != (y.remap is None):
+            return False
+        if x.remap is not None and not np.array_equal(x.remap, y.remap):
+            return False
+    return True
+
+
+def needed_columns(segment: Segment, kds: Sequence[KeyDim],
+                   aggs: Sequence[AggregatorSpec], flt,
+                   virtual_columns: Sequence):
+    """Returns (all referenced real-column names, the subset present in
+    `segment` — i.e. the columns to stage)."""
+    from druid_tpu.utils.expression import parse_expression
+    vc_names = {v.name for v in virtual_columns}
+    needed = set()
+    for d in kds:
+        if d.column is not None:
+            needed.add(d.column)
+    if flt is not None:
+        needed |= flt.required_columns()
+    for a in aggs:
+        needed |= a.required_columns()
+    for v in virtual_columns:
+        needed |= parse_expression(v.expression).required_columns()
+    needed -= vc_names
+    needed -= {"__time", "__time_offset", "__valid"}
+    present = tuple(sorted(c for c in needed
+                           if c in segment.dims or c in segment.metrics))
+    return needed, present
+
+
 def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                           granularity: Granularity, dims: Sequence[KeyDim],
                           aggs: Sequence[AggregatorSpec],

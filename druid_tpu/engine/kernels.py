@@ -242,8 +242,12 @@ class SumKernel(AggKernel):
             r = (2 ** 30) // max_abs
             # the bound only holds when ≥1024 rows fit under 2^30: values
             # above ~2^20 would wrap the int32 partial inside ONE chunk —
-            # stay on the general int64 path instead of flooring the chunk
-            self.chunk_rows = (r // 1024) * 1024 if r >= 1024 else 0
+            # stay on the general int64 path instead of flooring the chunk.
+            # Power-of-two quantization (any chunk ≤ r keeps the bound):
+            # chunk_rows is a plan constant in signature(), and coarse steps
+            # let segments with near-identical value ranges share one
+            # batched/sharded program instead of splitting shape buckets
+            self.chunk_rows = 1 << (r.bit_length() - 1) if r >= 1024 else 0
             base = min(int(lo), 0)
             span = int(hi) - base
             nl = max(1, (span.bit_length() + 6) // 7)

@@ -28,9 +28,11 @@ from druid_tpu.data.segment import Segment
 from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
 from druid_tpu.engine import grouping
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
-                                       eval_virtual_columns,
-                                       fuse_filter_update, make_group_spec,
-                                       plan_virtual_columns, windowed_window)
+                                       assemble_stacked_aux, aux_equal,
+                                       keydims_equal, make_group_spec,
+                                       make_stacked_segment_fn,
+                                       needed_columns, plan_virtual_columns,
+                                       windowed_window)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
@@ -52,27 +54,11 @@ _STACK_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict
 _STACK_CACHE_CAP = 4
 
 
-def _aux_equal(a: List[np.ndarray], b: List[np.ndarray]) -> bool:
-    if len(a) != len(b):
-        return False
-    for x, y in zip(a, b):
-        x, y = np.asarray(x), np.asarray(y)
-        if x.dtype != y.dtype or x.shape != y.shape or not np.array_equal(x, y):
-            return False
-    return True
-
-
-def _keydims_equal(a: Sequence[KeyDim], b: Sequence[KeyDim]) -> bool:
-    if len(a) != len(b):
-        return False
-    for x, y in zip(a, b):
-        if x.column != y.column or x.cardinality != y.cardinality:
-            return False
-        if (x.remap is None) != (y.remap is None):
-            return False
-        if x.remap is not None and not np.array_equal(x.remap, y.remap):
-            return False
-    return True
+# plan-constant equality + column planning now live in engine/grouping.py,
+# shared with the batched (unrolled, engine/batching.py) multi-segment path
+_aux_equal = aux_equal
+_keydims_equal = keydims_equal
+_needed_columns = needed_columns
 
 
 def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
@@ -231,8 +217,7 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     iv_rel = _jax.device_put(iv_rel, _NS(mesh, _P(axis, None, None)))
     bucket_off = _jax.device_put(bucket_off, _NS(mesh, _P(axis)))
 
-    aux = _assemble_aux(spec0, intervals, kds, f_aux, k_aux, granularity,
-                        vc_luts)
+    aux = _assemble_aux(spec0, kds, f_aux, k_aux, granularity, vc_luts)
 
     sig = _sharded_sig(mesh, axis, spec0, kds, filter_node, kernels,
                        len(intervals), vc_plans, K, R)
@@ -253,30 +238,6 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     return SegmentPartial(segment=segments[0], spec=spec0,
                           counts=np.asarray(counts, dtype=np.int64),
                           states=host_states, kernels=kernels)
-
-
-def _needed_columns(segment: Segment, kds: Sequence[KeyDim],
-                    aggs: Sequence[AggregatorSpec], flt,
-                    virtual_columns: Sequence):
-    """Returns (all referenced real-column names, the subset present in
-    `segment` — i.e. the columns to stage)."""
-    from druid_tpu.utils.expression import parse_expression
-    vc_names = {v.name for v in virtual_columns}
-    needed = set()
-    for d in kds:
-        if d.column is not None:
-            needed.add(d.column)
-    if flt is not None:
-        needed |= flt.required_columns()
-    for a in aggs:
-        needed |= a.required_columns()
-    for v in virtual_columns:
-        needed |= parse_expression(v.expression).required_columns()
-    needed -= vc_names
-    needed -= {"__time", "__time_offset", "__valid"}
-    present = tuple(sorted(c for c in needed
-                           if c in segment.dims or c in segment.metrics))
-    return needed, present
 
 
 def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
@@ -358,26 +319,8 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     return result
 
 
-def _assemble_aux(spec: GroupSpec, intervals: Sequence[Interval],
-                  kds: Sequence[KeyDim], f_aux: List[np.ndarray],
-                  k_aux: List[np.ndarray], granularity: Granularity,
-                  vc_luts: Sequence[np.ndarray] = ()) -> Tuple:
-    # interval bounds + bucket origins arrive as per-segment int32 vmapped
-    # args (see try_sharded); only shared scalars live in aux.
-    # vc string-LUTs lead (consumed inside eval_virtual_columns first)
-    aux: List[np.ndarray] = list(vc_luts)
-    if spec.bucket_mode == "uniform":
-        aux.append(np.asarray(granularity.period_ms, dtype=np.int32))
-        aux.append(np.asarray(spec.num_buckets, dtype=np.int32))
-    for d in kds:
-        if d.column is None:
-            continue
-        if d.remap is not None:
-            aux.append(d.remap.astype(np.int32))
-        aux.append(np.asarray(d.cardinality, dtype=np.int32))
-    aux.extend(f_aux)
-    aux.extend(k_aux)
-    return tuple(aux)
+# aux layout shared with the batched path (engine/grouping.py)
+_assemble_aux = assemble_stacked_aux
 
 
 def _sharded_sig(mesh, axis, spec: GroupSpec, kds, filter_node, kernels,
@@ -465,41 +408,11 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
         _check_kw = "check_rep"            # and the old replication-check kw
     from jax.sharding import PartitionSpec as P
 
-    bucket_mode = spec.bucket_mode
-    num_total = spec.num_total
-    dim_cols = tuple(d.column for d in kds)
-    has_remap = tuple(d.remap is not None for d in kds)
+    seg_body = make_stacked_segment_fn(spec, kds, filter_node, kernels,
+                                       vc_plans)
 
     def per_segment(arrays, time0, iv_rel, bucket_off, aux):
-        it = iter(aux)
-        t = arrays["__time_offset"]
-        mask = arrays["__valid"]
-
-        if vc_plans:
-            # expressions may reference absolute __time — the one consumer
-            # of 64-bit per-row time (epoch millis overflow int32; x64 is
-            # globally on via engine/__init__)
-            arrays = eval_virtual_columns(
-                arrays, t.astype(jnp.int64) + time0, vc_plans, it)  # druidlint: disable=x64-dtype
-
-        # int32 relative bounds — no 64-bit elementwise time math
-        within = (t[:, None] >= iv_rel[None, :, 0]) \
-            & (t[:, None] < iv_rel[None, :, 1])
-        mask = mask & jnp.any(within, axis=1)
-
-        if bucket_mode == "all":
-            key = jnp.zeros(t.shape, dtype=jnp.int32)
-        else:
-            period = next(it)
-            nb = next(it)
-            b = (t - bucket_off) // period
-            mask = mask & (b >= 0) & (b < nb)
-            key = b.astype(jnp.int32)
-
-        counts, states = fuse_filter_update(arrays, mask, key, it, dim_cols,
-                                            has_remap, filter_node, kernels,
-                                            num_total, strategy=spec.strategy,
-                                            window=spec.window)
+        counts, states = seg_body(arrays, time0, iv_rel, bucket_off, aux)
         states = tuple(k.device_post(s, time0)
                        for k, s in zip(kernels, states))
         return counts, states

@@ -1,0 +1,262 @@
+"""Batched multi-segment execution (engine/batching.py): parity with the
+per-segment path, shape-bucket formation, compile-count bounds, stragglers.
+
+The parity assertions are EXACT (`==` on finished result rows, floats
+included): the batched program runs the same traced body over the same
+staged columns and post-processes with the same host_post, so results must
+be bit-identical, not merely close."""
+import collections
+
+import numpy as np
+import pytest
+
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.data.segment import SegmentBuilder, ValueType
+from druid_tpu.engine import batching
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-03-01", "2026-03-03")
+
+SCHEMA = (
+    ColumnSpec("dimA", "string", cardinality=8, distribution="uniform"),
+    ColumnSpec("dimB", "string", cardinality=40, distribution="zipf"),
+    ColumnSpec("metLong", "long", low=0, high=1000),
+    ColumnSpec("metFloat", "float", distribution="normal", mean=5.0, std=2.0),
+    ColumnSpec("metDouble", "double", low=0.0, high=1.0),
+)
+
+
+@pytest.fixture(autouse=True)
+def _batching_on(monkeypatch):
+    monkeypatch.setattr(batching, "_ENABLED", True)
+
+
+@pytest.fixture(scope="module")
+def mixed_segments():
+    """Same schema, mixed sizes -> two ladder rungs (3000->4096, 9000->16384)."""
+    gen = DataGenerator(SCHEMA, seed=7)
+    return gen.segments(4, 3000, IV, datasource="mix") \
+        + gen.segments(4, 9000, IV, datasource="mix")
+
+
+def run_both(segments, query_json):
+    ex = QueryExecutor(segments)
+    prev = batching.set_enabled(False)
+    try:
+        plain = ex.run_json(query_json)
+        batching.set_enabled(True)
+        before = batching.stats().snapshot()
+        batched = ex.run_json(query_json)
+        after = batching.stats().snapshot()
+    finally:
+        batching.set_enabled(prev)
+    return plain, batched, after["batches"] - before["batches"]
+
+
+AGGS = [{"type": "count", "name": "n"},
+        {"type": "longSum", "name": "ls", "fieldName": "metLong"},
+        {"type": "doubleSum", "name": "ds", "fieldName": "metDouble"},
+        {"type": "floatMax", "name": "fx", "fieldName": "metFloat"},
+        {"type": "longMin", "name": "lm", "fieldName": "metLong"}]
+
+
+def test_timeseries_parity_mixed_sizes(mixed_segments):
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "hour", "aggregations": AGGS}
+    plain, batched, n_batches = run_both(mixed_segments, q)
+    assert n_batches >= 2          # one dispatch per rung at least
+    assert plain == batched
+
+
+def test_topn_parity(mixed_segments):
+    q = {"queryType": "topN", "dataSource": "mix", "intervals": [str(IV)],
+         "granularity": "all", "dimension": "dimB", "metric": "ls",
+         "threshold": 9, "aggregations": AGGS}
+    plain, batched, n_batches = run_both(mixed_segments, q)
+    assert n_batches >= 2
+    assert plain == batched
+
+
+def test_groupby_parity_with_filter_and_virtual_column(mixed_segments):
+    q = {"queryType": "groupBy", "dataSource": "mix", "intervals": [str(IV)],
+         "granularity": "day",
+         "virtualColumns": [
+             {"type": "expression", "name": "v",
+              "expression": "metLong * 2 + 1", "outputType": "long"},
+             {"type": "expression", "name": "w",
+              "expression": "if(dimA == 'v00000000', 10.0, 1.0)",
+              "outputType": "double"}],
+         "dimensions": ["dimA"],
+         "filter": {"type": "bound", "dimension": "metLong", "lower": 10,
+                    "upper": 900, "ordering": "numeric"},
+         "aggregations": [{"type": "longSum", "name": "vs", "fieldName": "v"},
+                          {"type": "doubleSum", "name": "ws", "fieldName": "w"},
+                          {"type": "longFirst", "name": "lf",
+                           "fieldName": "metLong"}]}
+    plain, batched, n_batches = run_both(mixed_segments, q)
+    assert n_batches >= 1
+    assert plain == batched
+
+
+def _long_segment(name_part, lo, hi, n=1500, partition=0):
+    """Segment whose long column spans [lo, hi) — values past 2**31 stage
+    int64, small ones narrow to int32 (staged_dtype)."""
+    rng = np.random.default_rng(100 + partition)
+    b = SegmentBuilder("longs", IV, version="v1", partition=partition)
+    t = np.sort(rng.integers(IV.start, IV.end, n))
+    b.add_columns(
+        t,
+        {"dimA": [f"a{int(x)}" for x in rng.integers(0, 5, n)]},
+        {"big": rng.integers(lo, hi, n, dtype=np.int64)},
+        metric_types={"big": ValueType.LONG})
+    return b.build()
+
+
+def test_int64_staged_long_parity():
+    """Mixed staged dtypes: two int32-staged + two int64-staged segments
+    form two shape buckets, both batch, and 64-bit sums stay exact."""
+    segs = [_long_segment("small", 0, 1000, partition=i) for i in (0, 1)] \
+        + [_long_segment("big", 2**40, 2**40 + 10**6, partition=i)
+           for i in (2, 3)]
+    assert segs[0].staged_dtype("big") == np.int32
+    assert segs[2].staged_dtype("big") == np.int64
+    q = {"queryType": "groupBy", "dataSource": "longs",
+         "intervals": [str(IV)], "granularity": "all",
+         "dimensions": ["dimA"],
+         "aggregations": [{"type": "longSum", "name": "s",
+                           "fieldName": "big"},
+                          {"type": "longMax", "name": "m",
+                           "fieldName": "big"}]}
+    plain, batched, n_batches = run_both(segs, q)
+    assert n_batches == 2          # one dispatch per staged-dtype bucket
+    assert plain == batched
+    total = sum(r["event"]["s"] for r in batched)
+    expect = sum(int(s.metrics["big"].values.sum()) for s in segs)
+    assert total == expect         # exactness across the int64 bucket
+
+
+def test_straggler_falls_back_and_merges(mixed_segments):
+    """A schema-divergent segment (extra column set) runs per-segment while
+    the rest batch; the merged result equals the all-per-segment run."""
+    rng = np.random.default_rng(9)
+    b = SegmentBuilder("mix", IV, version="odd", partition=99)
+    n = 500
+    t = np.sort(rng.integers(IV.start, IV.end, n))
+    b.add_columns(t, {"dimA": [f"dimA_{int(x)}" for x in rng.integers(0, 3, n)]},
+                  {"metLong": rng.integers(0, 1000, n, dtype=np.int64)},
+                  metric_types={"metLong": ValueType.LONG})
+    odd = b.build()
+    segs = list(mixed_segments) + [odd]
+    before = batching.stats().snapshot()
+    q = {"queryType": "groupBy", "dataSource": "mix", "intervals": [str(IV)],
+         "granularity": "all", "dimensions": ["dimA"],
+         "aggregations": [{"type": "longSum", "name": "ls",
+                           "fieldName": "metLong"}]}
+    plain, batched, n_batches = run_both(segs, q)
+    after = batching.stats().snapshot()
+    assert n_batches >= 1
+    assert after["fallbackSegments"] > before["fallbackSegments"]
+    assert plain == batched
+
+
+def test_context_disables_batching(mixed_segments):
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "context": {"batchSegments": False},
+         "aggregations": [{"type": "count", "name": "n"}]}
+    before = batching.stats().snapshot()
+    QueryExecutor(mixed_segments).run_json(q)
+    after = batching.stats().snapshot()
+    assert after["batches"] == before["batches"]
+
+
+def test_repeated_batched_query_builds_once(mixed_segments, monkeypatch):
+    """The batched program cache follows the _JIT_CACHE discipline: one
+    build per (structure, K, R), repeats served from cache."""
+    monkeypatch.setattr(batching, "_JIT_CACHE", collections.OrderedDict())
+    calls = []
+    real = batching._build_batched_fn
+
+    def counted(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(batching, "_build_batched_fn", counted)
+    ex = QueryExecutor(mixed_segments)
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "aggregations": [{"type": "count", "name": "n"}]}
+    first = ex.run_json(q)
+    built = len(calls)
+    assert built >= 1
+    for _ in range(3):
+        assert ex.run_json(q) == first
+    assert len(calls) == built, "repeat queries rebuilt the batched program"
+
+
+def test_row_rung_ladder():
+    assert batching.row_rung(0) == 1024
+    assert batching.row_rung(1) == 1024
+    assert batching.row_rung(1024) == 1024
+    assert batching.row_rung(1025) == 2048
+    assert batching.row_rung(3000) == 4096
+    assert batching.row_rung(9000) == 16384
+    for n in (1, 999, 4097, 100_000):
+        assert batching.row_rung(n) >= n
+
+
+def test_pow2_chunks():
+    mk = lambda n: list(range(n))
+    chunks, rem = batching._pow2_chunks(mk(13))
+    assert [len(c) for c in chunks] == [8, 4] and len(rem) == 1
+    chunks, rem = batching._pow2_chunks(mk(6))
+    assert [len(c) for c in chunks] == [4, 2] and rem == []
+    chunks, rem = batching._pow2_chunks(mk(1))
+    assert chunks == [] and len(rem) == 1
+    chunks, rem = batching._pow2_chunks(mk(130))
+    assert [len(c) for c in chunks] == [64, 64, 2] and rem == []
+
+
+def test_fill_ratio_recorded(mixed_segments):
+    batching.stats().drain_events()
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "aggregations": [{"type": "count", "name": "n"}]}
+    QueryExecutor(mixed_segments).run_json(q)
+    events, dropped = batching.stats().drain_events()
+    assert events, "batched dispatches must record (segments, fillRatio)"
+    assert dropped == 0
+    for n_segments, fill in events:
+        assert n_segments >= 2
+        assert 0.0 < fill <= 1.0
+
+
+def test_event_overflow_is_counted():
+    stats = batching.BatchStats()
+    for _ in range(stats.EVENT_CAP + 5):
+        stats.record_batch(2, 100, 200)
+    events, dropped = stats.drain_events()
+    assert len(events) == stats.EVENT_CAP
+    assert dropped == 5
+    _, dropped2 = stats.drain_events()
+    assert dropped2 == 0
+
+
+def test_large_group_space_falls_back():
+    """Group spaces past BLOCKED_GROUP_LIMIT keep the per-segment path:
+    strategy selection there consults per-segment row clustering, which
+    could reorder float accumulation between chunk-mates and break the
+    bit-parity contract (they are also scatter-compute-bound, where
+    dispatch amortization is noise)."""
+    gen = DataGenerator(
+        (ColumnSpec("hi", "string", cardinality=3000),
+         ColumnSpec("metLong", "long", low=0, high=100)), seed=13)
+    segs = gen.segments(4, 2000, IV, datasource="big")
+    q = {"queryType": "groupBy", "dataSource": "big", "intervals": [str(IV)],
+         "granularity": "all", "dimensions": ["hi"],
+         "aggregations": [{"type": "longSum", "name": "s",
+                           "fieldName": "metLong"}]}
+    plain, batched, n_batches = run_both(segs, q)
+    assert n_batches == 0
+    assert plain == batched

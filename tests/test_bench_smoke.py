@@ -1,0 +1,71 @@
+"""Bench smoke gate: `python bench.py` must exit 0 on CPU and print ONE
+valid JSON line with the headline + batch-comparison fields.
+
+The benchmark zeroing a whole trajectory because of an environment wedge
+(every BENCH_r0*.json rc=1, "backend init hung") is exactly the silent
+breakage this tier-1 test exists to catch: tiny row counts keep it fast,
+the CPU pin keeps it hermetic, and the assertion is on CONTRACT (rc=0,
+parseable one-line JSON, fields present) — not on throughput, which this
+shared CI hardware cannot promise."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+BENCH_ENV = {
+    "DRUID_TPU_BENCH_PLATFORM": "cpu",
+    "DRUID_TPU_BENCH_ROWS": "40000",
+    "DRUID_TPU_BENCH_SEGMENTS": "2",
+    "DRUID_TPU_BENCH_ITERS": "1",
+    "DRUID_TPU_BENCH_BATCH_SEGMENTS": "4",
+    "DRUID_TPU_BENCH_BATCH_ROWS": "1024",
+    "DRUID_TPU_BENCH_INIT_TIMEOUT": "120",
+}
+
+
+def _run_bench(extra_env=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)       # the bench must pin its own
+    # conftest forces an 8-virtual-device CPU fleet for the mesh tests;
+    # inheriting it would make the bench subprocess run every program on a
+    # 1/8-size device and blow the smoke budget
+    env.pop("XLA_FLAGS", None)
+    env.update(BENCH_ENV)
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py")],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=420)
+
+
+def test_bench_exits_zero_with_one_json_line():
+    proc = _run_bench()
+    assert proc.returncode == 0, (
+        f"bench.py rc={proc.returncode}\nstdout:{proc.stdout}\n"
+        f"stderr:{proc.stderr[-2000:]}")
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE stdout JSON line, got {lines!r}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "groupby+topn_scan_rate"
+    assert out["value"] > 0 and "error" not in out
+    # the batch-comparison fields the perf gate reads
+    assert out["per_segment_rate"] > 0
+    assert out["batched_rate"] > 0
+    assert out["batch_speedup"] > 0
+    assert out["batch_segments"] == 4
+
+
+def test_bench_falls_back_to_cpu_on_bad_backend():
+    """An unavailable accelerator backend must not zero the run: the bench
+    re-execs once on the CPU backend and still produces numbers."""
+    proc = _run_bench({"DRUID_TPU_BENCH_PLATFORM": "nosuchplatform"})
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstderr:{proc.stderr[-2000:]}")
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    out = json.loads(lines[-1])
+    assert out["value"] > 0 and "error" not in out
+    assert "retrying once on the cpu backend" in proc.stderr

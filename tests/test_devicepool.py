@@ -1,0 +1,136 @@
+"""Byte-budgeted device segment pool (data/devicepool.py): budget
+enforcement, LRU eviction by actual bytes, re-staging after eviction,
+owner purge on segment GC, and the DevicePoolMonitor metrics."""
+import gc
+
+import numpy as np
+import pytest
+
+from druid_tpu.data import devicepool
+from druid_tpu.data.devicepool import DeviceSegmentPool, entry_bytes
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-04-01", "2026-04-02")
+SCHEMA = (ColumnSpec("dimA", "string", cardinality=5),
+          ColumnSpec("metLong", "long", low=0, high=50))
+
+
+@pytest.fixture
+def fresh_pool(monkeypatch):
+    """Isolated pool; segments built after this bind to it."""
+    pool = DeviceSegmentPool(budget_bytes=1 << 40)
+    monkeypatch.setattr(devicepool, "_POOL", pool)
+    return pool
+
+
+def _segments(n, rows=2000, seed=5):
+    return DataGenerator(SCHEMA, seed=seed).segments(
+        n, rows, IV, datasource="pool")
+
+
+COUNT_Q = {"queryType": "timeseries", "dataSource": "pool",
+           "intervals": [str(IV)], "granularity": "all",
+           "aggregations": [{"type": "count", "name": "n"},
+                            {"type": "longSum", "name": "s",
+                             "fieldName": "metLong"}]}
+
+
+def test_entry_bytes_accounts_arrays():
+    a = np.zeros(100, dtype=np.int32)
+    assert entry_bytes(a) == 400
+    assert entry_bytes({"x": a, "y": a}) == 800
+    assert entry_bytes((a, [a, a])) == 1200
+    assert entry_bytes(None) == 0
+
+    class FakeBlock:
+        arrays = {"c": np.zeros(10, np.int64)}
+    assert entry_bytes(FakeBlock()) == 80
+
+
+def test_staging_is_pooled_and_counted(fresh_pool):
+    segs = _segments(2)
+    ex = QueryExecutor(segs)
+    r1 = ex.run_json(COUNT_Q)
+    s1 = fresh_pool.snapshot()
+    assert s1.misses > 0 and s1.resident_bytes > 0
+    r2 = ex.run_json(COUNT_Q)
+    s2 = fresh_pool.snapshot()
+    assert r1 == r2
+    assert s2.hits > s1.hits, "repeat query must hit the pooled blocks"
+    assert s2.misses == s1.misses, "repeat query must not re-stage"
+
+
+def test_byte_budget_evicts_lru_and_restages(fresh_pool):
+    segs = _segments(6, rows=4000)
+    ex = QueryExecutor(segs)
+    ex.run_json(COUNT_Q)
+    baseline = fresh_pool.snapshot()
+    per_entry = baseline.resident_bytes // max(baseline.entries, 1)
+    # room for ~2 entries: the other stagings must evict, budget respected
+    budget = int(per_entry * 2.5)
+    fresh_pool.configure(budget)
+    s = fresh_pool.snapshot()
+    assert s.resident_bytes <= budget
+    assert s.evicted_bytes > 0 and s.evictions > 0
+    # evicted blocks re-stage transparently and results stay correct
+    r = ex.run_json(COUNT_Q)
+    assert r[0]["result"]["n"] == sum(seg.n_rows for seg in segs)
+    s2 = fresh_pool.snapshot()
+    assert s2.misses > s.misses, "evicted entries must re-stage as misses"
+    assert s2.resident_bytes <= budget
+
+
+def test_single_oversized_entry_survives(fresh_pool):
+    """The entry just staged for the running query is never evicted from
+    under it, even when it alone exceeds the budget."""
+    fresh_pool.configure(1)            # absurd: nothing fits
+    segs = _segments(2)
+    r = QueryExecutor(segs).run_json(COUNT_Q)
+    assert r[0]["result"]["n"] == sum(s.n_rows for s in segs)
+    s = fresh_pool.snapshot()
+    assert s.entries >= 1              # the last-used entry survives
+
+
+def test_zero_budget_means_unbounded(fresh_pool):
+    fresh_pool.configure(0)
+    segs = _segments(4)
+    QueryExecutor(segs).run_json(COUNT_Q)
+    s = fresh_pool.snapshot()
+    assert s.evictions == 0 and s.entries > 0
+
+
+def test_segment_gc_purges_entries(fresh_pool):
+    segs = _segments(2)
+    QueryExecutor(segs).run_json(COUNT_Q)
+    assert fresh_pool.snapshot().resident_bytes > 0
+    del segs
+    gc.collect()
+    s = fresh_pool.snapshot()
+    assert s.resident_bytes == 0, "collected segments must release HBM"
+    assert s.entries == 0
+
+
+def test_pool_monitor_emits_metrics(fresh_pool):
+    segs = _segments(2)
+    ex = QueryExecutor(segs)
+    sink = InMemoryEmitter()
+    emitter = ServiceEmitter("historical", "host1", sink)
+    mon = devicepool.DevicePoolMonitor(fresh_pool)
+    ex.run_json(COUNT_Q)               # misses (cold)
+    ex.run_json(COUNT_Q)               # hits (warm)
+    mon.do_monitor(emitter)
+    names = {e.metric for e in sink.metrics()}
+    assert {"segment/devicePool/hitRate", "segment/devicePool/hits",
+            "segment/devicePool/misses", "segment/devicePool/evictedBytes",
+            "segment/devicePool/residentBytes",
+            "segment/devicePool/entries"} <= names
+    rate = sink.metrics("segment/devicePool/hitRate")[-1].value
+    assert 0.0 < rate <= 1.0
+    # second tick with no traffic: deltas go quiet, no rate emitted
+    sink.events.clear()
+    mon.do_monitor(emitter)
+    assert not sink.metrics("segment/devicePool/hitRate")
+    assert sink.metrics("segment/devicePool/hits")[-1].value == 0

@@ -132,6 +132,18 @@ VIOLATIONS = {
         "from jax import lax\n"
         "def f(a, b):\n"
         "    return lax.dot_general(a, b, (((1,), (0,)), ((), ())))\n"),
+    "shard-spec": (
+        "druid_tpu/parallel/distributed.py",
+        "from jax import shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "CACHE = {}\n"
+        "def body(a, b):\n"
+        "    return (a,)\n"
+        "def build(mesh):\n"
+        "    axis = mesh.axis_names[0]\n"
+        "    CACHE['f'] = shard_map(body, mesh=mesh, in_specs=(P(axis),),\n"
+        "                           out_specs=(P(),))\n"
+        "    return CACHE['f']\n"),
 }
 
 

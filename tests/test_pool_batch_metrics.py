@@ -1,0 +1,126 @@
+"""Device-pool + batched-execution metrics wired through the data node
+server (cluster/dataserver.py): the server owns the MonitorScheduler that
+emits segment/devicePool/* and query/batch/* counters."""
+import pytest
+
+from druid_tpu.cluster.dataserver import DataNodeServer
+from druid_tpu.cluster.view import DataNode
+from druid_tpu.data import devicepool
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.engine import batching
+from druid_tpu.query.model import query_from_json
+from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-05-01", "2026-05-02")
+SCHEMA = (ColumnSpec("dimA", "string", cardinality=6),
+          ColumnSpec("metLong", "long", low=0, high=100))
+
+QUERY = {"queryType": "groupBy", "dataSource": "metrics",
+         "intervals": [str(IV)], "granularity": "all",
+         "dimensions": ["dimA"],
+         "aggregations": [{"type": "count", "name": "n"},
+                          {"type": "longSum", "name": "s",
+                           "fieldName": "metLong"}]}
+
+
+@pytest.fixture
+def served(monkeypatch):
+    """Fresh pool + batching stats, a loaded DataNode, and its server with
+    the metrics monitors wired."""
+    pool = devicepool.DeviceSegmentPool(budget_bytes=1 << 40)
+    monkeypatch.setattr(devicepool, "_POOL", pool)
+    monkeypatch.setattr(batching, "_ENABLED", True)
+    stats = batching.BatchStats()
+    monkeypatch.setattr(batching, "_STATS", stats)
+    segments = DataGenerator(SCHEMA, seed=11).segments(
+        4, 1500, IV, datasource="metrics")
+    node = DataNode("dn1")
+    for s in segments:
+        node.load_segment(s)
+    sink = InMemoryEmitter()
+    emitter = ServiceEmitter("historical", "dn1", sink)
+    # monitors must read the patched pool/stats singletons
+    server = DataNodeServer(node, emitter=emitter,
+                            device_pool_bytes=1 << 40,
+                            monitor_period_seconds=3600.0)
+    monkeypatch.setattr(
+        server._monitors, "monitors",
+        [devicepool.DevicePoolMonitor(pool),
+         batching.BatchMetricsMonitor(stats)])
+    try:
+        yield node, server, sink, segments
+    finally:
+        server._httpd.server_close()
+
+
+def test_server_tick_emits_pool_and_batch_metrics(served):
+    node, server, sink, segments = served
+    sids = [str(s.id) for s in segments]
+    query = query_from_json(QUERY)
+    node.run_partials(query, sids)           # cold: stage + batch
+    node.run_partials(query, sids)           # warm: pool hits
+    server.metrics_tick()
+    names = {e.metric for e in sink.metrics()}
+    assert "segment/devicePool/hitRate" in names
+    assert "segment/devicePool/evictedBytes" in names
+    assert "query/batch/segments" in names
+    assert "query/batch/fillRatio" in names
+    # every dispatch stacked all 4 same-shape segments
+    segs_per_batch = [e.value for e in sink.metrics("query/batch/segments")]
+    assert segs_per_batch and all(v == 4 for v in segs_per_batch)
+    for e in sink.metrics("query/batch/fillRatio"):
+        assert 0.0 < e.value <= 1.0
+    # service dims stamped by the ServiceEmitter wrapper
+    e = sink.metrics("query/batch/segments")[0]
+    assert e.dims["service"] == "historical"
+
+
+def test_batch_events_drain_once(served):
+    node, server, sink, segments = served
+    sids = [str(s.id) for s in segments]
+    node.run_partials(query_from_json(QUERY), sids)
+    server.metrics_tick()
+    n = len(sink.metrics("query/batch/segments"))
+    assert n >= 1
+    server.metrics_tick()                    # no new dispatches: no new events
+    assert len(sink.metrics("query/batch/segments")) == n
+
+
+def test_check_probe_still_enforced_around_fused_run(served):
+    """Cancellation collapses to dispatch boundaries, not silently dropped:
+    a pre-cancelled probe aborts before any result is produced."""
+    node, server, sink, segments = served
+
+    class Cancelled(Exception):
+        pass
+
+    def probe():
+        raise Cancelled()
+
+    with pytest.raises(Cancelled):
+        node.run_partials(query_from_json(QUERY),
+                          [str(s.id) for s in segments], check=probe)
+
+
+def test_check_fires_between_per_segment_dispatches(served, monkeypatch):
+    """With batching off, the probe still fires between per-segment device
+    dispatches (threaded through make_aggregate_partials), so a cancel
+    aborts at the next dispatch boundary instead of after the whole set."""
+    node, server, sink, segments = served
+    monkeypatch.setattr(batching, "_ENABLED", False)
+
+    class Cancelled(Exception):
+        pass
+
+    calls = []
+
+    def probe():
+        calls.append(1)
+        if len(calls) >= 2:
+            raise Cancelled()
+
+    with pytest.raises(Cancelled):
+        node.run_partials(query_from_json(QUERY),
+                          [str(s.id) for s in segments], check=probe)
+    assert len(calls) == 2

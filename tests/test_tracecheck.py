@@ -656,3 +656,128 @@ def test_real_tree_scans_clean_with_tracecheck():
                     "pallas-tile-shape,pallas-accum-dtype,vmem-budget,"
                     "x64-dtype,agg-contract,preferred-element-type")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---- shard-spec -----------------------------------------------------------
+
+SHARD = "druid_tpu/parallel/distributed.py"
+
+_SHARD_OK = """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(stacked, time0s, aux):
+        counts = stacked
+        merged = aux
+        return counts, merged
+
+    def run(mesh, xs, t0s, aux):
+        axis = mesh.axis_names[0]
+        f = shard_map(body, mesh=mesh, in_specs=(P(axis, None), P(axis), P()),
+                      out_specs=(P(), P()))
+        return f(xs, t0s, aux)
+"""
+
+
+def test_shard_spec_ok_passes():
+    assert "shard-spec" not in rules_hit(_SHARD_OK, SHARD)
+
+
+def test_shard_spec_in_arity_mismatch_flagged():
+    src = _SHARD_OK.replace("in_specs=(P(axis, None), P(axis), P())",
+                            "in_specs=(P(axis, None), P(axis))")
+    assert "shard-spec" in rules_hit(src, SHARD)
+
+
+def test_shard_spec_out_arity_mismatch_flagged():
+    src = _SHARD_OK.replace("out_specs=(P(), P())",
+                            "out_specs=(P(), P(), P())")
+    assert "shard-spec" in rules_hit(src, SHARD)
+
+
+def test_shard_spec_unknown_axis_flagged():
+    src = _SHARD_OK.replace("in_specs=(P(axis, None), P(axis), P())",
+                            "in_specs=(P('seg', None), P(axis), P())")
+    assert "shard-spec" in rules_hit(src, SHARD)
+
+
+def test_shard_spec_mesh_literal_axis_ok():
+    src = """
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    def body(xs):
+        return (xs,)
+
+    def run(devices, xs):
+        mesh = Mesh(devices, ("seg",))
+        f = shard_map(body, mesh=mesh, in_specs=(P("seg"),),
+                      out_specs=(P("seg"),))
+        return f(xs)
+    """
+    assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+def test_shard_spec_opaque_axis_module_skips_axis_check():
+    """No mesh.axis_names binding and no Mesh construction in the module:
+    axis provenance cannot be judged, so only arity is checked."""
+    src = """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(xs):
+        return (xs,)
+
+    def run(mesh, axis, xs):
+        f = shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                      out_specs=(P(axis),))
+        return f(xs)
+    """
+    assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+def test_shard_spec_vararg_body_skips_in_arity():
+    src = """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(*xs):
+        return (xs,)
+
+    def run(mesh, xs):
+        axis = mesh.axis_names[0]
+        f = shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis),))
+        return f(xs, xs)
+    """
+    assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+def test_shard_spec_only_in_shard_modules():
+    src = _SHARD_OK.replace("in_specs=(P(axis, None), P(axis), P())",
+                            "in_specs=(P(axis),)")
+    assert "shard-spec" not in rules_hit(src, ENGINE)
+
+
+def test_shard_spec_suppression():
+    src = _SHARD_OK.replace(
+        "in_specs=(P(axis, None), P(axis), P()),",
+        "in_specs=(P(axis, None), P(axis)),  # druidlint: disable=shard-spec")
+    assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+def test_shard_spec_defaulted_params_tolerated():
+    src = """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(xs, t0s, scale=2):
+        return (xs,)
+
+    def run(mesh, xs, t0s):
+        axis = mesh.axis_names[0]
+        f = shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                      out_specs=(P(axis),))
+        return f(xs, t0s)
+    """
+    assert "shard-spec" not in rules_hit(src, SHARD)

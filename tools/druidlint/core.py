@@ -105,6 +105,9 @@ _DEFAULT_CONFIG = {
     "pallas-modules": ["druid_tpu/engine/pallas_agg.py"],
     # tracecheck: modules defining AggKernel subclasses (agg-contract)
     "kernel-modules": ["druid_tpu/engine/kernels.py", "druid_tpu/ext/*"],
+    # tracecheck: modules whose shard_map partition specs are checked
+    # against mesh construction + body arity (shard-spec)
+    "shard-modules": ["druid_tpu/parallel/distributed.py"],
     # tracecheck: VMEM tile budget in bytes; 0 = contracts.VMEM_BUDGET_BYTES
     "vmem-cap-bytes": 0,
     # unused-suppression audit (CLI --report-unused-suppressions)
@@ -132,6 +135,8 @@ class LintConfig:
         default_factory=lambda: list(_DEFAULT_CONFIG["pallas-modules"]))
     kernel_modules: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["kernel-modules"]))
+    shard_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["shard-modules"]))
     vmem_cap_bytes: int = 0
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here

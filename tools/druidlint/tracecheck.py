@@ -853,3 +853,155 @@ def check_preferred_element_type(ctx: ModuleContext) -> Iterable[Finding]:
                       f"without preferred_element_type — the MXU "
                       f"accumulator dtype must be pinned (int32 for int8 "
                       f"rows, float32 for bf16 rows)")
+
+
+# ---- shard-spec -----------------------------------------------------------
+
+def _partition_spec_names(ctx: ModuleContext) -> Set[str]:
+    """Local names PartitionSpec is importable under (incl. aliases)."""
+    names = {"PartitionSpec"}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _mesh_axis_sources(ctx: ModuleContext) -> Tuple[Set[str], Set[str]]:
+    """(variable names bound from <mesh>.axis_names[...], literal axis
+    strings declared by Mesh(...) constructions) — the two ways a module
+    can legitimately name a mesh axis."""
+    axis_vars: Set[str] = set()
+    axis_literals: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Subscript) \
+                and isinstance(node.value.value, ast.Attribute) \
+                and node.value.value.attr == "axis_names":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    axis_vars.add(tgt.id)
+        if isinstance(node, ast.Call) and _terminal(node.func) == "Mesh":
+            cands = list(node.args[1:2]) + [
+                kw.value for kw in node.keywords if kw.arg == "axis_names"]
+            for cand in cands:
+                if isinstance(cand, (ast.Tuple, ast.List)):
+                    for e in cand.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                            axis_literals.add(e.value)
+    return axis_vars, axis_literals
+
+
+def _own_returns(fn: ast.AST) -> List[ast.Return]:
+    """Return statements belonging to `fn` itself (nested defs/lambdas have
+    their own returns and must not count)."""
+    out: List[ast.Return] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_DEFS + (ast.Lambda,)):
+                continue
+            if isinstance(child, ast.Return):
+                out.append(child)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+@rule("shard-spec", "error",
+      "shard_map partition specs disagree with the mesh or body")
+def check_shard_spec(ctx: ModuleContext) -> Iterable[Finding]:
+    """In sharding modules (config `shard-modules`), every `shard_map`
+    call's partition specs must agree with its body and its mesh:
+    `in_specs` tuples need one entry per body positional parameter,
+    `out_specs` tuples one entry per element of the body's returned tuple,
+    and every PartitionSpec axis argument must be derived from the mesh —
+    a name bound from mesh.axis_names[...] or a literal axis a Mesh(...)
+    construction in the module declares. A resharding edit that breaks any
+    of these otherwise surfaces in the multichip suite (or as a silent
+    replication of what should be sharded), not at lint time."""
+    if not ctx.path_matches(ctx.config.shard_modules):
+        return
+    p_names = _partition_spec_names(ctx)
+    axis_vars, axis_literals = _mesh_axis_sources(ctx)
+
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_DEFS):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def spec_entries(node) -> Optional[List[ast.AST]]:
+        return list(node.elts) if isinstance(node, (ast.Tuple, ast.List)) \
+            else None
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal(node.func) == "shard_map"):
+            continue
+        body = None
+        if node.args and isinstance(node.args[0], ast.Name):
+            cands = defs_by_name.get(node.args[0].id, [])
+            body = cands[0] if len(cands) == 1 else None
+        in_specs = _call_kw(node, "in_specs")
+        out_specs = _call_kw(node, "out_specs")
+
+        # arity: in_specs entries ↔ body positional parameters (defaulted
+        # params are optional, so any count in [required, total] is valid)
+        if body is not None and in_specs is not None:
+            entries = spec_entries(in_specs)
+            n_pos = len(getattr(body.args, "posonlyargs", [])) \
+                + len(body.args.args)
+            n_required = n_pos - len(body.args.defaults)
+            if entries is not None and body.args.vararg is None \
+                    and not (n_required <= len(entries) <= n_pos):
+                yield ctx.finding(
+                    in_specs, f"in_specs has {len(entries)} spec(s) but "
+                              f"body {body.name}() takes {n_required}"
+                              f"{f'-{n_pos}' if n_pos != n_required else ''} "
+                              f"positional parameter(s)")
+
+        # arity: out_specs entries ↔ body return tuple
+        if body is not None and out_specs is not None:
+            entries = spec_entries(out_specs)
+            if entries is not None:
+                ret_lens = set()
+                resolvable = True
+                for ret in _own_returns(body):
+                    if isinstance(ret.value, ast.Tuple):
+                        ret_lens.add(len(ret.value.elts))
+                    else:
+                        resolvable = False
+                if resolvable and len(ret_lens) == 1 \
+                        and ret_lens != {len(entries)}:
+                    yield ctx.finding(
+                        out_specs, f"out_specs has {len(entries)} spec(s) "
+                                   f"but body {body.name}() returns a "
+                                   f"{ret_lens.pop()}-tuple")
+
+        # axis provenance: every PartitionSpec argument must trace to the
+        # mesh. Skip when the module declares no axis source at all (a
+        # fixture or a mesh passed opaquely) — no false positives.
+        if not axis_vars and not axis_literals:
+            continue
+        for spec_src in (in_specs, out_specs):
+            if spec_src is None:
+                continue
+            for sub in ast.walk(spec_src):
+                if not (isinstance(sub, ast.Call)
+                        and _terminal(sub.func) in p_names):
+                    continue
+                for arg in sub.args:
+                    if isinstance(arg, ast.Constant) and arg.value is None:
+                        continue
+                    if isinstance(arg, ast.Name) and arg.id in axis_vars:
+                        continue
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str) \
+                            and arg.value in axis_literals:
+                        continue
+                    yield ctx.finding(
+                        arg, f"PartitionSpec axis {ast.dump(arg) if not isinstance(arg, ast.Constant) else arg.value!r} "
+                             f"is not derived from the mesh (bind it from "
+                             f"mesh.axis_names[...] or declare it in the "
+                             f"Mesh construction)")
