@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import logging
 import random
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import replace
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -115,7 +114,6 @@ class Broker:
         self.max_threads = max_threads
         self.query_manager = query_manager or QueryManager()
         self.selector_strategy = selector_strategy
-        self._lock = threading.Lock()
 
     # ---- QueryExecutor-compatible surface ------------------------------
     @property

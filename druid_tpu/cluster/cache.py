@@ -101,6 +101,9 @@ class HybridCache(Cache):
         self.l2 = l2
         self.populate_l1 = populate_l1
         self.stats = CacheStats()
+        # counter increments are read-modify-write: broker pool threads
+        # hitting both tiers concurrently would lose updates unguarded
+        self._stats_lock = threading.Lock()
 
     def get(self, namespace, key):
         v = self.l1.get(namespace, key)
@@ -108,16 +111,18 @@ class HybridCache(Cache):
             v = self.l2.get(namespace, key)
             if v is not None and self.populate_l1:
                 self.l1.put(namespace, key, v)
-        if v is None:
-            self.stats.misses += 1
-        else:
-            self.stats.hits += 1
+        with self._stats_lock:
+            if v is None:
+                self.stats.misses += 1
+            else:
+                self.stats.hits += 1
         return v
 
     def put(self, namespace, key, value):
         self.l1.put(namespace, key, value)
         self.l2.put(namespace, key, value)
-        self.stats.puts += 1
+        with self._stats_lock:
+            self.stats.puts += 1
 
     def invalidate_namespace(self, namespace):
         n = self.l1.invalidate_namespace(namespace)
@@ -204,6 +209,9 @@ class RemoteCacheClient(Cache):
         self.stats = CacheStats()
         self._sock = None
         self._lock = threading.Lock()
+        # separate from the socket lock: a counter bump must not queue
+        # behind a remote round-trip
+        self._stats_lock = threading.Lock()
         self._warned_drop = False
 
     def _call(self, req):
@@ -229,10 +237,11 @@ class RemoteCacheClient(Cache):
     def get(self, namespace, key):
         out = self._call({"op": "get", "ns": namespace, "key": key})
         v = out.get("value") if out else None
-        if v is None:
-            self.stats.misses += 1
-        else:
-            self.stats.hits += 1
+        with self._stats_lock:
+            if v is None:
+                self.stats.misses += 1
+            else:
+                self.stats.hits += 1
         return v
 
     def put(self, namespace, key, value):
@@ -246,9 +255,11 @@ class RemoteCacheClient(Cache):
             # (and logged once) so a pure-remote deployment whose values
             # never serialize shows WHY its hit rate is zero, instead of
             # silently recomputing everything forever.
-            self.stats.dropped_puts += 1
-            if not self._warned_drop:
+            with self._stats_lock:
+                self.stats.dropped_puts += 1
+                warn_now = not self._warned_drop
                 self._warned_drop = True
+            if warn_now:
                 log.warning(
                     "remote cache dropping non-serializable puts (first: "
                     "namespace %r, %s) — these entries only cache in a "
@@ -256,7 +267,8 @@ class RemoteCacheClient(Cache):
                     type(value).__name__)
             return
         self._call(payload)
-        self.stats.puts += 1
+        with self._stats_lock:
+            self.stats.puts += 1
 
     def invalidate_namespace(self, namespace):
         out = self._call({"op": "invalidate", "ns": namespace})

@@ -217,17 +217,45 @@ class DataNode:
                                    cached=True)
             else:
                 to_compute.append(s)
-        for s in to_compute:
+        if to_compute and (self.mesh is not None
+                           or (self.emitter is not None
+                               and self.per_segment_metrics)):
+            # mesh: the sharded program may FUSE the miss set into one
+            # merged partial that cannot split back into per-segment cache
+            # entries — keep the per-miss loop (as the uncached path does).
+            # per_segment_metrics: observability trade, per-segment
+            # timings require per-segment dispatches
+            for s in to_compute:
+                if check is not None:
+                    check()
+                t0, c0 = time.monotonic(), time.thread_time()
+                ap = make_aggregate_partials(query, [s], clamp=False)
+                self._emit_segment(query, s.id,
+                                   (time.monotonic() - t0) * 1e3,
+                                   (time.thread_time() - c0) * 1e3,
+                                   cached=False)
+                if self.cache_config.populate_segment_cache:
+                    self.cache.put("segment", f"{s.id}|{qkey}", ap)
+                parts.append(ap)
+        elif to_compute:
+            # the whole miss set in ONE wave: shape-compatible misses fuse
+            # into batched dispatches (engine/batching.py) instead of one
+            # device program per miss; the per-segment partials come back
+            # split, so cache entries stay identical to the per-miss path
+            from druid_tpu.engine.engines import make_partials_by_segment
             if check is not None:
                 check()
             t0, c0 = time.monotonic(), time.thread_time()
-            ap = make_aggregate_partials(query, [s], clamp=False)
-            self._emit_segment(query, s.id, (time.monotonic() - t0) * 1e3,
+            per_seg = make_partials_by_segment(query, to_compute,
+                                               clamp=False, check=check)
+            self._emit_segment(query, f"{len(to_compute)}-segment-misses",
+                               (time.monotonic() - t0) * 1e3,
                                (time.thread_time() - c0) * 1e3,
                                cached=False)
-            if self.cache_config.populate_segment_cache:
-                self.cache.put("segment", f"{s.id}|{qkey}", ap)
-            parts.append(ap)
+            for s, ap in zip(to_compute, per_seg):
+                if self.cache_config.populate_segment_cache:
+                    self.cache.put("segment", f"{s.id}|{qkey}", ap)
+                parts.append(ap)
         return AggregatePartials.concat(parts), served
 
     def run_rows(self, query: Query, segment_ids: Sequence[str]

@@ -27,6 +27,7 @@ from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from druid_tpu.cluster.metadata import MetadataStore, StaleTermError  # noqa: F401  (re-export)
+from druid_tpu.utils.emitter import ServiceEmitter
 
 log = logging.getLogger(__name__)
 
@@ -111,7 +112,7 @@ class LeaderParticipant:
     def __init__(self, store: LeaseStore, service: str, node_id: str,
                  lease_ms: int = 3_000, meta: Optional[dict] = None,
                  clock: Optional[Callable[[], int]] = None,
-                 emitter=None):
+                 emitter: Optional[ServiceEmitter] = None):
         self.store = store
         self.service = service
         self.node_id = node_id
@@ -170,6 +171,13 @@ class LeaderParticipant:
             if self._last_renew_ms is None:
                 return None
             return max(0, self.clock() - self._last_renew_ms)
+
+    def transition_count(self) -> int:
+        """Locked read of the become/stop transition counter — monitor
+        ticks run on the scheduler thread while the heartbeat thread
+        writes it."""
+        with self._lock:
+            return self.transitions
 
     # ---- one heartbeat ---------------------------------------------------
     def tick(self) -> bool:
@@ -299,7 +307,8 @@ class LeaderMonitor:
 
     def do_monitor(self, emitter) -> None:
         p = self.participant
-        emitter.metric("coordination/leader/transitions", p.transitions,
+        emitter.metric("coordination/leader/transitions",
+                       p.transition_count(),
                        service=p.service, node=p.node_id,
                        leader=p.is_leader())
         age = p.lease_age_ms()

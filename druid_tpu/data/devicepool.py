@@ -85,6 +85,12 @@ class DeviceSegmentPool:
             = collections.OrderedDict()
         self._owner_keys: Dict[int, Set[Tuple]] = {}
         self._owner_seq = itertools.count(1)
+        # weakref finalizers ONLY append here (deque.append is atomic and
+        # takes no lock): a finalizer can fire at any allocation point —
+        # including while this thread already holds self._lock — so a
+        # finalizer that acquired the lock would self-deadlock. Dead owners
+        # are drained under the lock at the next pool operation.
+        self._dead_owners: "collections.deque[int]" = collections.deque()
         self._resident = 0
         self._hits = 0
         self._misses = 0
@@ -103,6 +109,7 @@ class DeviceSegmentPool:
         """Set the byte budget (None re-resolves env/contract default;
         <= 0 disables eviction) and trims immediately."""
         with self._lock:
+            self._drain_dead_locked()
             self._budget = budget_bytes
             budget = self.budget_bytes
             if budget > 0:
@@ -110,24 +117,53 @@ class DeviceSegmentPool:
 
     # ---- owner registry -------------------------------------------------
     def register_owner(self, obj) -> int:
-        """Opaque token for `obj`'s entries; a weakref finalizer purges
-        them when `obj` is collected (dropped segments release HBM)."""
-        token = next(self._owner_seq)
-        weakref.finalize(obj, self.purge_owner, token)
+        """Opaque token for `obj`'s entries; a weakref finalizer marks it
+        dead when `obj` is collected (dropped segments release HBM at the
+        next pool touch). The token's presence in the owner registry IS the
+        liveness bit get_or_build checks before caching."""
+        with self._lock:
+            self._drain_dead_locked()
+            token = next(self._owner_seq)
+            self._owner_keys.setdefault(token, set())
+        weakref.finalize(obj, self._note_dead, token)
         return token
 
-    def purge_owner(self, owner: int) -> int:
-        """Drop every entry owned by `owner`; returns bytes released.
-        Purges are bookkeeping, not cache pressure: they do not count as
-        evictions."""
+    def _note_dead(self, owner: int) -> None:
+        """Finalizer target. MUST NOT touch self._lock: finalizers run at
+        arbitrary allocation points, including under this very lock."""
+        # the lock-free write is the point: deque.append is atomic, and a
+        # finalizer taking self._lock would self-deadlock when GC fires
+        # inside a locked region
+        self._dead_owners.append(owner)  # druidlint: disable=unguarded-shared-write
+
+    def _drain_dead_locked(self) -> int:
+        """Caller holds the lock. Purge every finalizer-reported owner."""
         freed = 0
-        with self._lock:
-            for key in self._owner_keys.pop(owner, ()):
-                value = self._entries.pop(key, None)
-                if value is not None:
-                    freed += value[1]
-            self._resident -= freed
+        while True:
+            try:
+                owner = self._dead_owners.popleft()
+            except IndexError:
+                break
+            freed += self._purge_locked(owner)
         return freed
+
+    def _purge_locked(self, owner: int) -> int:
+        freed = 0
+        for key in self._owner_keys.pop(owner, ()):
+            value = self._entries.pop(key, None)
+            if value is not None:
+                freed += value[1]
+        self._resident -= freed
+        return freed
+
+    def purge_owner(self, owner: int) -> int:
+        """Drop every entry owned by `owner` NOW; returns bytes released.
+        Purges are bookkeeping, not cache pressure: they do not count as
+        evictions. Removing the owner's registry slot also marks it dead,
+        so an in-flight get_or_build cannot resurrect its entries (a late
+        insert after the owner died would pin HBM forever)."""
+        with self._lock:
+            return self._purge_locked(owner)
 
     # ---- cache surface --------------------------------------------------
     def get_or_build(self, owner: int, key: Tuple, build: Callable[[], object]):
@@ -136,6 +172,7 @@ class DeviceSegmentPool:
         corrupt the accounting (the replaced entry's bytes are subtracted)."""
         full_key = (owner,) + tuple(key)
         with self._lock:
+            self._drain_dead_locked()
             hit = self._entries.get(full_key)
             if hit is not None:
                 self._entries.move_to_end(full_key)
@@ -145,11 +182,18 @@ class DeviceSegmentPool:
         value = build()
         nbytes = entry_bytes(value)
         with self._lock:
+            self._drain_dead_locked()
+            keys = self._owner_keys.get(owner)
+            if keys is None:
+                # owner purged while build() ran (segment GC'd mid-query):
+                # hand the value back WITHOUT caching — its finalizer will
+                # never run again, so a cached entry would leak HBM
+                return value
             old = self._entries.pop(full_key, None)
             if old is not None:
                 self._resident -= old[1]
             self._entries[full_key] = (value, nbytes)
-            self._owner_keys.setdefault(owner, set()).add(full_key)
+            keys.add(full_key)
             self._resident += nbytes
             budget = self.budget_bytes
             if budget > 0:
@@ -177,12 +221,16 @@ class DeviceSegmentPool:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
-            self._owner_keys.clear()
+            # keep owner slots (liveness bits) — only their key sets drop;
+            # clearing slots would permanently refuse live segments' inserts
+            for keys in self._owner_keys.values():
+                keys.clear()
             self._resident = 0
 
     # ---- observability --------------------------------------------------
     def snapshot(self) -> PoolStats:
         with self._lock:
+            self._drain_dead_locked()
             return PoolStats(hits=self._hits, misses=self._misses,
                              evictions=self._evictions,
                              evicted_bytes=self._evicted_bytes,

@@ -110,7 +110,11 @@ class StringDimColumn:
             return self._bitmap_index
 
     def set_bitmap_index(self, index: BitmapIndex):
-        self._bitmap_index = index
+        # same lock as the lazy build: an unlocked store here could be
+        # overwritten by a concurrent bitmap_index() builder (or hand a
+        # half-published index to it)
+        with self._lock:
+            self._bitmap_index = index
 
     def capabilities(self) -> ColumnCapabilities:
         return ColumnCapabilities(ValueType.STRING, dictionary_encoded=True,

@@ -44,14 +44,16 @@ from druid_tpu.engine import grouping
 from druid_tpu.engine.contracts import (BATCH_MAX_SEGMENT_ROWS,
                                         BATCH_MAX_SEGMENTS,
                                         BATCH_MIN_SEGMENTS, BATCH_ROW_ALIGN)
-from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
-from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
-                                       assemble_stacked_aux, aux_equal,
-                                       keydims_equal, make_group_spec,
+from druid_tpu.engine.filters import ConstNode
+from druid_tpu.engine.grouping import (GroupPlan, GroupSpec, KeyDim,
+                                       SegmentPartial, assemble_stacked_aux,
+                                       aux_equal, keydims_equal,
                                        make_stacked_segment_fn,
-                                       needed_columns, plan_virtual_columns,
-                                       run_grouped_aggregate, windowed_window)
-from druid_tpu.engine.kernels import AggKernel, make_kernel
+                                       needed_columns,
+                                       plan_grouped_aggregate,
+                                       run_grouped_aggregate,
+                                       windowed_window)
+from druid_tpu.engine.kernels import AggKernel
 from druid_tpu.query.aggregators import AggregatorSpec
 from druid_tpu.utils.emitter import Monitor
 from druid_tpu.utils.granularity import Granularity
@@ -185,42 +187,72 @@ def row_rung(n_rows: int) -> int:
 
 @dataclass
 class _Plan:
-    """One segment's per-query plan, the unit of shape-bucket grouping."""
+    """One segment's per-query plan, the unit of shape-bucket grouping.
+    Wraps the shared host-side GroupPlan (grouping.plan_grouped_aggregate)
+    with the batching-only derivations (ladder rung, bucket digest); the
+    GroupPlan rides along so straggler fallback re-executes WITHOUT
+    re-planning (run_grouped_aggregate(plan=...))."""
     segment: Segment
     kds: Tuple[KeyDim, ...]
     index: int                       # position in the caller's segment list
-    spec: GroupSpec
-    filter_node: object
-    f_aux: List[np.ndarray]
-    kernels: List[AggKernel]
-    k_aux: List[np.ndarray]
-    vc_plans: Tuple
-    vc_luts: List[np.ndarray]
-    columns: Tuple[str, ...]
-    col_dtypes: Dict[str, np.dtype]
-    rung: int
-    digest: Tuple                    # hashable shape-bucket prefilter
+    gplan: GroupPlan
+    #: False = straggler (runs per-segment, but still through this gplan)
+    eligible: bool = False
+    f_aux: List[np.ndarray] = None
+    k_aux: List[np.ndarray] = None
+    columns: Tuple[str, ...] = ()
+    col_dtypes: Dict[str, np.dtype] = None
+    rung: int = 0
+    digest: Tuple = None             # hashable shape-bucket prefilter
+
+    @property
+    def spec(self) -> GroupSpec:
+        return self.gplan.spec
+
+    @property
+    def filter_node(self):
+        return self.gplan.filter_node
+
+    @property
+    def kernels(self) -> List[AggKernel]:
+        return self.gplan.kernels
+
+    @property
+    def vc_plans(self) -> Tuple:
+        return self.gplan.vc_plans
+
+    @property
+    def vc_luts(self) -> List[np.ndarray]:
+        return self.gplan.vc_luts
 
 
 def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
               intervals: Sequence[Interval], granularity: Granularity,
               aggs: Sequence[AggregatorSpec], flt,
-              virtual_columns: Sequence) -> Optional[_Plan]:
-    """Plan one segment for batched execution; None = ineligible (straggler,
-    runs per-segment). The checks mirror distributed.try_sharded minus the
-    cross-segment dictionary requirement: batched partials stay PER SEGMENT,
-    so raw dictionary ids decode through each segment's own value list."""
-    if segment.n_rows > BATCH_MAX_SEGMENT_ROWS:
-        return None
+              virtual_columns: Sequence) -> _Plan:
+    """Plan one segment for batched execution. ONE host-side planning pass
+    (grouping.plan_grouped_aggregate) serves both outcomes: eligible plans
+    group into shape buckets and drive the stacked program; ineligible
+    plans (stragglers) keep `eligible=False` and re-execute per-segment
+    through run_grouped_aggregate(plan=...) WITHOUT re-planning. The
+    eligibility checks mirror distributed.try_sharded minus the
+    cross-segment dictionary requirement: batched partials stay PER
+    SEGMENT, so raw dictionary ids decode through each segment's own value
+    list."""
     kds = tuple(kds)
+    gplan = plan_grouped_aggregate(segment, intervals, granularity, kds,
+                                   aggs, flt, virtual_columns)
+    plan = _Plan(segment=segment, kds=kds, index=index, gplan=gplan)
+    if segment.n_rows > BATCH_MAX_SEGMENT_ROWS:
+        return plan
     if any(d.host_ids is not None for d in kds):
         # numeric/expression dims derive per-segment host id columns with
         # per-segment padded device copies — stageable, but their query-time
         # dictionaries make plan constants segment-local; keep per-segment
-        return None
-    spec = make_group_spec(segment, intervals, granularity, kds)
+        return plan
+    spec, filter_node, kernels = gplan.spec, gplan.filter_node, gplan.kernels
     if spec.key_mode != "dense" or spec.bucket_mode not in ("all", "uniform"):
-        return None
+        return plan
     if spec.num_total > grouping.BLOCKED_GROUP_LIMIT:
         # bounded group spaces make select_strategy a pure function of
         # (num_total, kernels, dtypes) — identical for the batched rung and
@@ -229,36 +261,32 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
         # clustering (windowed/projection), which could diverge between
         # chunk-mates and reorder float accumulation; those segments are
         # also scatter-compute-bound, where dispatch amortization is noise
-        return None
-    filter_node = simplify_node(plan_filter(flt, segment, virtual_columns))
+        return plan
     if isinstance(filter_node, ConstNode) and not filter_node.value:
         # constant-false: the per-segment path skips the device entirely —
         # batching it would only waste a stacked slot
-        return None
-    kernels = [make_kernel(a, segment) for a in aggs]
-    vc_plans, vc_luts = plan_virtual_columns(segment, virtual_columns)
+        return plan
     needed, columns = needed_columns(segment, kds, aggs, flt, virtual_columns)
     for c in columns:
         m = segment.metrics.get(c)
         if m is not None and np.asarray(m.values).ndim != 1:
-            return None              # complex (2-D) metrics: per-segment
+            return plan              # complex (2-D) metrics: per-segment
     col_dtypes: Dict[str, np.dtype] = {
         "__time_offset": np.dtype(np.int32), "__valid": np.dtype(bool)}
     for c in columns:
         col_dtypes[c] = np.dtype(np.int32) if c in segment.dims \
             else np.dtype(segment.staged_dtype(c))
-    rung = row_rung(segment.n_rows)
+    plan.eligible = True
+    plan.f_aux = filter_node.aux_arrays() if filter_node else []
+    plan.k_aux = [a for k in kernels for a in k.aux_arrays()]
+    plan.columns = columns
+    plan.col_dtypes = col_dtypes
+    plan.rung = row_rung(segment.n_rows)
     sig = grouping._structure_sig(spec, len(intervals), filter_node, kernels,
-                                  vc_plans)
-    dtype_sig = tuple(sorted((c, str(d)) for c, d in col_dtypes.items()))
-    return _Plan(segment=segment, kds=kds, index=index, spec=spec,
-                 filter_node=filter_node,
-                 f_aux=filter_node.aux_arrays() if filter_node else [],
-                 kernels=kernels,
-                 k_aux=[a for k in kernels for a in k.aux_arrays()],
-                 vc_plans=vc_plans, vc_luts=vc_luts, columns=columns,
-                 col_dtypes=col_dtypes, rung=rung,
-                 digest=(sig, rung, columns, dtype_sig))
+                                  gplan.vc_plans)
+    plan.digest = (sig, plan.rung, columns,
+                   tuple(sorted((c, str(d)) for c, d in col_dtypes.items())))
+    return plan
 
 
 def _compatible(ref: _Plan, cand: _Plan) -> bool:
@@ -434,9 +462,13 @@ def run_with_batching(segs: Sequence[Segment], intervals: Sequence[Interval],
     plans = [_plan_for(s, kds, i, intervals, granularity, aggs, flt,
                        virtual_columns)
              for i, (s, kds) in enumerate(zip(segs, kds_per_seg))]
-    buckets = _shape_buckets([p for p in plans if p is not None])
+    buckets = _shape_buckets([p for p in plans if p.eligible])
     if not any(len(b) >= BATCH_MIN_SEGMENTS for b in buckets):
-        return None
+        # nothing batches — but the per-segment planning already happened:
+        # run the plain path HERE so the plans are executed, not rebuilt
+        return [_run_straggler(p, intervals, granularity, aggs, flt,
+                               virtual_columns, check, first=(i == 0))
+                for i, p in enumerate(plans)]
 
     results: List[Optional[SegmentPartial]] = [None] * len(segs)
     dispatched = 0
@@ -453,17 +485,24 @@ def run_with_batching(segs: Sequence[Segment], intervals: Sequence[Interval],
             dispatched += 1
             for p, partial in zip(chunk, partials):
                 results[p.index] = partial
-    if not dispatched:
-        return None
 
     n_fallback = sum(1 for r in results if r is None)
-    if n_fallback:
+    if dispatched and n_fallback:
         _STATS.record_fallback(n_fallback)
-    for i, (s, kds) in enumerate(zip(segs, kds_per_seg)):
+    for i, p in enumerate(plans):
         if results[i] is None:
-            if check is not None:
-                check()
-            results[i] = run_grouped_aggregate(
-                s, intervals, granularity, kds, aggs, flt,
-                virtual_columns=virtual_columns)
+            results[i] = _run_straggler(p, intervals, granularity, aggs,
+                                        flt, virtual_columns, check,
+                                        first=not dispatched and i == 0)
     return results
+
+
+def _run_straggler(p: _Plan, intervals, granularity, aggs, flt,
+                   virtual_columns, check, first: bool) -> SegmentPartial:
+    """Per-segment execution reusing the plan built for bucket grouping
+    (the ROADMAP's 'stragglers are planned twice' follow-on, closed)."""
+    if check is not None and not first:
+        check()
+    return run_grouped_aggregate(
+        p.segment, intervals, granularity, p.kds, aggs, flt,
+        virtual_columns=virtual_columns, plan=p.gplan)

@@ -295,12 +295,59 @@ def make_aggregate_partials(query, segments: Sequence[Segment],
     segments. `clamp=False` is used by the broker path: it pre-bounds the
     query intervals globally so bucket index spaces align across nodes.
     `check` (optional cancel/timeout probe) fires at dispatch boundaries."""
+    return _make_aggregate_partials_with_segs(query, segments, clamp,
+                                              check)[0]
+
+
+def make_partials_by_segment(query, segments: Sequence[Segment],
+                             clamp: bool = False,
+                             check=None) -> List[AggregatePartials]:
+    """One single-segment AggregatePartials PER INPUT SEGMENT (parallel to
+    `segments`; a segment outside the query intervals yields an EMPTY
+    partials object). The data node's segment-cache miss path runs its
+    whole miss set through here — ONE call, so shape-compatible misses
+    batch into shared dispatches (engine/batching.py) — and splits the
+    results back into per-segment cache entries."""
+    ap, segs = _make_aggregate_partials_with_segs(query, segments, clamp,
+                                                  check)
+    if len(ap.partials) != len(segs):
+        # the sharded path fused the set into one merged partial (mesh
+        # active) — per-segment states no longer exist, so compute each
+        # segment singly; callers needing the split semantics (the cache
+        # population path) get correct entries at per-segment cost. The
+        # cancel probe keeps firing at every dispatch boundary.
+        out = []
+        for i, s in enumerate(segments):
+            if check is not None and i:
+                check()
+            out.append(make_aggregate_partials(query, [s], clamp=clamp))
+        return out
+    remaining: Dict[int, List[int]] = {}
+    for i, s in enumerate(segs):
+        remaining.setdefault(id(s), []).append(i)
+    out = []
+    for s in segments:
+        idxs = remaining.get(id(s))
+        if idxs:
+            i = idxs.pop(0)
+            out.append(AggregatePartials([ap.partials[i]],
+                                         [ap.dim_values[i]],
+                                         [ap.spans[i]], ap.intervals))
+        else:
+            out.append(AggregatePartials([], [], [], ap.intervals))
+    return out
+
+
+def _make_aggregate_partials_with_segs(query, segments: Sequence[Segment],
+                                       clamp: bool, check
+                                       ) -> Tuple[AggregatePartials,
+                                                  List[Segment]]:
     intervals = condense(query.intervals)
     segs = _segments_for(segments, intervals)
     if clamp and not query.granularity.is_all:
         intervals = _clamp_to_data(intervals, segs)
     if not segs:
-        return AggregatePartials([], [], [], intervals)
+        return AggregatePartials([], [], [], intervals), segs
     if isinstance(query, TimeseriesQuery):
         kds_per_seg = [[] for _ in segs]
         vals_per_seg = [[] for _ in segs]
@@ -324,7 +371,7 @@ def make_aggregate_partials(query, segments: Sequence[Segment],
                                           kds_per_seg, vals_per_seg,
                                           check=check)
     spans = [(s.min_time, s.max_time) for s in segs]
-    return AggregatePartials(partials, dim_values, spans, intervals)
+    return AggregatePartials(partials, dim_values, spans, intervals), segs
 
 
 # ---------------------------------------------------------------------------

@@ -964,18 +964,58 @@ def needed_columns(segment: Segment, kds: Sequence[KeyDim],
     return needed, present
 
 
+@dataclass
+class GroupPlan:
+    """The host-side planning product for one segment's grouped aggregation
+    — everything run_grouped_aggregate derives BEFORE staging: group spec,
+    simplified filter tree, kernel instances, virtual-column programs.
+    Built by plan_grouped_aggregate; the batched path (engine/batching.py)
+    plans every segment once for bucket grouping and hands the same plan
+    back on straggler fallback so nothing is planned twice.
+
+    Single-use per execution: run_grouped_aggregate mutates spec (strategy
+    selection, projection rewrites) — do not share one plan across runs."""
+    spec: "GroupSpec"
+    filter_node: object
+    kernels: List[AggKernel]
+    vc_plans: Tuple
+    vc_luts: List[np.ndarray]
+
+
+def plan_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
+                           granularity: Granularity,
+                           dims: Sequence[KeyDim],
+                           aggs: Sequence[AggregatorSpec], flt,
+                           virtual_columns: Sequence = ()) -> GroupPlan:
+    """Host-side planning for one segment (no staging, no device work)."""
+    vc_plans, vc_luts = plan_virtual_columns(segment, virtual_columns)
+    return GroupPlan(
+        spec=make_group_spec(segment, intervals, granularity, dims),
+        filter_node=simplify_node(plan_filter(flt, segment,
+                                              virtual_columns)),
+        kernels=[make_kernel(a, segment) for a in aggs],
+        vc_plans=vc_plans, vc_luts=vc_luts)
+
+
 def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                           granularity: Granularity, dims: Sequence[KeyDim],
                           aggs: Sequence[AggregatorSpec],
                           flt, extra_columns: Sequence[str] = (),
-                          virtual_columns: Sequence = ()) -> SegmentPartial:
-    """Execute the grouped aggregation for one segment; returns host partials."""
+                          virtual_columns: Sequence = (),
+                          plan: Optional[GroupPlan] = None) -> SegmentPartial:
+    """Execute the grouped aggregation for one segment; returns host
+    partials. `plan` (a GroupPlan from plan_grouped_aggregate over the SAME
+    arguments) skips re-planning — the batched path's straggler fallback
+    passes the plan it already built for bucket grouping."""
     from druid_tpu.utils.expression import parse_expression
 
-    spec = make_group_spec(segment, intervals, granularity, dims)
-    filter_node = simplify_node(plan_filter(flt, segment, virtual_columns))
-    kernels = [make_kernel(a, segment) for a in aggs]
-    vc_plans, vc_luts = plan_virtual_columns(segment, virtual_columns)
+    if plan is None:
+        plan = plan_grouped_aggregate(segment, intervals, granularity, dims,
+                                      aggs, flt, virtual_columns)
+    spec = plan.spec
+    filter_node = plan.filter_node
+    kernels = plan.kernels
+    vc_plans, vc_luts = plan.vc_plans, plan.vc_luts
 
     if isinstance(filter_node, ConstNode) and not filter_node.value:
         # constant-false filter: nothing matches — skip the device entirely

@@ -102,6 +102,12 @@ class TaskActionServer:
         self._httpd.shutdown()
         self._httpd.server_close()
 
+    def status(self, task_id: str) -> Optional[TaskStatus]:
+        """Locked read of a peon-reported status — monitors poll this
+        while handler threads record into the same dict."""
+        with self._lock:
+            return self.statuses.get(task_id)
+
     def live_workers(self, ttl: float = 30.0) -> List[str]:
         """Workers whose heartbeat arrived within `ttl` seconds — the
         overlord's view of peon liveness (WorkerTaskMonitor's periodic
@@ -413,7 +419,7 @@ class ForkingTaskRunner:
         t.start()
         return task.id
 
-    def _fork(self, task_id: str) -> subprocess.Popen:
+    def _fork(self, task_id: str, attempt: int) -> subprocess.Popen:
         env = dict(os.environ)
         # peons never own the TPU: ingest is host-side numpy work, and a
         # crashed peon must not wedge the chip the serving process holds —
@@ -426,10 +432,12 @@ class ForkingTaskRunner:
         if repo_root not in paths:
             paths.insert(0, repo_root)
         env["PYTHONPATH"] = os.pathsep.join(paths)
-        log_path = self._specs[task_id] + f".log.{self.attempts[task_id]}"
+        with self._lock:
+            spec_path = self._specs[task_id]
+        log_path = spec_path + f".log.{attempt}"
         logf = open(log_path, "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "druid_tpu.peon", self._specs[task_id]],
+            [sys.executable, "-m", "druid_tpu.peon", spec_path],
             stdout=logf, stderr=subprocess.STDOUT, env=env)
         logf.close()
         with self._lock:
@@ -438,11 +446,14 @@ class ForkingTaskRunner:
 
     def _monitor(self, task_id: str) -> None:
         while True:
+            # snapshot the attempt count under the lock once; unlocked
+            # re-reads below would race a concurrent resubmit's reset
             with self._lock:
                 self.attempts[task_id] += 1
-            proc = self._fork(task_id)
+                attempt = self.attempts[task_id]
+            proc = self._fork(task_id, attempt)
             proc.wait()
-            reported = self.actions.statuses.get(task_id)
+            reported = self.actions.status(task_id)
             if reported is not None and reported.state in ("SUCCESS",
                                                            "FAILED"):
                 status = reported
@@ -453,9 +464,9 @@ class ForkingTaskRunner:
             if self._shutdown:
                 status = TaskStatus.failure(task_id, "runner shut down")
                 break
-            if self.attempts[task_id] > self.max_restarts:
+            if attempt > self.max_restarts:
                 status = TaskStatus.failure(
-                    task_id, f"peon died {self.attempts[task_id]} times "
+                    task_id, f"peon died {attempt} times "
                     f"(exit {proc.returncode})")
                 break
         self.lockbox.release_all(task_id)

@@ -17,6 +17,11 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence
 
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:        # runtime import would cycle through coordination
+    from druid_tpu.coordination.latch import LeaderParticipant
+
 from druid_tpu.cluster.metadata import (MetadataStore, SegmentDescriptor,
                                         StaleTermError)
 from druid_tpu.data.segment import Segment
@@ -93,7 +98,8 @@ class Overlord:
 
     def __init__(self, metadata: MetadataStore,
                  deep_storage: Optional[DeepStorage] = None,
-                 max_workers: int = 4, leader=None):
+                 max_workers: int = 4,
+                 leader: Optional["LeaderParticipant"] = None):
         self.metadata = metadata
         self.deep_storage = deep_storage or InMemoryDeepStorage()
         self.lockbox = TaskLockbox()

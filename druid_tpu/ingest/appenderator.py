@@ -32,6 +32,7 @@ from druid_tpu.ingest.incremental import IncrementalIndex
 from druid_tpu.ingest.input import RowBatch
 from druid_tpu.ingest.merger import merge_segments
 from druid_tpu.query import aggregators as A
+from druid_tpu.storage.deep import DeepStorage
 from druid_tpu.utils.granularity import Granularity
 from druid_tpu.utils.intervals import Interval
 
@@ -264,7 +265,7 @@ class StreamAppenderatorDriver:
                  metadata: MetadataStore,
                  handoff: Optional[Callable[
                      [List[Tuple[SegmentDescriptor, Segment]]], None]] = None,
-                 deep_storage=None):
+                 deep_storage: Optional["DeepStorage"] = None):
         self.appenderator = appenderator
         self.allocator = allocator
         self.metadata = metadata

@@ -211,8 +211,11 @@ class AvaticaServer:
         cols, rows = self.sql.execute(sql, parameters)
         if max_rows is not None and max_rows >= 0:
             rows = rows[:max_rows]
-        st = conn.statements.setdefault(sid, _Statement(sid))
-        st.columns, st.rows = list(cols), [list(r) for r in rows]
+        # statement registry is mutated under the server lock everywhere
+        # else; concurrent requests on one connection race the dict insert
+        with self._lock:
+            st = conn.statements.setdefault(sid, _Statement(sid))
+            st.columns, st.rows = list(cols), [list(r) for r in rows]
         first = st.rows[: self.max_rows_per_frame]
         done = len(first) == len(st.rows)
         return {

@@ -271,7 +271,9 @@ def load_segment(directory: str,
         else:
             metrics[name] = NumericColumn(vals, ValueType(tname))
     seg = Segment(seg_id, time_ms.copy(), dims, metrics, sorted_by_time=True)
-    seg._mapper = mapper  # keep mmaps alive for lazy bitmap loads
+    # loader-local publish: `seg` has no other referent until this return,
+    # so the post-construction write cannot race (same-safety as __init__)
+    seg._mapper = mapper  # druidlint: disable=unguarded-shared-write  # keep mmaps alive for lazy bitmap loads
     return seg
 
 

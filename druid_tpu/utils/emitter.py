@@ -90,11 +90,14 @@ class FileEmitter(Emitter):
 
     def emit(self, event):
         with self._lock:
+            if self._fh.closed:
+                return        # late tick racing shutdown: drop, don't raise
             self._fh.write(json.dumps(event.to_json()) + "\n")
 
     def flush(self):
         with self._lock:
-            self._fh.flush()
+            if not self._fh.closed:
+                self._fh.flush()
 
     def close(self):
         with self._lock:
@@ -298,5 +301,12 @@ class MonitorScheduler:
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 
-    def stop(self):
+    def stop(self, join_timeout: float = 5.0):
+        """Signal the loop AND wait for it: callers close their emitter
+        right after stop(), and a tick still in flight would write to the
+        closed sink (FileEmitter additionally drops late writes — belt and
+        suspenders, since a tick may be mid-emit when stop() is called)."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=join_timeout)

@@ -123,8 +123,13 @@ class Lifecycle:
         self._stop_event.set()
 
     def _unwind(self) -> None:
-        while self._started:
-            stage, _, label, _, stop_fn = self._started.pop()
+        while True:
+            # pop under the lock: start() appends under it, and a start
+            # thread racing a stop() must not tear a list resize
+            with self._lock:
+                if not self._started:
+                    return
+                stage, _, label, _, stop_fn = self._started.pop()
             if stop_fn is None:
                 continue
             try:

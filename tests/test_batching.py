@@ -260,3 +260,218 @@ def test_large_group_space_falls_back():
     plain, batched, n_batches = run_both(segs, q)
     assert n_batches == 0
     assert plain == batched
+
+
+# ---------------------------------------------------------------------------
+# plan reuse (PR 5): one host-side planning pass per segment, stragglers
+# included
+# ---------------------------------------------------------------------------
+
+def _counting_planner(monkeypatch):
+    from druid_tpu.engine import grouping
+    calls = collections.Counter()
+    real = grouping.plan_grouped_aggregate
+
+    def counted(segment, *a, **kw):
+        calls[id(segment)] += 1
+        return real(segment, *a, **kw)
+
+    monkeypatch.setattr(grouping, "plan_grouped_aggregate", counted)
+    # batching binds the name at import time — patch its reference too
+    monkeypatch.setattr(batching, "plan_grouped_aggregate", counted)
+    return calls
+
+
+def test_stragglers_are_planned_once(monkeypatch):
+    """A mixed set (one bucket of 4 + an incompatible straggler): every
+    segment is planned EXACTLY once — the straggler's fallback execution
+    reuses the plan built for bucket grouping instead of re-planning."""
+    gen = DataGenerator(SCHEMA, seed=11)
+    segs = gen.segments(4, 3000, IV, datasource="mix")
+    # straggler: a long column beyond int32 stages int64 -> its own bucket
+    b = SegmentBuilder("mix", IV)
+    for i in range(256):
+        b.add_row(IV.start + i * 1000, {"dimA": f"v{i % 3}"},
+                  {"metLong": 2**40 + i})
+    segs.append(b.build())
+    calls = _counting_planner(monkeypatch)
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "aggregations": [{"type": "longSum", "name": "ls",
+                           "fieldName": "metLong"}]}
+    ex = QueryExecutor(segs)
+    before = batching.stats().snapshot()
+    ex.run_json(q)
+    after = batching.stats().snapshot()
+    assert after["batches"] > before["batches"], "the bucket must dispatch"
+    assert after["fallbackSegments"] > before["fallbackSegments"]
+    assert set(calls.values()) == {1}, (
+        f"every segment plans exactly once, got {dict(calls)}")
+    assert len(calls) == len(segs)
+
+
+def test_nothing_batches_still_plans_once(monkeypatch):
+    """When no bucket reaches BATCH_MIN_SEGMENTS, run_with_batching now
+    executes the per-segment path ITSELF with the plans it already built —
+    again exactly one planning pass per segment."""
+    gen = DataGenerator(SCHEMA, seed=13)
+    segs = []
+    for i, rows in enumerate((1000, 3000, 9000, 17000)):
+        segs += DataGenerator(SCHEMA, seed=20 + i).segments(
+            1, rows, IV, datasource="mix")
+    calls = _counting_planner(monkeypatch)
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "aggregations": [{"type": "doubleSum", "name": "ds",
+                           "fieldName": "metDouble"}]}
+    plain, batched, n_batches = run_both(segs, q)
+    assert plain == batched
+    assert n_batches == 0          # four distinct rungs: no bucket forms
+    # run_both executes twice (batching off + on); each execution plans
+    # each segment once
+    assert set(calls.values()) == {2}, dict(calls)
+
+
+def test_straggler_parity_with_plan_reuse():
+    """Plan-carrying fallback is bit-identical to the plain path."""
+    gen = DataGenerator(SCHEMA, seed=17)
+    segs = gen.segments(5, 3000, IV, datasource="mix")
+    b = SegmentBuilder("mix", IV)
+    for i in range(300):
+        b.add_row(IV.start + i * 777, {"dimA": f"v{i % 5}"},
+                  {"metLong": 2**41 + 7 * i})
+    segs.append(b.build())
+    q = {"queryType": "groupBy", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "day",
+         "dimensions": ["dimA"], "aggregations": AGGS}
+    plain, batched, n_batches = run_both(segs, q)
+    assert n_batches >= 1
+    assert plain == batched
+
+
+# ---------------------------------------------------------------------------
+# batched segment-cache miss path (cluster/view.py run_partials)
+# ---------------------------------------------------------------------------
+
+def _cached_node(segs):
+    from druid_tpu.cluster.cache import CacheConfig, LruCache
+    from druid_tpu.cluster.view import DataNode
+    node = DataNode("n1", cache=LruCache(),
+                    cache_config=CacheConfig(use_segment_cache=True,
+                                             populate_segment_cache=True))
+    for s in segs:
+        node.load_segment(s)
+    return node
+
+
+def _finish(query_json, ap):
+    from druid_tpu.engine import engines
+    from druid_tpu.query.model import query_from_json
+    q = query_from_json(query_json)
+    return engines.finish_timeseries(q, ap)
+
+
+def test_cache_miss_set_runs_one_batched_wave():
+    """The segment-cache miss path computes the whole miss set through
+    make_partials_by_segment: shape-compatible misses fuse into batched
+    dispatches, the split-back entries serve later queries as hits, and
+    results are bit-identical to the uncached node."""
+    from druid_tpu.query.model import query_from_json
+    gen = DataGenerator(SCHEMA, seed=23)
+    segs = gen.segments(6, 3000, IV, datasource="mix")
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "hour",
+         "aggregations": AGGS}
+    node = _cached_node(segs)
+    sids = [str(s.id) for s in segs]
+
+    before = batching.stats().snapshot()
+    ap_cold, served = node.run_partials(query_from_json(q), sids)
+    after = batching.stats().snapshot()
+    assert len(served) == 6
+    assert after["batches"] > before["batches"], (
+        "cold misses must go through the batched wave")
+    assert node.cache.stats.misses >= 6     # six cache probes missed
+
+    hits_before = node.cache.stats.hits
+    ap_warm, _ = node.run_partials(query_from_json(q), sids)
+    assert node.cache.stats.hits >= hits_before + 6
+
+    from druid_tpu.cluster.view import DataNode
+    plain_node = DataNode("plain")
+    for s in segs:
+        plain_node.load_segment(s)
+    ap_plain, _ = plain_node.run_partials(query_from_json(q), sids)
+    assert _finish(q, ap_cold) == _finish(q, ap_warm) == _finish(q, ap_plain)
+
+
+def test_cache_partial_miss_mixes_hits_and_batched_misses():
+    """Second query over a superset: cached segments hit, the new ones run
+    through one wave; merged results stay exact."""
+    from druid_tpu.query.model import query_from_json
+    gen = DataGenerator(SCHEMA, seed=29)
+    segs = gen.segments(8, 3000, IV, datasource="mix")
+    q = {"queryType": "timeseries", "dataSource": "mix",
+         "intervals": [str(IV)], "granularity": "all",
+         "aggregations": [{"type": "longSum", "name": "ls",
+                           "fieldName": "metLong"},
+                          {"type": "doubleSum", "name": "ds",
+                           "fieldName": "metDouble"}]}
+    node = _cached_node(segs)
+    first_four = [str(s.id) for s in segs[:4]]
+    node.run_partials(query_from_json(q), first_four)
+    misses_before = node.cache.stats.misses
+    hits_before = node.cache.stats.hits
+    ap_all, _ = node.run_partials(query_from_json(q),
+                                  [str(s.id) for s in segs])
+    assert node.cache.stats.hits == hits_before + 4
+    assert node.cache.stats.misses == misses_before + 4
+
+    from druid_tpu.cluster.view import DataNode
+    plain_node = DataNode("plain")
+    for s in segs:
+        plain_node.load_segment(s)
+    ap_plain, _ = plain_node.run_partials(query_from_json(q),
+                                          [str(s.id) for s in segs])
+    assert _finish(q, ap_all) == _finish(q, ap_plain)
+
+
+def test_partials_by_segment_survives_sharded_fusion(monkeypatch):
+    """REGRESSION (review): when the mesh path fuses the set into ONE
+    merged partial, make_partials_by_segment must fall back to per-segment
+    computation instead of mis-splitting (cache poisoning) or crashing."""
+    from druid_tpu.engine import engines
+    from druid_tpu.parallel import distributed
+    from druid_tpu.query.model import query_from_json
+    gen = DataGenerator(SCHEMA, seed=31)
+    segs = gen.segments(3, 2000, IV, datasource="mix")
+    q = query_from_json({"queryType": "timeseries", "dataSource": "mix",
+                         "intervals": [str(IV)], "granularity": "all",
+                         "aggregations": [{"type": "longSum", "name": "ls",
+                                           "fieldName": "metLong"}]})
+    expected = [engines.make_aggregate_partials(q, [s], clamp=False)
+                for s in segs]
+
+    real = distributed.try_sharded
+    state = {"fused": 0}
+
+    def fusing(segs_in, *a, **kw):
+        # simulate the mesh fusing a MULTI-segment set into one partial
+        if len(segs_in) > 1 and not state.get("busy"):
+            state["fused"] += 1
+            state["busy"] = True      # the inner run must not re-fuse
+            try:
+                ap = engines.make_aggregate_partials(q, list(segs_in),
+                                                     clamp=False)
+            finally:
+                state["busy"] = False
+            return ap.partials[0]
+        return real(segs_in, *a, **kw)
+
+    monkeypatch.setattr(distributed, "try_sharded", fusing)
+    got = engines.make_partials_by_segment(q, segs, clamp=False)
+    assert state["fused"] >= 1, "the fused path was not exercised"
+    assert len(got) == len(segs)
+    for g, e in zip(got, expected):
+        assert len(g.partials) == 1
+        assert _finish(q.to_json(), g) == _finish(q.to_json(), e)

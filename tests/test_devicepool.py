@@ -134,3 +134,76 @@ def test_pool_monitor_emits_metrics(fresh_pool):
     mon.do_monitor(emitter)
     assert not sink.metrics("segment/devicePool/hitRate")
     assert sink.metrics("segment/devicePool/hits")[-1].value == 0
+
+
+def test_finalizer_never_takes_the_pool_lock(fresh_pool):
+    """REGRESSION (raceguard witness finding): the owner finalizer runs at
+    arbitrary allocation points — including while the CURRENT thread holds
+    the pool lock. A finalizer that acquired the lock would self-deadlock;
+    it must only enqueue the dead token, leaving the purge to the next
+    locked pool operation."""
+    class Owner:
+        pass
+
+    owner_obj = Owner()
+    token = fresh_pool.register_owner(owner_obj)
+    fresh_pool.get_or_build(token, ("k",),
+                            lambda: np.zeros(64, dtype=np.int64))
+    assert fresh_pool.snapshot().resident_bytes == 64 * 8
+
+    acquired = fresh_pool._lock.acquire(timeout=5)
+    assert acquired
+    try:
+        del owner_obj
+        gc.collect()       # finalizer fires HERE, with the lock held by us
+        assert list(fresh_pool._dead_owners) == [token]
+    finally:
+        fresh_pool._lock.release()
+    # the next locked operation drains the dead owner
+    s = fresh_pool.snapshot()
+    assert s.resident_bytes == 0 and s.entries == 0
+    assert not fresh_pool._dead_owners
+
+
+def test_purge_during_build_does_not_resurrect(fresh_pool):
+    """REGRESSION: get_or_build runs build() OUTSIDE the lock. If the owner
+    dies during the build, the insert must NOT cache the value — the
+    finalizer already ran, so a cached entry would pin device memory until
+    process exit."""
+    class Owner:
+        pass
+
+    owner_obj = Owner()
+    token = fresh_pool.register_owner(owner_obj)
+    holder = {"obj": owner_obj}
+    del owner_obj
+
+    def build():
+        # the segment is dropped (and collected) mid-build
+        del holder["obj"]
+        gc.collect()
+        return np.zeros(32, dtype=np.int64)
+
+    value = fresh_pool.get_or_build(token, ("k",), build)
+    assert value.nbytes == 32 * 8         # caller still gets its value
+    s = fresh_pool.snapshot()
+    assert s.entries == 0 and s.resident_bytes == 0, (
+        "a dead owner's entry must not be cached")
+
+
+def test_clear_keeps_live_owners_cacheable(fresh_pool):
+    """clear() drops entries but must keep live owners registered — a
+    cleared pool that refused live segments' inserts would never cache
+    again."""
+    class Owner:
+        pass
+
+    owner_obj = Owner()
+    token = fresh_pool.register_owner(owner_obj)
+    fresh_pool.get_or_build(token, ("k",),
+                            lambda: np.zeros(8, dtype=np.int64))
+    fresh_pool.clear()
+    assert fresh_pool.snapshot().entries == 0
+    fresh_pool.get_or_build(token, ("k",),
+                            lambda: np.zeros(8, dtype=np.int64))
+    assert fresh_pool.snapshot().entries == 1

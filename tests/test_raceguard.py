@@ -1,0 +1,728 @@
+"""raceguard rule tests: each concurrency rule fires on its hazard, stays
+quiet on the disciplined equivalent, and honors rationale suppressions —
+plus the whole-program machinery (binder, dataflow, thread roots, lock-order
+graph, cross-module cache soundness, --dot CLI)."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.druidlint.core import (LintConfig, check_source,  # noqa: E402
+                                  lint_paths, load_config)
+from tools.druidlint.raceguard import (analyze_sources,  # noqa: E402
+                                       analyze_tree, render_dot)
+
+RULES = ("unguarded-shared-write", "lock-order-cycle", "guard-consistency",
+         "lock-in-traced")
+
+
+def cfg(*rules) -> LintConfig:
+    """Config scoped to the given rules with NO on-disk program (root
+    points nowhere), so check_source analyzes the module standalone."""
+    c = LintConfig(rules=list(rules) if rules else [])
+    c.root = "/nonexistent-raceguard-root"
+    return c
+
+
+def findings_of(source: str, rule: str, path: str = "druid_tpu/mod.py"):
+    return [f for f in check_source(source, path, cfg(rule))
+            if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-write
+# ---------------------------------------------------------------------------
+
+MIXED_WRITE = """\
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def reset(self):
+        self.n = 0
+"""
+
+
+def test_unguarded_write_mixed_discipline_fires():
+    got = findings_of(MIXED_WRITE, "unguarded-shared-write")
+    assert len(got) == 1
+    assert got[0].line == 13                 # the reset() write
+
+
+def test_unguarded_write_all_locked_is_quiet():
+    src = MIXED_WRITE.replace("    def reset(self):\n        self.n = 0\n",
+                              "    def reset(self):\n"
+                              "        with self._lock:\n"
+                              "            self.n = 0\n")
+    assert findings_of(src, "unguarded-shared-write") == []
+
+
+def test_unguarded_write_init_is_exempt():
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        self.n = 1
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+"""
+    assert findings_of(src, "unguarded-shared-write") == []
+
+
+def test_unguarded_write_suppression():
+    src = MIXED_WRITE.replace(
+        "        self.n = 0\n",
+        "        self.n = 0  "
+        "# druidlint: disable=unguarded-shared-write  # reset is test-only\n",
+        1).replace("    def reset(self):\n        self.n = 0\n",
+                   "    def reset(self):\n        self.n = 0  "
+                   "# druidlint: disable=unguarded-shared-write\n")
+    assert findings_of(src, "unguarded-shared-write") == []
+
+
+def test_unguarded_write_mutator_counts_as_write():
+    src = """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def put(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def drop(self):
+        self.items.clear()
+"""
+    got = findings_of(src, "unguarded-shared-write")
+    assert len(got) == 1 and got[0].line == 13
+
+
+TWO_ROOT_WRITE = """\
+import threading
+
+class Shared:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    def writer_a(self):
+        self.state["a"] = 1
+
+    def writer_b(self):
+        self.state["b"] = 2
+
+    def start(self):
+        threading.Thread(target=self.writer_a).start()
+        threading.Thread(target=self.writer_b).start()
+"""
+
+
+def test_two_thread_roots_no_common_lock_fires():
+    got = findings_of(TWO_ROOT_WRITE, "unguarded-shared-write")
+    assert len(got) == 1                     # one finding per state
+    assert "thread roots" in got[0].message
+
+
+def test_lockless_class_from_roots_is_quiet():
+    # a class without any lock is treated as per-request state: flagging
+    # every plan/builder object reachable from a handler would drown signal
+    src = TWO_ROOT_WRITE.replace(
+        "        self._lock = threading.Lock()\n", "")
+    assert findings_of(src, "unguarded-shared-write") == []
+
+
+def test_module_global_mixed_discipline_fires():
+    src = """\
+import threading
+
+_LOCK = threading.Lock()
+_CACHE = {}
+
+def insert(k, v):
+    with _LOCK:
+        _CACHE[k] = v
+
+def wipe():
+    _CACHE.clear()
+"""
+    got = findings_of(src, "unguarded-shared-write")
+    assert len(got) == 1 and got[0].line == 11
+
+
+# ---------------------------------------------------------------------------
+# guard-consistency
+# ---------------------------------------------------------------------------
+
+GUARDED_READ = """\
+import threading
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.entries[k] = v
+
+    def peek(self):
+        return len(self.entries)
+
+    def start(self):
+        threading.Thread(target=self.add).start()
+        threading.Thread(target=self.peek).start()
+"""
+
+
+def test_guard_consistency_unlocked_read_on_root_path_fires():
+    got = findings_of(GUARDED_READ, "guard-consistency")
+    assert len(got) == 1
+    assert got[0].line == 13
+
+
+def test_guard_consistency_locked_read_is_quiet():
+    src = GUARDED_READ.replace(
+        "    def peek(self):\n        return len(self.entries)\n",
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return len(self.entries)\n")
+    assert findings_of(src, "guard-consistency") == []
+
+
+def test_guard_consistency_off_root_read_is_quiet():
+    # nothing spawns a thread that reaches peek(): single-threaded read
+    src = GUARDED_READ.replace(
+        "        threading.Thread(target=self.peek).start()\n", "")
+    assert findings_of(src, "guard-consistency") == []
+
+
+def test_guard_consistency_locked_helper_is_quiet():
+    """Interprocedural MUST-held: a _locked helper invoked only under the
+    lock holds it by intersection over call sites."""
+    src = """\
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}
+
+    def insert(self, k, v):
+        with self._lock:
+            self.entries[k] = v
+            self._trim_locked()
+
+    def _trim_locked(self):
+        while len(self.entries) > 8:
+            self.entries.popitem()
+
+    def start(self):
+        threading.Thread(target=self.insert).start()
+"""
+    assert findings_of(src, "guard-consistency") == []
+
+
+def test_guard_consistency_suppression():
+    src = GUARDED_READ.replace(
+        "        return len(self.entries)\n",
+        "        return len(self.entries)  "
+        "# druidlint: disable=guard-consistency\n")
+    assert findings_of(src, "guard-consistency") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
+
+ABBA = """\
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def cross(self):
+        with self._lock:
+            self.b.poke()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class B:
+    def __init__(self, a: A):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def cross(self):
+        with self._lock:
+            self.a.poke()
+
+    def poke(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_lock_order_cycle_abba_fires():
+    got = findings_of(ABBA, "lock-order-cycle")
+    assert len(got) == 1
+    assert "cycle" in got[0].message
+
+
+def test_lock_order_consistent_order_is_quiet():
+    one_way = ABBA.replace(
+        "    def cross(self):\n"
+        "        with self._lock:\n"
+        "            self.a.poke()\n", "    def cross(self):\n"
+                                       "        self.a.poke()\n")
+    assert findings_of(one_way, "lock-order-cycle") == []
+
+
+def test_self_deadlock_through_self_call_fires():
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+    got = findings_of(src, "lock-order-cycle")
+    assert len(got) == 1
+    assert "non-reentrant" in got[0].message
+
+
+def test_lock_order_cycle_suppression():
+    """A rationale pragma on the cycle's anchor line silences it (e.g. a
+    cycle that a runtime mode flag makes unreachable)."""
+    got = findings_of(ABBA, "lock-order-cycle")
+    assert len(got) == 1
+    lines = ABBA.splitlines()
+    lines[got[0].line - 1] += "  # druidlint: disable=lock-order-cycle"
+    assert findings_of("\n".join(lines) + "\n", "lock-order-cycle") == []
+
+
+def test_rlock_self_reentry_is_quiet():
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+    assert findings_of(src, "lock-order-cycle") == []
+
+
+def test_condition_alias_shares_identity():
+    """Condition(self._lock) IS self._lock: nesting them is reentrancy of
+    one lock (a runtime bug on a plain Lock, but not an ABBA cycle), and
+    split guard attribution would be wrong."""
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.jobs = []
+
+    def put(self, j):
+        with self._cond:
+            self.jobs.append(j)
+
+    def flush(self):
+        with self._lock:
+            self.jobs.clear()
+"""
+    # both writes hold the SAME lock id — no mixed-discipline finding
+    assert findings_of(src, "unguarded-shared-write") == []
+
+
+# ---------------------------------------------------------------------------
+# lock-in-traced
+# ---------------------------------------------------------------------------
+
+LOCK_IN_JIT = """\
+import threading
+import jax
+
+_lock = threading.Lock()
+
+def kernel(x):
+    with _lock:
+        return x + 1
+
+fn = jax.jit(kernel)
+"""
+
+
+def test_lock_in_traced_fires():
+    got = findings_of(LOCK_IN_JIT, "lock-in-traced")
+    assert len(got) == 1 and got[0].line == 7
+
+
+def test_lock_acquire_in_traced_fires():
+    src = LOCK_IN_JIT.replace("    with _lock:\n        return x + 1\n",
+                              "    _lock.acquire()\n    return x + 1\n")
+    got = findings_of(src, "lock-in-traced")
+    assert len(got) == 1
+
+
+def test_lock_outside_traced_is_quiet():
+    src = """\
+import threading
+import jax
+
+_lock = threading.Lock()
+
+def kernel(x):
+    return x + 1
+
+def dispatch(x):
+    with _lock:
+        return jax.jit(kernel)(x)
+"""
+    assert findings_of(src, "lock-in-traced") == []
+
+
+def test_lock_in_traced_suppression():
+    src = LOCK_IN_JIT.replace(
+        "    with _lock:\n",
+        "    with _lock:  # druidlint: disable=lock-in-traced\n")
+    assert findings_of(src, "lock-in-traced") == []
+
+
+# ---------------------------------------------------------------------------
+# whole-program machinery
+# ---------------------------------------------------------------------------
+
+def test_cross_module_root_reaches_write(tmp_path):
+    """The hazard spans two modules: the thread root lives in a.py, the
+    mixed-discipline class in b.py — only a whole-program view connects
+    them."""
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir()
+    (pkg / "b.py").write_text("""\
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+
+    def write_a(self):
+        self.rows["a"] = 1
+
+    def write_b(self):
+        self.rows["b"] = 2
+""")
+    (pkg / "a.py").write_text("""\
+import threading
+from druid_tpu.b import Store
+
+def launch():
+    s = Store()
+    threading.Thread(target=s.write_a).start()
+    threading.Thread(target=s.write_b).start()
+""")
+    config = load_config(tmp_path)
+    config.rules = ["unguarded-shared-write"]
+    findings = lint_paths(tmp_path, config)
+    assert [f.rule for f in findings] == ["unguarded-shared-write"]
+    assert findings[0].path == "druid_tpu/b.py"
+
+
+def test_cross_module_cache_is_dropped_on_any_program_edit(tmp_path):
+    """Per-file mtime caching must NOT survive edits to OTHER program
+    modules: adding a thread root in a.py changes b.py's findings."""
+    import os
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir()
+    (pkg / "b.py").write_text("""\
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+
+    def write_a(self):
+        self.rows["a"] = 1
+
+    def write_b(self):
+        self.rows["b"] = 2
+""")
+    (pkg / "a.py").write_text("from druid_tpu.b import Store\n")
+    cache = tmp_path / ".cache.json"
+    config = load_config(tmp_path)
+    config.rules = ["unguarded-shared-write"]
+    assert lint_paths(tmp_path, config, cache_path=cache) == []
+    # grow the root in a DIFFERENT file than the finding's
+    (pkg / "a.py").write_text("""\
+import threading
+from druid_tpu.b import Store
+
+def launch():
+    s = Store()
+    threading.Thread(target=s.write_a).start()
+    threading.Thread(target=s.write_b).start()
+""")
+    os.utime(pkg / "b.py")        # keep b.py's own mtime-key identical
+    config2 = load_config(tmp_path)
+    config2.rules = ["unguarded-shared-write"]
+    findings = lint_paths(tmp_path, config2, cache_path=cache)
+    assert [f.path for f in findings] == ["druid_tpu/b.py"]
+
+
+HANDLER_PROGRAM = """\
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                outer.record()
+
+        self.handler = Handler
+
+    def record(self):
+        self.hits["n"] = self.hits.get("n", 0) + 1
+"""
+
+
+def test_handler_outer_self_idiom_is_a_concurrent_root():
+    """The nested-Handler `outer = self` closure types the call back into
+    the server class; do_* methods are concurrent roots, so the unlocked
+    dict write fires (variant b: no locked write exists — the root
+    discovery alone must carry the finding)."""
+    got = findings_of(HANDLER_PROGRAM, "unguarded-shared-write")
+    assert len(got) == 1
+    assert "thread roots" in got[0].message
+    assert got[0].line == 17               # the record() dict write
+
+
+def test_dict_element_annotation_types_lock_edges():
+    """`self._tls: Dict[str, Timeline]` + .setdefault() resolves the
+    element class — the acquisition inside Timeline lands in the order
+    graph (the edge the dynamic witness observed in the real tree)."""
+    src = '''\
+import threading
+from typing import Dict
+
+class Timeline:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def add(self, x):
+        with self._lock:
+            pass
+
+class View:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._tls: Dict[str, Timeline] = {}
+
+    def announce(self, ds, x):
+        with self._lock:
+            tl = self._tls.setdefault(ds, Timeline())
+            tl.add(x)
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("View._lock", "Timeline._lock") in edges
+
+
+def test_thread_root_discovery_kinds():
+    src = """\
+import threading
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+class Svc:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(2)
+
+    def tick(self):
+        pass
+
+    def probe(self):
+        pass
+
+    def cleanup(self):
+        pass
+
+    def start(self, obj):
+        threading.Thread(target=self.tick).start()
+        self.pool.submit(self.probe)
+        weakref.finalize(obj, self.cleanup)
+"""
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    kinds = {fid.split(".")[-1]: kind for fid, kind in prog.roots.items()}
+    assert kinds == {"tick": "thread", "probe": "submit",
+                     "cleanup": "finalizer"}
+
+
+def test_extra_thread_roots_config():
+    src = """\
+import threading
+
+class Mon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = {}
+
+    def set_last(self, v):
+        with self._lock:
+            self.last["v"] = v
+
+    def do_monitor(self, emitter):
+        return len(self.last)
+"""
+    quiet = cfg("guard-consistency")
+    assert [f for f in check_source(src, "druid_tpu/m.py", quiet)
+            if f.rule == "guard-consistency"] == []
+    rooted = cfg("guard-consistency")
+    rooted.extra_thread_roots = ["druid_tpu/*::*.do_monitor",
+                                 "druid_tpu/*::*.set_last"]
+    got = [f for f in check_source(src, "druid_tpu/m.py", rooted)
+           if f.rule == "guard-consistency"]
+    assert len(got) == 1 and got[0].line == 13
+
+
+def test_lock_sites_map_construction_lines():
+    prog = analyze_sources({"druid_tpu/m.py": MIXED_WRITE}, cfg())
+    sites = prog.lock_sites()
+    assert sites == {("druid_tpu/m.py", 5):
+                     "druid_tpu/m.py::Counter._lock"}
+
+
+def test_real_tree_program_is_acyclic_and_indexed():
+    """The shipped tree: locks indexed, thread roots found, order graph
+    cycle-free (the gate would fail otherwise — this pins the numbers from
+    drifting silently to zero, which would mean the analyzer went blind)."""
+    config = load_config(REPO_ROOT)
+    prog = analyze_tree(REPO_ROOT, config)
+    assert len(prog.locks) >= 30
+    assert len(prog.roots) >= 12
+    assert any(kind == "handler" for kind in prog.roots.values())
+    assert len(prog.order_edges) >= 5
+    assert prog.findings.get("lock-order-cycle", {}) == {}
+
+
+def test_dot_output(tmp_path):
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(ABBA)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--root", str(tmp_path),
+         "--dot"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    out = proc.stdout
+    assert out.startswith("digraph lock_order {")
+    assert "A._lock" in out and "B._lock" in out
+    assert "color=red" in out          # the ABBA pair is a cycle
+
+
+def test_assume_edges_join_graph_and_cycle_check():
+    """Config-declared edges (opaque callback contracts) enter the order
+    graph: they render dashed in DOT and close cycles with discovered
+    edges — so view code acquiring the driver lock would fail the gate."""
+    src = """\
+import threading
+
+class Driver:
+    def __init__(self, view: "View"):
+        self._lock = threading.Lock()
+        self.view = view
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def attach(self, driver: Driver):
+        self.driver = driver
+
+    def poke(self):
+        with self._lock:
+            with self.driver._lock:
+                pass
+"""
+    c = cfg("lock-order-cycle")
+    c.raceguard_assume_edges = [
+        "druid_tpu/m.py::Driver._lock -> druid_tpu/m.py::View._lock"]
+    prog = analyze_sources({"druid_tpu/m.py": src}, c)
+    assert ("druid_tpu/m.py::Driver._lock",
+            "druid_tpu/m.py::View._lock") in prog.order_edges
+    assert "style=dashed" in render_dot(prog)
+    # the discovered View→Driver edge + the assumed Driver→View edge cycle
+    got = [f for f in check_source(src, "druid_tpu/m.py", c)
+           if f.rule == "lock-order-cycle"]
+    assert len(got) == 1 and "cycle" in got[0].message
+
+
+def test_assume_edges_invalidate_program_memo(tmp_path):
+    """REGRESSION (review): analyze_tree memoizes per root — a config with
+    different assume-edges must NOT be served the cached order graph."""
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("import threading\n"
+                              "class C:\n"
+                              "    def __init__(self):\n"
+                              "        self._lock = threading.Lock()\n")
+    c1 = load_config(tmp_path)
+    p1 = analyze_tree(tmp_path, c1)
+    assert p1.order_edges == {}
+    c2 = load_config(tmp_path)
+    c2.raceguard_assume_edges = ["a::X._lock -> b::Y._lock"]
+    p2 = analyze_tree(tmp_path, c2)
+    assert ("a::X._lock", "b::Y._lock") in p2.order_edges
+
+
+def test_render_dot_empty_program():
+    prog = analyze_sources({}, cfg())
+    dot = render_dot(prog)
+    assert dot.startswith("digraph lock_order {") and dot.endswith("}\n")

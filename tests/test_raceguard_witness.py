@@ -1,0 +1,333 @@
+"""Dynamic lock witness: unit tests for the recording machinery, then a
+multi-threaded stress run driving broker fan-out + device-pool eviction +
+metrics ticks CONCURRENTLY with every project lock wrapped — asserting
+
+  (a) no acquisition-order violation was observed (no ABBA ran),
+  (b) every observed acquisition order is an edge of raceguard's STATIC
+      order graph (the analyzer's model covers reality), and
+  (c) no witness-detected unguarded mutation of the watched device-pool
+      counters happened (the guard discipline holds under load).
+
+The witness is installed BEFORE the cluster objects are constructed —
+instance locks are wrapped at construction time; module-level locks
+imported earlier in the session stay raw (the subgraph assertion is over
+whatever was observed, so unwrapped locks only shrink the sample, never
+falsify it)."""
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.druidlint.core import load_config  # noqa: E402
+from tools.druidlint.lockwitness import LockWitness, WitnessLock  # noqa: E402
+from tools.druidlint.raceguard import analyze_tree  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# unit: recording machinery
+# ---------------------------------------------------------------------------
+
+def _wrapped_pair(w):
+    a = WitnessLock(w, threading.Lock(), ("druid_tpu/a.py", 1),
+                    reentrant=False)
+    b = WitnessLock(w, threading.Lock(), ("druid_tpu/b.py", 2),
+                    reentrant=False)
+    return a, b
+
+
+def test_nested_acquisition_records_edge():
+    w = LockWitness(str(REPO_ROOT))
+    a, b = _wrapped_pair(w)
+    with a:
+        with b:
+            pass
+    assert list(w.observed_edges()) == [(a.site, b.site)]
+    assert w.order_violations() == []
+
+
+def test_reentrant_acquisition_records_no_edge():
+    w = LockWitness(str(REPO_ROOT))
+    r = WitnessLock(w, threading.RLock(), ("druid_tpu/a.py", 1),
+                    reentrant=True)
+    with r:
+        with r:
+            pass
+    assert w.observed_edges() == {}
+
+
+def test_abba_is_an_order_violation():
+    w = LockWitness(str(REPO_ROOT))
+    a, b = _wrapped_pair(w)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert w.order_violations() == [(a.site, b.site)]
+
+
+def test_release_out_of_order_keeps_stack_sane():
+    w = LockWitness(str(REPO_ROOT))
+    a, b = _wrapped_pair(w)
+    a.acquire()
+    b.acquire()
+    a.release()                 # hand-over-hand: release in FIFO order
+    with a:                     # b still held → records (b, a)
+        pass
+    b.release()
+    assert (b.site, a.site) in w.observed_edges()
+    assert w._stack() == []
+
+
+def test_condition_on_witnessed_lock_balances_stack():
+    w = LockWitness(str(REPO_ROOT))
+    lock = WitnessLock(w, threading.Lock(), ("druid_tpu/a.py", 1),
+                       reentrant=False)
+    cond = threading.Condition(lock)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hits.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cond:
+        cond.notify()
+    t.join(timeout=5)
+    assert hits == [True]
+    assert w._stack() == []     # this thread's stack drained
+    assert w.order_violations() == []
+
+
+def test_mutation_watch_flags_unlocked_writes():
+    w = LockWitness(str(REPO_ROOT))
+    lock = WitnessLock(w, threading.Lock(), ("druid_tpu/a.py", 1),
+                       reentrant=False)
+
+    class Box:
+        def __init__(self):
+            self.n = 0
+
+    box = Box()
+    w.watch(box, ("n",), lock)
+    with lock:
+        box.n = 1               # disciplined
+    assert w.mutation_violations == []
+    box.n = 2                   # unguarded
+    assert len(w.mutation_violations) == 1
+    w.uninstall()
+    assert type(box).__name__ == "Box"
+
+
+def test_install_uninstall_restores_factories():
+    """uninstall() restores whatever install() displaced — so a per-test
+    witness nested inside a session-wide one (DRUID_TPU_LOCK_WITNESS=1)
+    hands control back to the OUTER witness, not the raw builtin."""
+    prev_lock, prev_rlock = threading.Lock, threading.RLock
+    w = LockWitness(str(REPO_ROOT)).install()
+    try:
+        assert threading.Lock is not prev_lock
+        # constructions OUTSIDE druid_tpu (this test file) stay raw
+        raw = threading.Lock()
+        assert not isinstance(raw, WitnessLock)
+    finally:
+        w.uninstall()
+    assert threading.Lock is prev_lock and threading.RLock is prev_rlock
+
+
+def test_unexplained_edges_subgraph_check():
+    from tools.druidlint.core import LintConfig
+    from tools.druidlint.raceguard import analyze_sources
+    src = """\
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def go(self):
+        with self._lock:
+            self.b.poke()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+"""
+    cfg = LintConfig()
+    cfg.root = "/nonexistent"
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg)
+    w = LockWitness(str(REPO_ROOT))
+    a = WitnessLock(w, threading.Lock(), ("druid_tpu/m.py", 5), False)
+    b = WitnessLock(w, threading.Lock(), ("druid_tpu/m.py", 14), False)
+    with a:                     # A held while taking B: statically predicted
+        with b:
+            pass
+    assert w.unexplained_edges(prog) == []
+    with b:                     # B held while taking A: NOT in the graph
+        with a:
+            pass
+    out = w.unexplained_edges(prog)
+    assert len(out) == 1 and "B._lock -> " in out[0]
+
+
+# ---------------------------------------------------------------------------
+# the stress run (broker fan-out × pool eviction × metric ticks)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stress_run():
+    """Build a witnessed mini-cluster and hammer it from three directions
+    at once; yields (witness, errors, pool, emitter)."""
+    witness = LockWitness(str(REPO_ROOT)).install()
+    try:
+        from druid_tpu.cluster.broker import Broker
+        from druid_tpu.cluster.view import (DataNode, InventoryView,
+                                            descriptor_for)
+        from druid_tpu.data import ColumnSpec, DataGenerator
+        from druid_tpu.data import devicepool as dp_mod
+        from druid_tpu.data.devicepool import (DevicePoolMonitor,
+                                               DeviceSegmentPool)
+        from druid_tpu.engine.batching import BatchMetricsMonitor
+        from druid_tpu.utils.emitter import (InMemoryEmitter,
+                                             MonitorScheduler,
+                                             ServiceEmitter)
+        from druid_tpu.utils.intervals import Interval
+
+        # tiny budget (a couple of staged blocks) → query rounds keep
+        # evicting (the purge/evict churn PRs 2 and 4 fixed races in)
+        pool = DeviceSegmentPool(budget_bytes=1 << 15)
+        old_pool = dp_mod._POOL
+        dp_mod._POOL = pool
+        assert isinstance(pool._lock, WitnessLock)
+        witness.watch(pool, ("_resident", "_hits", "_misses", "_evictions",
+                             "_evicted_bytes", "_budget"), pool._lock)
+
+        gen = DataGenerator((ColumnSpec("d", "string", cardinality=5),
+                             ColumnSpec("m", "long", low=0, high=10)),
+                            seed=7)
+        view = InventoryView()
+        nodes = [DataNode(f"n{i}") for i in range(3)]
+        for n in nodes:
+            view.register(n)
+        for i in range(12):
+            seg = gen.segment(512, Interval.of("2026-07-01", "2026-07-02"),
+                              datasource="x")
+            nodes[i % 3].load_segment(seg)
+            view.announce(nodes[i % 3].name, descriptor_for(seg))
+        broker = Broker(view)
+        emitter = ServiceEmitter("stress", "local", InMemoryEmitter())
+        sched = MonitorScheduler(
+            emitter, [DevicePoolMonitor(pool), BatchMetricsMonitor()],
+            period_seconds=60.0)
+
+        group_q = {"queryType": "groupBy", "dataSource": "x",
+                   "granularity": "all",
+                   "intervals": ["2026-07-01/2026-07-02"],
+                   "dimensions": ["d"],
+                   "aggregations": [{"type": "longSum", "name": "s",
+                                     "fieldName": "m"}]}
+        ts_q = {"queryType": "timeseries", "dataSource": "x",
+                "granularity": "all",
+                "intervals": ["2026-07-01/2026-07-02"],
+                "aggregations": [{"type": "doubleSum", "name": "s",
+                                  "fieldName": "m"}]}
+
+        errors = []
+        stop = threading.Event()
+
+        def fan_out(q, rounds):
+            try:
+                for _ in range(rounds):
+                    broker.run_json(q)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
+        def tick_loop():
+            try:
+                while not stop.is_set():
+                    sched.tick()
+                    view.sync_all()
+                    time.sleep(0.005)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
+        def churn_loop():
+            # segment churn: dropped generations GC while queries run,
+            # driving the finalizer path concurrently with eviction
+            try:
+                while not stop.is_set():
+                    s = gen.segment(512,
+                                    Interval.of("2026-07-01", "2026-07-02"),
+                                    datasource="churn")
+                    s.device_block(["m"])
+                    del s
+                    time.sleep(0.002)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
+        workers = [threading.Thread(target=fan_out, args=(group_q, 6)),
+                   threading.Thread(target=fan_out, args=(group_q, 6)),
+                   threading.Thread(target=fan_out, args=(ts_q, 6)),
+                   threading.Thread(target=fan_out, args=(ts_q, 6)),
+                   threading.Thread(target=tick_loop, daemon=True),
+                   threading.Thread(target=churn_loop, daemon=True)]
+        for t in workers:
+            t.start()
+        for t in workers[:4]:
+            t.join(timeout=300)
+        stop.set()
+        for t in workers[4:]:
+            t.join(timeout=10)
+
+        yield witness, errors, pool, emitter
+        dp_mod._POOL = old_pool
+    finally:
+        witness.uninstall()
+
+
+def test_stress_completes_without_errors(stress_run):
+    witness, errors, pool, emitter = stress_run
+    assert errors == []
+    s = pool.snapshot()
+    assert s.hits + s.misses > 0, "the pool was never exercised"
+    assert s.evictions > 0, "the byte budget never forced eviction"
+
+
+def test_stress_no_order_violation(stress_run):
+    witness, errors, *_ = stress_run
+    assert witness.order_violations() == []
+
+
+def test_stress_observed_orders_are_statically_predicted(stress_run):
+    """Acceptance: the acquisition-order graph OBSERVED under real
+    concurrency is a subgraph of raceguard's static MAY graph."""
+    witness, *_ = stress_run
+    prog = analyze_tree(REPO_ROOT, load_config(REPO_ROOT))
+    assert witness.unexplained_edges(prog) == []
+
+
+def test_stress_no_unguarded_pool_mutation(stress_run):
+    """Every mutation of the watched pool counters happened under the pool
+    lock — the dynamic confirmation of the unguarded-shared-write burn."""
+    witness, *_ = stress_run
+    assert witness.mutation_violations == []
+
+
+def test_stress_emitted_pool_metrics(stress_run):
+    *_, emitter = stress_run
+    names = {e.metric for e in emitter.sink.events}
+    assert "segment/devicePool/residentBytes" in names

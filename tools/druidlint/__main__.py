@@ -41,6 +41,9 @@ def main(argv=None) -> int:
                     help="also report disable pragmas that suppress nothing")
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the mtime-keyed per-file scan cache")
+    ap.add_argument("--dot", action="store_true",
+                    help="print the raceguard lock-order graph as graphviz "
+                         "DOT (cycle members red) and exit")
     args = ap.parse_args(argv)
 
     if args.update_baseline and (args.paths or args.only):
@@ -71,6 +74,10 @@ def main(argv=None) -> int:
             return 2
     if args.report_unused_suppressions:
         config.report_unused_suppressions = True
+    if args.dot:
+        from tools.druidlint.raceguard import analyze_tree, render_dot
+        print(render_dot(analyze_tree(root, config)), end="")
+        return 0
     baseline_path = Path(args.baseline) if args.baseline \
         else root / config.baseline
     cache_path = None if args.no_cache else root / ".druidlint-cache.json"

@@ -78,6 +78,7 @@ def rule(name: str, severity: str, description: str):
 def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import rules as _rules  # noqa: F401 (registration)
     from tools.druidlint import tracecheck as _tracecheck  # noqa: F401
+    from tools.druidlint import raceguard as _raceguard  # noqa: F401
     return dict(_RULES)
 
 
@@ -110,6 +111,20 @@ _DEFAULT_CONFIG = {
     "shard-modules": ["druid_tpu/parallel/distributed.py"],
     # tracecheck: VMEM tile budget in bytes; 0 = contracts.VMEM_BUDGET_BYTES
     "vmem-cap-bytes": 0,
+    # raceguard: the whole-program concurrency-analysis member set — every
+    # module whose locks/threads/shared state enter the shared index
+    "raceguard-modules": ["druid_tpu/*"],
+    # raceguard: thread roots the AST cannot see, as "path-glob::qual-glob"
+    # (e.g. "druid_tpu/*::*.do_monitor" — monitor ticks run on the
+    # MonitorScheduler thread but are dispatched through a list the binder
+    # cannot type)
+    "extra-thread-roots": [],
+    # raceguard: declared order edges ("lockid -> lockid") for acquisition
+    # paths through OPAQUE callbacks the binder cannot enumerate (a
+    # handoff lambda announcing to the view under the driver lock); they
+    # join the static order graph, so they participate in cycle detection
+    # and explain dynamic-witness observations
+    "raceguard-assume-edges": [],
     # unused-suppression audit (CLI --report-unused-suppressions)
     "report-unused-suppressions": False,
 }
@@ -138,6 +153,13 @@ class LintConfig:
     shard_modules: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["shard-modules"]))
     vmem_cap_bytes: int = 0
+    raceguard_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["raceguard-modules"]))
+    extra_thread_roots: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["extra-thread-roots"]))
+    raceguard_assume_edges: List[str] = field(
+        default_factory=lambda: list(
+            _DEFAULT_CONFIG["raceguard-assume-edges"]))
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
     #: (set by load_config/lint_paths, not a pyproject key)
@@ -347,9 +369,17 @@ def collect_files(root: Path, config: LintConfig,
 def _cache_meta_sig(root: Path, config: LintConfig) -> str:
     """Identity of everything findings depend on besides the scanned file:
     the analyzer sources (rules + core + tracecheck), the engine contracts
-    module, and the effective config. Any drift drops the whole cache."""
+    module, the effective config — and the raceguard PROGRAM signature
+    (every member module's mtime/size): raceguard findings in module B can
+    change when module A changes, so any edit inside the program set must
+    drop every per-file cache entry, not just the edited file's."""
     from tools.druidlint.tracecheck import contracts_path  # lazy: no cycle
-    parts = [repr(sorted((k, v) for k, v in vars(config).items()))]
+    from tools.druidlint.raceguard import program_sig  # lazy: no cycle
+    # private attrs are per-run caches (raceguard memoizes its program on
+    # the config), not finding-relevant identity
+    parts = [repr(sorted((k, v) for k, v in vars(config).items()
+                         if not k.startswith("_"))),
+             program_sig(root, config)]
     tool_files = sorted(Path(__file__).parent.glob("*.py"))
     contracts = contracts_path(str(root))
     if contracts is not None:

@@ -1,0 +1,325 @@
+"""lockwitness: a dynamic witness for raceguard's static lock-order graph.
+
+Static analysis is only as good as its model: if raceguard's call-graph
+binder misses an edge, the lock-order-cycle rule silently under-reports
+forever. The witness closes that loop by observing REALITY — it wraps every
+lock the project constructs, records which locks are actually held when
+another is acquired, and asserts the observed order graph is a SUBGRAPH of
+the static one. An observed edge the analyzer did not predict fails the
+witness test: either the binder needs fixing or the code grew an
+acquisition path the model cannot see (both are things we want to know
+before a deadlock ships).
+
+Mechanics:
+  * install() monkeypatches threading.Lock / threading.RLock with factories
+    that inspect the CALLER's frame — only constructions from files under
+    the configured prefixes (default druid_tpu/) are wrapped; jax, stdlib,
+    and test-local locks pass through untouched. The (relpath, lineno) of
+    the construction site is exactly the key raceguard's Program.lock_sites
+    exposes, so runtime locks map onto static identities with no cooperation
+    from the instrumented code.
+  * WitnessLock keeps a per-thread held stack; acquiring L2 with L1 held
+    records the edge (site(L1), site(L2)). Reentrant re-acquisition records
+    nothing (an RLock nested in itself is not an ordering event).
+    Condition-protocol methods (_release_save / _acquire_restore /
+    _is_owned) are implemented so threading.Condition built on a witnessed
+    lock keeps the stack balanced across wait().
+  * watch(obj, attrs, lock) rebinds obj's class to a recording subclass:
+    any write to a watched attribute while `lock` is NOT held by the
+    writing thread is a mutation violation — the dynamic analog of the
+    unguarded-shared-write rule, used by the stress test to prove the
+    guard discipline holds under real concurrency.
+  * order_violations() reports edges observed in BOTH directions (an
+    actual ABBA interleaving happened); unexplained_edges(program) reports
+    observed edges absent from the static MAY graph.
+
+Same-lock-id edges (two INSTANCES of one class nesting) are excluded from
+the static comparison: raceguard's identity is per class, so it cannot
+distinguish instance A→B from B→A — the static self-deadlock check and
+this witness's order_violations() cover that shape instead.
+
+Test-only: nothing in druid_tpu imports this module.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+Site = Tuple[str, int]                    # (repo-relative path, lineno)
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: process-wide session witness (see session_witness)
+_SESSION: Optional["LockWitness"] = None
+
+
+def session_witness(root: Optional[str] = None,
+                    prefixes: Sequence[str] = ("druid_tpu",)
+                    ) -> Optional["LockWitness"]:
+    """Process-wide singleton install. conftest.py may execute TWICE in one
+    process (pytest loads it as `conftest`, while `from tests.conftest
+    import ...` in test modules executes it again as `tests.conftest`) — a
+    second install would shadow the first witness and swallow every
+    recording the reporting hook never sees. This module has exactly one
+    sys.modules entry, so the singleton lives here. First call (with
+    `root`) installs; later calls return the same witness."""
+    global _SESSION
+    if _SESSION is None and root is not None:
+        _SESSION = LockWitness(root, prefixes).install()
+    return _SESSION
+
+
+def end_session_witness() -> Optional["LockWitness"]:
+    """Uninstall and detach the session witness (reporting hook)."""
+    global _SESSION
+    w, _SESSION = _SESSION, None
+    if w is not None:
+        w.uninstall()
+    return w
+
+
+class LockWitness:
+    """Holds observed state for one install()/uninstall() span."""
+
+    def __init__(self, root: str, prefixes: Sequence[str] = ("druid_tpu",)):
+        self.root = os.path.abspath(root)
+        self.prefixes = tuple(prefixes)
+        self._meta = _REAL_LOCK()        # guards the witness's own records
+        self._tls = threading.local()
+        #: observed acquisition-order edges: (site_a, site_b) → count
+        self.edges: Dict[Tuple[Site, Site], int] = {}
+        #: construction counts per site (sanity/visibility)
+        self.constructed: Dict[Site, int] = {}
+        #: mutation-watch violations: (cls, attr, thread, site-ish)
+        self.mutation_violations: List[str] = []
+        self._installed = False
+        self._watched: List[Tuple[object, type]] = []
+        self._prev_factories = None      # what install() displaced
+
+    # ---- interception ---------------------------------------------------
+    def _site_of_caller(self) -> Optional[Site]:
+        f = sys._getframe(2)             # caller of the Lock()/RLock() call
+        path = os.path.abspath(f.f_code.co_filename)
+        if not path.startswith(self.root + os.sep):
+            return None
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        if not any(rel.startswith(p.rstrip("/") + "/") or rel == p
+                   for p in self.prefixes):
+            return None
+        return (rel, f.f_lineno)
+
+    def install(self) -> "LockWitness":
+        if self._installed:
+            return self
+        witness = self
+
+        def make_lock():
+            site = witness._site_of_caller()
+            inner = _REAL_LOCK()
+            if site is None:
+                return inner
+            with witness._meta:
+                witness.constructed[site] = \
+                    witness.constructed.get(site, 0) + 1
+            return WitnessLock(witness, inner, site, reentrant=False)
+
+        def make_rlock():
+            site = witness._site_of_caller()
+            inner = _REAL_RLOCK()
+            if site is None:
+                return inner
+            with witness._meta:
+                witness.constructed[site] = \
+                    witness.constructed.get(site, 0) + 1
+            return WitnessLock(witness, inner, site, reentrant=True)
+
+        # stack-aware: restore whatever was installed BEFORE this witness
+        # (a per-test witness nested inside a session-wide one must not
+        # strip the outer one on uninstall)
+        self._prev_factories = (threading.Lock, threading.RLock)
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Lock, threading.RLock = self._prev_factories
+            self._prev_factories = None
+            self._installed = False
+        for obj, cls in self._watched:
+            obj.__class__ = cls
+        self._watched.clear()
+
+    def __enter__(self) -> "LockWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ---- held-stack bookkeeping ----------------------------------------
+    def _stack(self) -> List["WitnessLock"]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquired(self, lock: "WitnessLock") -> None:
+        stack = self._stack()
+        if not any(h is lock for h in stack):
+            held_sites = []
+            seen: Set[Site] = set()
+            for h in stack:
+                if h.site != lock.site and h.site not in seen:
+                    seen.add(h.site)
+                    held_sites.append(h.site)
+            if held_sites:
+                with self._meta:
+                    for hs in held_sites:
+                        key = (hs, lock.site)
+                        self.edges[key] = self.edges.get(key, 0) + 1
+        stack.append(lock)
+
+    def _on_released(self, lock: "WitnessLock") -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    def held_by_current(self, lock: "WitnessLock") -> bool:
+        return any(h is lock for h in self._stack())
+
+    # ---- mutation watch -------------------------------------------------
+    def watch(self, obj, attrs: Sequence[str], lock: "WitnessLock") -> None:
+        """Record a violation whenever obj.<attr in attrs> is assigned by a
+        thread that does not hold `lock`. Restored by uninstall()."""
+        witness = self
+        watched = frozenset(attrs)
+        base = type(obj)
+
+        class _Watched(base):
+            def __setattr__(self, name, value):
+                if name in watched \
+                        and not witness.held_by_current(lock):
+                    witness.record_mutation_violation(
+                        f"{base.__name__}.{name} assigned without "
+                        f"{lock.site[0]}:{lock.site[1]} held "
+                        f"(thread {threading.current_thread().name})")
+                super().__setattr__(name, value)
+
+        _Watched.__name__ = base.__name__
+        _Watched.__qualname__ = base.__qualname__
+        obj.__class__ = _Watched
+        self._watched.append((obj, base))
+
+    def record_mutation_violation(self, desc: str) -> None:
+        with self._meta:
+            self.mutation_violations.append(desc)
+
+    # ---- reporting ------------------------------------------------------
+    def observed_edges(self) -> Dict[Tuple[Site, Site], int]:
+        with self._meta:
+            return dict(self.edges)
+
+    def order_violations(self) -> List[Tuple[Site, Site]]:
+        """Site pairs observed in BOTH orders — an actual ABBA interleaving
+        ran; with unlucky timing those threads deadlock."""
+        with self._meta:
+            out = []
+            for a, b in self.edges:
+                if (b, a) in self.edges and (a, b) <= (b, a):
+                    out.append((a, b))
+            return sorted(out)
+
+    def unexplained_edges(self, program) -> List[str]:
+        """Observed edges whose BOTH endpoints map to static lock ids but
+        which the static MAY order graph does not contain — raceguard's
+        model missed a real acquisition path. `program` is a
+        raceguard.Program (analyze_tree of the same root)."""
+        sites = program.lock_sites()
+        static = set(program.order_edges)
+        out = []
+        for (sa, sb), count in sorted(self.observed_edges().items()):
+            ia, ib = sites.get(sa), sites.get(sb)
+            if ia is None or ib is None:
+                continue            # lock the static index never saw
+            if ia == ib:
+                continue            # per-class identity: instances collapse
+            if (ia, ib) not in static:
+                out.append(f"{ia} -> {ib} (observed {count}x at "
+                           f"{sa[0]}:{sa[1]} -> {sb[0]}:{sb[1]}, "
+                           f"not in the static order graph)")
+        return out
+
+
+class WitnessLock:
+    """A recording wrapper around one project lock. Not a subclass: the
+    real lock types are C objects; delegation plus the Condition protocol
+    methods below cover every way the project uses them."""
+
+    __slots__ = ("_witness", "_inner", "site", "reentrant")
+
+    def __init__(self, witness: LockWitness, inner, site: Site,
+                 reentrant: bool):
+        self._witness = witness
+        self._inner = inner
+        self.site = site
+        self.reentrant = reentrant
+
+    # -- core lock protocol --
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._on_acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._witness._on_released(self)
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else self._inner._is_owned()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # -- Condition protocol (threading.Condition(witnessed_lock)) --
+    def _release_save(self):
+        if hasattr(self._inner, "_release_save"):
+            state = self._inner._release_save()
+        else:
+            self._inner.release()
+            state = None
+        # wait() dropped the lock entirely: clear every stack entry
+        stack = self._witness._stack()
+        n = sum(1 for h in stack if h is self)
+        for _ in range(n):
+            self._witness._on_released(self)
+        return (state, n)
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        for _ in range(max(n, 1)):
+            self._witness._on_acquired(self)
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return self._witness.held_by_current(self)
+
+    def __repr__(self):
+        return (f"<WitnessLock {self.site[0]}:{self.site[1]} "
+                f"{'r' if self.reentrant else ''}lock>")

@@ -1,0 +1,1734 @@
+"""raceguard: whole-program lock / thread-root concurrency analysis.
+
+The existing druidlint rules are strictly per-module; concurrency bugs are
+not. A broker pool thread racing a duty loop into an unlocked dict lives in
+the SPACE BETWEEN modules: the write is innocent where it stands — it is
+only wrong because some other file spawned a thread that can reach it. So
+raceguard builds one program-level index over every module matching config
+`raceguard-modules` (default: all of druid_tpu/) and derives:
+
+  * lock objects — `self._lock = threading.Lock()` instance locks (identity:
+    class + attribute, one id per class, NOT per instance), module-level
+    locks, and `threading.Condition(self._lock)` aliases (a condition built
+    on a lock IS that lock);
+  * guarded state — attributes/globals written while a lock is held;
+  * thread roots — Thread(target=...)/Timer, executor .submit/.map,
+    weakref.finalize callbacks, BaseHTTPRequestHandler do_* methods, plus
+    config `extra-thread-roots` patterns ("druid_tpu/*::*.do_monitor" marks
+    every monitor tick a root);
+  * a call graph with a light type binder — constructor calls
+    (`self._pool = DevicePool(...)`), annotated parameters (inherited from
+    overridden base methods), `Dict[K, V]` element annotations, return
+    annotations, `outer = self` closures (the nested HTTP-handler idiom),
+    @property loads, callable instances (`self.clock()` →
+    `ManualClock.__call__`), constructor ARGUMENTS typing the attributes
+    the params land in, dynamic dispatch to subclass overrides, and lambda
+    callbacks invoked by their receiver (`critical_section(id, lambda:
+    metadata.publish(...))` runs the publish under the box lock). Config
+    `raceguard-assume-edges` declares order edges for contracts even that
+    cannot see (opaque handoff callbacks); declared edges join the cycle
+    check. Two lock-set dataflows run over the graph:
+      - MUST-held (intersection over call sites): precision for the guard
+        rules — `_evict_to` called only under the lock is correctly treated
+        as locked;
+      - MAY-held (union): completeness for the lock-order graph — the
+        dynamic witness (tools/druidlint/lockwitness.py) asserts every
+        acquisition order OBSERVED at runtime is an edge this graph
+        predicted, so MAY must over-approximate.
+
+Four rules ride the shared druidlint registry/baseline/suppression/cache
+machinery (suppress with `# druidlint: disable=<rule>  # <rationale>` on
+the flagged line):
+
+  unguarded-shared-write  an attribute written both under a lock and
+                          outside it, or written from ≥2 concurrent thread
+                          roots with no common lock;
+  lock-order-cycle        a cycle in the static lock-acquisition-order
+                          graph (ABBA deadlock potential), plus same-lock
+                          self-deadlock through a self-call chain on a
+                          non-reentrant Lock;
+  lock-in-traced          a lock acquired inside jitted/shard_map/pallas
+                          code — trace-time it runs once (a silent no-op as
+                          a guard), and a captured lock in a compiled
+                          callable deadlocks under re-entry;
+  guard-consistency       a read of a consistently-guarded attribute on a
+                          thread-root path without its lock.
+
+Whole-program soundness vs the per-file mtime cache: a change in module A
+can change findings in module B, so core._cache_meta_sig folds program_sig()
+(every raceguard module's mtime/size) into the cache identity — any edit
+under druid_tpu/ drops the whole cache rather than serving stale
+cross-module findings.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from dataclasses import dataclass, field
+from pathlib import Path
+from types import SimpleNamespace
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.druidlint.core import Finding, LintConfig, ModuleContext, rule
+from tools.druidlint.rules import (_FUNC_DEFS, _decorator_names, _dotted,
+                                   _is_lockish, _terminal)
+
+# ---------------------------------------------------------------------------
+# Identities
+# ---------------------------------------------------------------------------
+# lock id:   "path::Class._lock" or "path::NAME" (module-level)
+# state id:  ("attr", "path::Class", attr) | ("global", path, name)
+# func id:   "path::Qual.name" (Qual includes nesting: "f.<locals>.Handler")
+
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock"}
+HANDLER_BASES = {"BaseHTTPRequestHandler", "StreamRequestHandler",
+                 "BaseRequestHandler"}
+#: methods that construction-phase writes are exempt in — nothing else can
+#: hold a reference to the instance yet
+INIT_METHODS = {"__init__", "__new__", "__post_init__", "__set_name__"}
+#: in-place mutations of a container attribute count as writes to it
+MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
+            "pop", "popitem", "popleft", "remove", "discard", "clear",
+            "setdefault", "move_to_end", "sort", "reverse",
+            "__setitem__", "__delitem__"}
+#: root kinds that imply concurrent instances of the SAME root (a pool
+#: worker races its siblings; an HTTP handler races other requests)
+CONCURRENT_KINDS = {"submit", "map", "handler", "extra"}
+
+UNKNOWN_LOCK = "?unknown-lock?"
+
+
+@dataclass(frozen=True)
+class Site:
+    path: str
+    line: int
+    col: int
+
+
+@dataclass
+class LockDef:
+    lock_id: str
+    kind: str                         # "lock" | "rlock" | "condition"
+    site: Site                        # construction call site
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "rlock"
+
+
+@dataclass
+class FuncInfo:
+    func_id: str
+    path: str
+    name: str
+    qual: str                         # dotted qualname within module
+    node: ast.AST = None
+    class_key: Optional[str] = None   # "path::Class" of owning class
+    #: events, each with the LOCAL with-held set at that point
+    acquires: List[Tuple[str, Tuple[str, ...], Site, bool]] = \
+        field(default_factory=list)   # (lock, held, site, via with-stmt)
+    calls: List[Tuple[str, Tuple[str, ...], Site, bool]] = \
+        field(default_factory=list)   # (callee, held, site, receiver=self)
+    writes: List[Tuple[Tuple, Tuple[str, ...], Site]] = \
+        field(default_factory=list)   # (state, held, site)
+    reads: List[Tuple[Tuple, Tuple[str, ...], Site]] = \
+        field(default_factory=list)
+    #: cached own-statement list (several passes re-traverse it)
+    own: Optional[List[ast.AST]] = None
+
+
+@dataclass
+class ClassInfo:
+    class_key: str                    # "path::Qual"
+    path: str
+    qual: str
+    bases: List[ast.expr] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)   # name → func_id
+    locks: Dict[str, LockDef] = field(default_factory=dict)  # attr → lock
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr → class
+    #: container attrs (`self._nodes: Dict[str, DataNode]`) → element class
+    elem_types: Dict[str, str] = field(default_factory=dict)
+    properties: Set[str] = field(default_factory=set)
+    is_handler: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    tree: ast.AST
+    #: import binding: local name → ("module", path) | ("symbol", path, name)
+    imports: Dict[str, Tuple] = field(default_factory=dict)
+    #: module-level name → ("class", key) | ("func", id) | ("lock", id)
+    #:                   | ("instance", class_key) | ("var",)
+    globals: Dict[str, Tuple] = field(default_factory=dict)
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+
+
+@dataclass
+class Program:
+    modules: Dict[str, ModuleInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+    sources: Dict[str, str] = field(default_factory=dict)
+    roots: Dict[str, str] = field(default_factory=dict)      # func_id → kind
+    #: program functions that escape as plain callable values (entry lock
+    #: context unknowable, assumed empty)
+    escaped: Set[str] = field(default_factory=set)
+    #: dataflow results
+    must_held: Dict[str, Optional[Set[str]]] = field(default_factory=dict)
+    may_held: Dict[str, Set[str]] = field(default_factory=dict)
+    roots_of: Dict[str, Set[str]] = field(default_factory=dict)
+    #: lock-order graph: (a, b) → representative Site (a held while b taken)
+    order_edges: Dict[Tuple[str, str], Site] = field(default_factory=dict)
+    #: precomputed findings: rule → path → [(line, col, message)]
+    findings: Dict[str, Dict[str, List[Tuple[int, int, str]]]] = \
+        field(default_factory=dict)
+    #: memoized per-function local binding frames (built once, used by the
+    #: event walk AND root discovery)
+    frames: Dict[str, Dict[str, Tuple]] = field(default_factory=dict)
+    #: class → direct program subclasses (dynamic-dispatch over-approx)
+    subclasses: Dict[str, Set[str]] = field(default_factory=dict)
+    #: memo: method func_id → [func_id of it + every subclass override]
+    _dispatch: Dict[str, List[str]] = field(default_factory=dict)
+    #: queued higher-order edges: (callee, lambda-body callee, site)
+    _pending_callbacks: List[Tuple[str, str, Site]] = \
+        field(default_factory=list)
+
+    def lock_sites(self) -> Dict[Tuple[str, int], str]:
+        """(path, lineno) of every lock construction → lock id; the dynamic
+        witness maps runtime locks back to static identity through this."""
+        return {(l.site.path, l.site.line): l.lock_id
+                for l in self.locks.values()}
+
+
+# ---------------------------------------------------------------------------
+# Module collection
+# ---------------------------------------------------------------------------
+
+def _pattern_prefix(pat: str) -> str:
+    """Literal directory prefix of a glob pattern ('druid_tpu/*' →
+    'druid_tpu') — walking only these keeps the scan off .git and friends."""
+    lead = []
+    for part in pat.split("/")[:-1]:
+        if any(c in part for c in "*?["):
+            break
+        lead.append(part)
+    return "/".join(lead)
+
+
+def _raceguard_paths(root: Path, config: LintConfig) -> List[Path]:
+    pats = config.raceguard_modules
+    scan_roots = {(_pattern_prefix(p) or ".") for p in pats}
+    seen: Set[Path] = set()
+    out: List[Path] = []
+    for sr in sorted(scan_roots):
+        base = root / sr if sr != "." else root
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = p.relative_to(root).as_posix()
+            if "__pycache__" in rel or p in seen:
+                continue
+            if any(fnmatch.fnmatch(rel, pat) or rel == pat for pat in pats):
+                seen.add(p)
+                out.append(p)
+    return sorted(out)
+
+
+def program_sig(root: Path, config: LintConfig) -> str:
+    """Identity of the whole analyzed program: any member file changing
+    must invalidate every module's cached raceguard findings."""
+    parts = []
+    for p in _raceguard_paths(root, config):
+        try:
+            st = p.stat()
+            parts.append(f"{p.relative_to(root).as_posix()}:"
+                         f"{st.st_mtime_ns}:{st.st_size}")
+        except OSError:
+            parts.append(f"{p}:gone")
+    return "|".join(parts)
+
+
+_PROGRAM_CACHE: Dict[str, Tuple[str, Program]] = {}
+
+
+def analyze_tree(root, config: LintConfig) -> Program:
+    """Analyze the on-disk program under `root` (memoized on program_sig)."""
+    root = Path(root).resolve()
+    key = str(root)
+    sig = program_sig(root, config) + "|" + repr(
+        (sorted(config.raceguard_modules),
+         sorted(config.extra_thread_roots),
+         sorted(config.raceguard_assume_edges)))
+    hit = _PROGRAM_CACHE.get(key)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    sources = {}
+    for p in _raceguard_paths(root, config):
+        try:
+            sources[p.relative_to(root).as_posix()] = p.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+    prog = analyze_sources(sources, config)
+    _PROGRAM_CACHE[key] = (sig, prog)
+    return prog
+
+
+def analyze_sources(sources: Dict[str, str], config: LintConfig) -> Program:
+    prog = Program(sources=dict(sources))
+    for path, src in sorted(sources.items()):
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue                  # core reports syntax errors itself
+        _collect_module(prog, path, tree)
+    _bind_and_walk(prog, config)
+    _find_roots(prog, config)
+    _dataflow(prog)
+    _order_graph(prog, config)
+    _compute_findings(prog, config)
+    return prog
+
+
+# ---- pass 1: declarations -------------------------------------------------
+
+def _module_path_of(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+def _module_lookup(prog: Program, dotted_path: str) -> Optional[str]:
+    """Program path for a module reference — plain module or package
+    __init__ ('druid_tpu/native.py' → 'druid_tpu/native/__init__.py')."""
+    if dotted_path in prog.modules:
+        return dotted_path
+    pkg = dotted_path[:-3] + "/__init__.py"
+    return pkg if pkg in prog.modules else None
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    return LOCK_CTORS.get(_terminal(call.func))
+
+
+def _collect_module(prog: Program, path: str, tree: ast.AST) -> None:
+    mod = ModuleInfo(path=path, tree=tree)
+    prog.modules[path] = mod
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                mod.imports[name] = ("module", _module_path_of(target))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module:
+                continue
+            src = _module_path_of(node.module)
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name] = \
+                    ("symbol", src, alias.name)
+
+    def visit(body, qual_prefix, class_key):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                qual = f"{qual_prefix}{node.name}"
+                ck = f"{path}::{qual}"
+                ci = ClassInfo(class_key=ck, path=path, qual=qual,
+                               bases=list(node.bases))
+                ci.is_handler = any(_terminal(b) in HANDLER_BASES
+                                    for b in node.bases)
+                prog.classes[ck] = ci
+                if class_key is None and not qual_prefix.count("<locals>"):
+                    mod.globals.setdefault(node.name, ("class", ck))
+                visit(node.body, f"{qual}.", ck)
+            elif isinstance(node, _FUNC_DEFS):
+                qual = f"{qual_prefix}{node.name}"
+                fid = f"{path}::{qual}"
+                fi = FuncInfo(func_id=fid, path=path, name=node.name,
+                              qual=qual, node=node, class_key=class_key)
+                prog.funcs[fid] = fi
+                if class_key is not None:
+                    ci = prog.classes[class_key]
+                    ci.methods[node.name] = fid
+                    if _decorator_names(node) & {"property",
+                                                 "cached_property"}:
+                        ci.properties.add(node.name)
+                if class_key is None and qual_prefix == "":
+                    mod.globals.setdefault(node.name, ("func", fid))
+                visit(node.body, f"{qual}.<locals>.", None)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                kind = _lock_ctor_kind(node.value)
+                if isinstance(t, ast.Name) and class_key is None \
+                        and qual_prefix == "":
+                    if kind is not None:
+                        lid = f"{path}::{t.id}"
+                        ld = LockDef(lid, kind,
+                                     Site(path, node.value.lineno,
+                                          node.value.col_offset))
+                        mod.locks[t.id] = ld
+                        prog.locks[lid] = ld
+                        mod.globals[t.id] = ("lock", lid)
+                    else:
+                        mod.globals.setdefault(t.id, ("var",))
+
+    visit(tree.body, "", None)
+
+    # instance lock attrs + condition aliases: any `self.X = Lock()` inside
+    # a method (scan after classes exist so nesting order doesn't matter)
+    for ck, ci in list(prog.classes.items()):
+        if ci.path != path:
+            continue
+        for mname, fid in ci.methods.items():
+            fi = prog.funcs[fid]
+            self_name = _self_param(fi.node)
+            if self_name is None:
+                continue
+            for node in _own(fi):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == self_name):
+                    continue
+                kind = _lock_ctor_kind(node.value)
+                if kind is not None:
+                    lid = f"{ck}.{t.attr}"
+                    ci.locks.setdefault(
+                        t.attr, LockDef(lid, kind,
+                                        Site(path, node.value.lineno,
+                                             node.value.col_offset)))
+                    prog.locks.setdefault(lid, ci.locks[t.attr])
+                elif isinstance(node.value, ast.Call) \
+                        and _terminal(node.value.func) == "Condition":
+                    args = node.value.args
+                    if args and isinstance(args[0], ast.Attribute) \
+                            and isinstance(args[0].value, ast.Name) \
+                            and args[0].value.id == self_name \
+                            and args[0].attr in ci.locks:
+                        # Condition(self._lock) IS self._lock
+                        ci.locks[t.attr] = ci.locks[args[0].attr]
+                    else:
+                        lid = f"{ck}.{t.attr}"
+                        ci.locks.setdefault(
+                            t.attr,
+                            LockDef(lid, "condition",
+                                    Site(path, node.value.lineno,
+                                         node.value.col_offset)))
+                        prog.locks.setdefault(lid, ci.locks[t.attr])
+
+
+def _self_param(fn: ast.AST) -> Optional[str]:
+    args = fn.args
+    if "staticmethod" in _decorator_names(fn):
+        return None
+    if args.args:
+        return args.args[0].arg
+    return None
+
+
+def _own_nodes(fn: ast.AST):
+    """fn's own statements/expressions, excluding nested def/class BODIES
+    (those are separate FuncInfos / ClassInfos with their own scopes); the
+    def/class statement itself is yielded so bindings can see it."""
+    stack = list(_body_of(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_DEFS + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own(fi: FuncInfo) -> List[ast.AST]:
+    if fi.own is None:
+        fi.own = list(_own_nodes(fi.node))
+    return fi.own
+
+
+# ---- pass 2: binder + per-function events ---------------------------------
+
+class _Scope:
+    """Lexical scope chain for value resolution: function-local single
+    assignments, enclosing functions (closures), then module globals."""
+
+    def __init__(self, mod: ModuleInfo, frames: List[Dict[str, Tuple]]):
+        self.mod = mod
+        self.frames = frames          # innermost last
+
+    def lookup(self, name: str) -> Optional[Tuple]:
+        for frame in reversed(self.frames):
+            if name in frame:
+                return frame[name]
+        g = self.mod.globals.get(name)
+        if g is not None:
+            return g
+        imp = self.mod.imports.get(name)
+        if imp is not None:
+            return ("import",) + imp
+        return None
+
+
+def _bind_and_walk(prog: Program, config: LintConfig) -> None:
+    for path, mod in prog.modules.items():
+        # module-level instance bindings: NAME = Class(...)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                ck = _resolve_class(prog, mod, _Scope(mod, []),
+                                    node.value.func)
+                if ck is not None:
+                    mod.globals[node.targets[0].id] = ("instance", ck)
+    # class attribute types: `self.X = Class(...)` / `self.X = param` with
+    # an annotated param / `self.X = param or Class(...)`
+    for ck, ci in prog.classes.items():
+        mod = prog.modules[ci.path]
+        for fid in ci.methods.values():
+            fi = prog.funcs[fid]
+            self_name = _self_param(fi.node)
+            if self_name is None:
+                continue
+            frame = _param_bindings(prog, mod, fi)
+            scope = _Scope(mod, [frame])
+            for node in _own(fi):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Attribute) \
+                        and isinstance(node.targets[0].value, ast.Name) \
+                        and node.targets[0].value.id == self_name:
+                    got = _resolve_value(prog, mod, scope, node.value)
+                    if got is not None and got[0] == "instance":
+                        ci.attr_types.setdefault(node.targets[0].attr,
+                                                 got[1])
+                elif isinstance(node, ast.AnnAssign) \
+                        and isinstance(node.target, ast.Attribute) \
+                        and isinstance(node.target.value, ast.Name) \
+                        and node.target.value.id == self_name:
+                    # `self._nodes: Dict[str, DataNode] = {}` — element
+                    # type flows to .get()/.setdefault()/indexing results
+                    tck = _resolve_annotation(prog, mod, scope,
+                                              node.annotation)
+                    if tck is not None:
+                        ci.attr_types.setdefault(node.target.attr, tck)
+                    else:
+                        eck = _elem_annotation(prog, mod, scope,
+                                               node.annotation)
+                        if eck is not None:
+                            ci.elem_types.setdefault(node.target.attr, eck)
+    _build_subclass_map(prog)
+    _ctor_param_attr_pass(prog)
+    # per-function event walks
+    for fid, fi in prog.funcs.items():
+        _walk_function(prog, fi)
+    # higher-order hops: the callback may run under any lock its receiver
+    # acquires internally
+    for callee, inner, site in prog._pending_callbacks:
+        tfi = prog.funcs.get(callee)
+        if tfi is None:
+            continue
+        held = tuple(sorted({l for l, _h, _s, _w in tfi.acquires
+                             if l != UNKNOWN_LOCK}))
+        tfi.calls.append((inner, held, site, False))
+
+
+def _ctor_param_attr_pass(prog: Program) -> None:
+    """Type constructor-stored params from their CALL SITES: `self.clock =
+    clock or (...)` in LeaderParticipant.__init__ plus a program call
+    `LeaderParticipant(..., clock=self.clock)` where the argument resolves
+    to a ManualClock types `LeaderParticipant.clock` — closing the
+    callable-attribute gap annotations alone cannot (the param is just
+    `clock: Optional[Callable]`)."""
+    # per class: __init__ param name → attrs assigned from it
+    param_attrs: Dict[str, Dict[str, List[str]]] = {}
+    for ck, ci in prog.classes.items():
+        init = ci.methods.get("__init__")
+        if init is None:
+            continue
+        fi = prog.funcs[init]
+        self_name = _self_param(fi.node)
+        if self_name is None:
+            continue
+        pmap: Dict[str, List[str]] = {}
+        for node in _own(fi):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == self_name:
+                v = node.value
+                if isinstance(v, ast.BoolOp):
+                    v = v.values[0]       # `param or default`
+                if isinstance(v, ast.Name):
+                    pmap.setdefault(v.id, []).append(node.targets[0].attr)
+        if pmap:
+            param_attrs[ck] = pmap
+    for fid in sorted(prog.funcs):
+        fi = prog.funcs[fid]
+        mod = prog.modules[fi.path]
+        scope = _Scope(mod, [_param_bindings(prog, mod, fi)])
+        for node in _own(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            got = _resolve_value(prog, mod, scope, node.func)
+            if got is None or got[0] != "class":
+                continue
+            ci = prog.classes.get(got[1])
+            pmap = param_attrs.get(got[1])
+            if ci is None or not pmap or "__init__" not in ci.methods:
+                continue
+            init_fi = prog.funcs[ci.methods["__init__"]]
+            params = [a.arg for a in init_fi.node.args.args][1:]
+            bound: Dict[str, ast.AST] = {}
+            for i, a in enumerate(node.args):
+                if i < len(params):
+                    bound[params[i]] = a
+            for kw in node.keywords:
+                if kw.arg:
+                    bound[kw.arg] = kw.value
+            for pname, expr in bound.items():
+                attrs = pmap.get(pname)
+                if not attrs:
+                    continue
+                v = _resolve_value(prog, mod, scope, expr)
+                if v is not None and v[0] == "instance":
+                    for attr in attrs:
+                        ci.attr_types.setdefault(attr, v[1])
+
+
+def _resolve_import(prog: Program, binding: Tuple,
+                    _depth: int = 0) -> Optional[Tuple]:
+    """('import', 'module'|'symbol', ...) → program binding or None."""
+    if binding[1] == "module":
+        path = _module_lookup(prog, binding[2])
+        return ("module", path) if path is not None else None
+    _, _, src, name = binding
+    src_path = _module_lookup(prog, src)
+    target = prog.modules.get(src_path) if src_path is not None else None
+    if target is not None:
+        got = target.globals.get(name)
+        if got is not None:
+            return got
+        imp = target.imports.get(name)      # re-export chain, bounded
+        if imp is not None and imp[0] == "symbol" and _depth < 8:
+            got = _resolve_import(prog, ("import",) + imp, _depth + 1)
+            if got is not None:
+                return got
+    # `from pkg import mod` / `from pkg.mod import name` where the name is
+    # itself a submodule (the package __init__ need not mention it)
+    sub = _module_lookup(prog, src[:-3] + "/" + name + ".py")
+    return ("module", sub) if sub is not None else None
+
+
+def _resolve_value(prog: Program, mod: ModuleInfo, scope: _Scope,
+                   expr: ast.AST) -> Optional[Tuple]:
+    """Resolve an expression to ('instance', class_key) | ('class', ck) |
+    ('func', fid) | ('module', path) | ('lock', lid) | ('var',) | None."""
+    if isinstance(expr, ast.Name):
+        b = scope.lookup(expr.id)
+        if b is None:
+            return None
+        if b[0] == "import":
+            return _resolve_import(prog, b)
+        return b
+    if isinstance(expr, ast.Attribute):
+        base = _resolve_value(prog, mod, scope, expr.value)
+        if base is None:
+            return None
+        if base[0] == "module":
+            target = prog.modules.get(base[1])
+            if target is None:
+                return None
+            got = target.globals.get(expr.attr)
+            if got is not None:
+                return got
+            imp = target.imports.get(expr.attr)
+            if imp is not None:
+                return _resolve_import(prog, ("import",) + imp)
+            return None
+        if base[0] == "instance":
+            ci = _class_with(prog, base[1], expr.attr)
+            if ci is None:
+                return None
+            if expr.attr in ci.locks:
+                return ("lock", ci.locks[expr.attr].lock_id)
+            if expr.attr in ci.attr_types:
+                return ("instance", ci.attr_types[expr.attr])
+            if expr.attr in ci.elem_types:
+                return ("container", ci.elem_types[expr.attr])
+            if expr.attr in ci.properties:
+                # a property ACCESS is a call, not a callable value: the
+                # expression's type is the property's return annotation
+                pnode = prog.funcs[ci.methods[expr.attr]].node
+                if getattr(pnode, "returns", None) is not None:
+                    mod2 = prog.modules[ci.path]
+                    ck = _resolve_annotation(prog, mod2, _Scope(mod2, []),
+                                             pnode.returns)
+                    if ck is not None:
+                        return ("instance", ck)
+                return None
+            if expr.attr in ci.methods:
+                return ("func", ci.methods[expr.attr])
+            return None
+        if base[0] == "class":
+            ci = _class_with(prog, base[1], expr.attr)
+            if ci is not None and expr.attr in ci.methods:
+                return ("func", ci.methods[expr.attr])
+            return None
+        return None
+    if isinstance(expr, ast.Subscript):
+        base = _resolve_value(prog, mod, scope, expr.value)
+        if base is not None and base[0] == "container":
+            return ("instance", base[1])
+        return None
+    if isinstance(expr, ast.Call):
+        # container getters hand back the element: self._nodes.get(name)
+        if isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in _CONTAINER_GETTERS:
+            base = _resolve_value(prog, mod, scope, expr.func.value)
+            if base is not None and base[0] == "container":
+                return ("instance", base[1])
+        fn = _resolve_value(prog, mod, scope, expr.func)
+        if fn is not None and fn[0] == "class":
+            return ("instance", fn[1])
+        if fn is not None and fn[0] == "func":
+            fi = prog.funcs.get(fn[1])
+            if fi is not None and getattr(fi.node, "returns", None) is not None:
+                # the annotation's names live in the FUNCTION'S module
+                fmod = prog.modules[fi.path]
+                ck = _resolve_annotation(prog, fmod, _Scope(fmod, []),
+                                         fi.node.returns)
+                if ck is not None:
+                    return ("instance", ck)
+        return None
+    if isinstance(expr, ast.BoolOp):
+        # `cache_config or CacheConfig()`: any resolvable operand types it
+        for op in reversed(expr.values):
+            got = _resolve_value(prog, mod, scope, op)
+            if got is not None:
+                return got
+        return None
+    if isinstance(expr, ast.IfExp):
+        return _resolve_value(prog, mod, scope, expr.body) \
+            or _resolve_value(prog, mod, scope, expr.orelse)
+    return None
+
+
+_CONTAINER_HEADS = {"Dict", "dict", "List", "list", "Set", "set",
+                    "Sequence", "Iterable", "Tuple", "tuple", "Deque",
+                    "deque", "OrderedDict", "DefaultDict", "defaultdict",
+                    "Mapping", "MutableMapping"}
+_CONTAINER_GETTERS = {"get", "setdefault", "pop", "popleft", "popitem"}
+
+
+def _elem_annotation(prog: Program, mod: ModuleInfo, scope: _Scope,
+                     ann: ast.AST) -> Optional[str]:
+    """Element class of a container annotation: Dict[K, V] → V,
+    List[V] → V (the type of what indexing/get/setdefault hands back)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        head = _terminal(ann.value)
+        if head == "Optional":
+            return _elem_annotation(prog, mod, scope, ann.slice)
+        if head not in _CONTAINER_HEADS:
+            return None
+        inner = ann.slice
+        if isinstance(inner, ast.Tuple) and inner.elts:
+            inner = inner.elts[-1]        # Dict[K, V] → V
+        return _resolve_annotation(prog, mod, scope, inner)
+    return None
+
+
+def _resolve_annotation(prog: Program, mod: ModuleInfo, scope: _Scope,
+                        ann: ast.AST) -> Optional[str]:
+    """A type annotation resolved to a program class key (handles
+    Optional[X]/List[X] one level and "quoted" forward references)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        got = scope.lookup(ann.value)
+        if got is not None and got[0] == "import":
+            got = _resolve_import(prog, got)
+        return got[1] if got is not None and got[0] == "class" else None
+    if isinstance(ann, ast.Subscript):
+        if _terminal(ann.value) in ("Optional", "List", "Sequence", "Dict",
+                                    "Tuple", "Set", "Iterable"):
+            inner = ann.slice
+            if _terminal(ann.value) != "Optional":
+                return None       # container ELEMENT types are not the value
+            return _resolve_annotation(prog, mod, scope, inner)
+        return None
+    got = _resolve_value(prog, mod, scope, ann)
+    return got[1] if got is not None and got[0] == "class" else None
+
+
+def _resolve_class(prog: Program, mod: ModuleInfo, scope: _Scope,
+                   expr: ast.AST) -> Optional[str]:
+    got = _resolve_value(prog, mod, scope, expr)
+    return got[1] if got is not None and got[0] == "class" else None
+
+
+def _build_subclass_map(prog: Program) -> None:
+    for ck, ci in prog.classes.items():
+        mod = prog.modules[ci.path]
+        scope = _Scope(mod, [])
+        for b in ci.bases:
+            bck = _resolve_class(prog, mod, scope, b)
+            if bck is not None:
+                prog.subclasses.setdefault(bck, set()).add(ck)
+
+
+def _dispatch_targets(prog: Program, fid: str) -> List[str]:
+    """A call resolved to a method may dynamically dispatch to any program
+    subclass override — `store: LeaseStore` receiving a MetadataLeaseStore
+    must contribute the override's acquisitions to the MAY order graph.
+    Returns [fid] plus every transitive-subclass override (memoized)."""
+    got = prog._dispatch.get(fid)
+    if got is not None:
+        return got
+    out = [fid]
+    fi = prog.funcs.get(fid)
+    if fi is not None and fi.class_key is not None:
+        seen: Set[str] = set()
+        stack = list(prog.subclasses.get(fi.class_key, ()))
+        while stack:
+            ck = stack.pop()
+            if ck in seen:
+                continue
+            seen.add(ck)
+            sub = prog.classes.get(ck)
+            if sub is not None:
+                override = sub.methods.get(fi.name)
+                if override is not None and override != fid:
+                    out.append(override)
+            stack.extend(prog.subclasses.get(ck, ()))
+    prog._dispatch[fid] = out
+    return out
+
+
+def _base_method_fid(prog: Program, class_key: str, name: str,
+                     _depth: int = 0) -> Optional[str]:
+    """func_id of the nearest BASE-class definition of `name`."""
+    ci = prog.classes.get(class_key)
+    if ci is None or _depth > 4:
+        return None
+    mod = prog.modules[ci.path]
+    for b in ci.bases:
+        bck = _resolve_class(prog, mod, _Scope(mod, []), b)
+        if bck is None:
+            continue
+        bci = prog.classes.get(bck)
+        if bci is not None and name in bci.methods:
+            return bci.methods[name]
+        got = _base_method_fid(prog, bck, name, _depth + 1)
+        if got is not None:
+            return got
+    return None
+
+
+def _class_with(prog: Program, class_key: str, attr: str,
+                _depth: int = 0) -> Optional[ClassInfo]:
+    """The class (or base class, resolved through the program) that defines
+    `attr` as a lock / typed attribute / method."""
+    ci = prog.classes.get(class_key)
+    if ci is None or _depth > 4:
+        return None
+    if attr in ci.locks or attr in ci.attr_types or attr in ci.methods \
+            or attr in ci.elem_types:
+        return ci
+    mod = prog.modules[ci.path]
+    for b in ci.bases:
+        bck = _resolve_class(prog, mod, _Scope(mod, []), b)
+        if bck is not None:
+            got = _class_with(prog, bck, attr, _depth + 1)
+            if got is not None:
+                return got
+    return None
+
+
+def _param_bindings(prog: Program, mod: ModuleInfo,
+                    fi: FuncInfo) -> Dict[str, Tuple]:
+    """self + annotated parameters: `def __init__(self, node: DataNode)`
+    binds `node` to a DataNode instance. An override that drops the base's
+    annotations inherits them by parameter name (the Monitor.do_monitor
+    pattern: the base declares `emitter: ServiceEmitter`, overrides
+    don't)."""
+    frame: Dict[str, Tuple] = {}
+    self_name = _self_param(fi.node) if fi.class_key else None
+    if self_name is not None:
+        frame[self_name] = ("instance", fi.class_key)
+
+    def bind_from(fn, ann_mod: ModuleInfo):
+        scope = _Scope(ann_mod, [])
+        args = fn.args
+        for a in list(args.args) + list(args.kwonlyargs) + \
+                list(getattr(args, "posonlyargs", ())):
+            if a.arg in frame or a.annotation is None:
+                continue
+            ck = _resolve_annotation(prog, ann_mod, scope, a.annotation)
+            if ck is not None:
+                frame[a.arg] = ("instance", ck)
+
+    bind_from(fi.node, mod)
+    if fi.class_key is not None:
+        base_fid = _base_method_fid(prog, fi.class_key, fi.name)
+        if base_fid is not None and base_fid != fi.func_id:
+            base_fi = prog.funcs[base_fid]
+            # base annotations resolve in the BASE's module (its imports)
+            bind_from(base_fi.node, prog.modules[base_fi.path])
+    return frame
+
+
+def _local_frame(prog: Program, mod: ModuleInfo, fi: FuncInfo,
+                 outer_frames: List[Dict[str, Tuple]]) -> Dict[str, Tuple]:
+    """Single-assignment local bindings inside one function: `x = self`,
+    `x = Class(...)`, `x = self.view.node(...)` (return annotation),
+    `x = imported_name` — plus annotated parameters."""
+    frame: Dict[str, Tuple] = _param_bindings(prog, mod, fi)
+    params = set(frame)
+    assigned_twice: Set[str] = set()
+    for node in _own(fi):
+        if isinstance(node, _FUNC_DEFS):
+            nested = f"{fi.path}::{fi.qual}.<locals>.{node.name}"
+            if nested in prog.funcs:
+                frame.setdefault(node.name, ("func", nested))
+        elif isinstance(node, ast.ClassDef):
+            nested = f"{fi.path}::{fi.qual}.<locals>.{node.name}"
+            if nested in prog.classes:
+                frame.setdefault(node.name, ("class", nested))
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name in assigned_twice:
+                continue
+            if name in frame and name not in params:
+                del frame[name]
+                assigned_twice.add(name)
+                continue
+            scope = _Scope(mod, outer_frames + [dict(frame)])
+            got = _resolve_value(prog, mod, scope, node.value)
+            if got is not None:
+                frame[name] = got[:2] if got[0] == "instance" else got
+            elif name in params:
+                del frame[name]       # reassigned param: binding unknown
+                assigned_twice.add(name)
+    return frame
+
+
+def _walk_function(prog: Program, fi: FuncInfo) -> None:
+    mod = prog.modules[fi.path]
+    scope = _Scope(mod, _closure_frames(prog, mod, fi)
+                   + [_frame_of(prog, mod, fi)])
+    self_name = _self_param(fi.node) if fi.class_key else None
+    tracked_globals = _tracked_globals(mod)
+
+    def resolve_lock(expr) -> Optional[str]:
+        got = _resolve_value(prog, mod, scope, expr)
+        if got is not None and got[0] == "lock":
+            return got[1]
+        return None
+
+    def state_of(expr) -> Optional[Tuple]:
+        """A shared-state identity for an attribute/global expression."""
+        if isinstance(expr, ast.Attribute):
+            base = _resolve_value(prog, mod, scope, expr.value)
+            if base is not None and base[0] == "instance":
+                return ("attr", base[1], expr.attr)
+            return None
+        if isinstance(expr, ast.Name) and expr.id in tracked_globals:
+            return ("global", fi.path, expr.id)
+        return None
+
+    def site(node) -> Site:
+        return Site(fi.path, getattr(node, "lineno", 1),
+                    getattr(node, "col_offset", 0))
+
+    def walk(body, held: Tuple[str, ...]):
+        for node in body:
+            if isinstance(node, _FUNC_DEFS + (ast.ClassDef, ast.Lambda)):
+                continue              # nested defs walk as their own funcs
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    lid = resolve_lock(item.context_expr)
+                    if lid is None and _is_lockish(item.context_expr):
+                        lid = UNKNOWN_LOCK
+                    if lid is not None:
+                        if lid not in inner:
+                            fi.acquires.append(
+                                (lid, inner, site(item.context_expr), True))
+                            inner = inner + (lid,)
+                    else:
+                        _expr_events(item.context_expr, held)
+                walk(node.body, inner)
+                continue
+            _stmt_events(node, held)
+            for sub in _child_blocks(node):
+                walk(sub, held)
+
+    def _child_blocks(node):
+        out = []
+        for name in ("body", "orelse", "finalbody"):
+            b = getattr(node, name, None)
+            if b:
+                out.append(b)
+        for h in getattr(node, "handlers", []) or []:
+            out.append(h.body)
+        return out
+
+    def _stmt_events(node, held):
+        # statement-level stores first (so reads in values still record)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _store_events(t, held)
+            _expr_events(node.value, held)
+        elif isinstance(node, ast.AugAssign):
+            _store_events(node.target, held)
+            st = state_of(node.target)
+            if st is not None:
+                fi.reads.append((st, held, site(node.target)))
+            _expr_events(node.value, held)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                _store_events(node.target, held)
+                _expr_events(node.value, held)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                _store_events(t, held)
+        elif isinstance(node, (ast.Expr, ast.Return, ast.Raise, ast.Assert,
+                               ast.If, ast.While, ast.For)):
+            for v in (getattr(node, "value", None),
+                      getattr(node, "test", None),
+                      getattr(node, "iter", None),
+                      getattr(node, "exc", None)):
+                if v is not None:
+                    _expr_events(v, held)
+        else:
+            for v in ast.iter_child_nodes(node):
+                if isinstance(v, ast.expr):
+                    _expr_events(v, held)
+
+    def _store_events(target, held):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                _store_events(e, held)
+            return
+        if isinstance(target, ast.Subscript):
+            st = state_of(target.value)
+            if st is not None:
+                fi.writes.append((st, held, site(target)))
+            _expr_events(target.slice, held)
+            return
+        st = state_of(target)
+        if st is not None:
+            fi.writes.append((st, held, site(target)))
+        elif isinstance(target, ast.Name) and fi.class_key is None \
+                and target.id in tracked_globals \
+                and _has_global_decl(fi.node, target.id):
+            fi.writes.append((("global", fi.path, target.id), held,
+                              site(target)))
+
+    def _expr_events(expr, held):
+        for node in ast.walk(expr):
+            if isinstance(node, _FUNC_DEFS + (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                _call_events(node, held)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                parent_is_call = False  # handled via _call_events receivers
+                st = state_of(node)
+                if st is not None and not parent_is_call:
+                    fi.reads.append((st, held, site(node)))
+                # @property access counts as a call to the property method
+                base = _resolve_value(prog, mod, scope, node.value)
+                if base is not None and base[0] == "instance":
+                    ci = _class_with(prog, base[1], node.attr)
+                    if ci is not None and node.attr in ci.properties:
+                        for target in _dispatch_targets(
+                                prog, ci.methods[node.attr]):
+                            fi.calls.append((target, held, site(node),
+                                             _is_self_expr(node.value)))
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in tracked_globals:
+                fi.reads.append((("global", fi.path, node.id), held,
+                                 site(node)))
+
+    def _is_self_expr(expr) -> bool:
+        return self_name is not None and isinstance(expr, ast.Name) \
+            and expr.id == self_name
+
+    def _call_events(call: ast.Call, held):
+        func = call.func
+        # .acquire() on a resolvable lock: an acquisition event (edges
+        # target it) without extending the held set (release is untracked)
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lid = resolve_lock(func.value)
+            if lid is None and _is_lockish(func.value):
+                lid = UNKNOWN_LOCK
+            if lid is not None:
+                fi.acquires.append((lid, held, site(call), False))
+                return
+        # mutator method on a tracked state: a write
+        if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+            st = state_of(func.value)
+            if st is not None:
+                fi.writes.append((st, held, site(call)))
+        got = _resolve_value(prog, mod, scope, func)
+        targets: List[str] = []
+        if got is not None:
+            if got[0] == "func":
+                recv_self = isinstance(func, ast.Attribute) \
+                    and _is_self_expr(func.value)
+                for target in _dispatch_targets(prog, got[1]):
+                    fi.calls.append((target, held, site(call),
+                                     recv_self and target == got[1]))
+                    targets.append(target)
+            elif got[0] == "class":
+                ci = prog.classes.get(got[1])
+                if ci is not None and "__init__" in ci.methods:
+                    fi.calls.append((ci.methods["__init__"], held,
+                                     site(call), False))
+                    targets.append(ci.methods["__init__"])
+            elif got[0] == "instance":
+                # calling an instance invokes __call__ (ManualClock-style
+                # callable objects stored as attributes)
+                ci = _class_with(prog, got[1], "__call__")
+                if ci is not None:
+                    for target in _dispatch_targets(
+                            prog, ci.methods["__call__"]):
+                        fi.calls.append((target, held, site(call), False))
+                        targets.append(target)
+        # a lambda argument is a callback the callee may invoke while
+        # holding ITS locks (TaskLockbox.critical_section runs fn() under
+        # self._lock): queue synthetic callee→lambda-body call edges; the
+        # post-walk pass attaches the callee's own acquired-lock set as the
+        # held context (not knowable until every function is walked)
+        if targets:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if not isinstance(arg, ast.Lambda):
+                    continue
+                for sub in ast.walk(arg.body):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    inner = _resolve_value(prog, mod, scope, sub.func)
+                    if inner is not None and inner[0] == "func":
+                        for t in targets:
+                            prog._pending_callbacks.append(
+                                (t, inner[1], site(sub)))
+        # thread-root constructions + escaped callables handled in
+        # _find_roots (they need the full program first)
+
+    walk(_body_of(fi.node), ())
+
+
+def _body_of(fn):
+    return fn.body if not isinstance(fn, ast.Lambda) else [ast.Expr(fn.body)]
+
+
+def _has_global_decl(fn, name: str) -> bool:
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Global) and name in node.names:
+            return True
+    return False
+
+
+def _tracked_globals(mod: ModuleInfo) -> Set[str]:
+    """Module-level mutable bindings worth tracking: plain vars (not
+    classes/funcs/locks/imports)."""
+    return {n for n, b in mod.globals.items() if b[0] in ("var", "instance")}
+
+
+def _frame_of(prog: Program, mod: ModuleInfo,
+              fi: FuncInfo) -> Dict[str, Tuple]:
+    got = prog.frames.get(fi.func_id)
+    if got is None:
+        got = _local_frame(prog, mod, fi, _closure_frames(prog, mod, fi))
+        prog.frames[fi.func_id] = got
+    return got
+
+
+def _closure_frames(prog: Program, mod: ModuleInfo,
+                    fi: FuncInfo) -> List[Dict[str, Tuple]]:
+    """Binding frames of lexically enclosing functions (outermost first) —
+    resolves the `outer = self` nested-HTTP-handler idiom."""
+    frames: List[Dict[str, Tuple]] = []
+    parts = fi.qual.split(".<locals>.")
+    prefix = ""
+    for part in parts[:-1]:
+        prefix = f"{prefix}.<locals>.{part}" if prefix else part
+        # the enclosing def may itself be a method: its qual is `prefix`
+        outer = prog.funcs.get(f"{fi.path}::{prefix}")
+        if outer is not None:
+            frames.append(_frame_of(prog, mod, outer))
+    return frames
+
+
+# ---- pass 3: thread roots -------------------------------------------------
+
+def _find_roots(prog: Program, config: LintConfig) -> None:
+    escaped: Set[str] = set()
+
+    for fid, fi in prog.funcs.items():
+        mod = prog.modules[fi.path]
+        scope = _Scope(mod, _closure_frames(prog, mod, fi)
+                       + [_frame_of(prog, mod, fi)])
+
+        def resolve_func(expr) -> Optional[str]:
+            got = _resolve_value(prog, mod, scope, expr)
+            if got is not None and got[0] == "func":
+                return got[1]
+            if got is not None and got[0] == "class":
+                ci = prog.classes.get(got[1])
+                if ci is not None and "__call__" in ci.methods:
+                    return ci.methods["__call__"]
+            return None
+
+        for node in _own(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal(node.func)
+            cand: List[Tuple[ast.AST, str]] = []
+            if name in ("Thread", "Timer"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        cand.append((kw.value, "thread"))
+                if name == "Timer" and len(node.args) >= 2:
+                    cand.append((node.args[1], "thread"))
+            elif name == "submit" and isinstance(node.func, ast.Attribute):
+                if node.args:
+                    cand.append((node.args[0], "submit"))
+            elif name == "map" and isinstance(node.func, ast.Attribute):
+                if node.args:
+                    cand.append((node.args[0], "map"))
+            elif name == "finalize" and node.args and len(node.args) >= 2:
+                cand.append((node.args[1], "finalizer"))
+            for expr, kind in cand:
+                target = resolve_func(expr)
+                if target is not None:
+                    for t in _dispatch_targets(prog, target):
+                        prog.roots.setdefault(t, kind)
+            # any program function passed as a plain argument escapes:
+            # its entry lock context is unknowable, assume none
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for a in args:
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    t = resolve_func(a)
+                    if t is not None:
+                        escaped.add(t)
+
+    # HTTP handler methods: every request runs them on a fresh server thread
+    for ci in prog.classes.values():
+        if not ci.is_handler:
+            continue
+        for mname, fid in ci.methods.items():
+            if mname.startswith("do_"):
+                prog.roots.setdefault(fid, "handler")
+
+    # configured roots: "path-glob::qual-glob"
+    for pat in config.extra_thread_roots:
+        ppat, _, qpat = pat.partition("::")
+        for fid, fi in prog.funcs.items():
+            if fnmatch.fnmatch(fi.path, ppat) and \
+                    fnmatch.fnmatch(fi.qual, qpat or "*"):
+                prog.roots.setdefault(fid, "extra")
+
+    prog.escaped = escaped            # consumed by _dataflow
+
+
+# ---- pass 4: dataflow -----------------------------------------------------
+
+def _dataflow(prog: Program) -> None:
+    callers: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+    for fid, fi in prog.funcs.items():
+        for callee, held, _site, _self in fi.calls:
+            callers.setdefault(callee, []).append((fid, held))
+
+    entry_zero = set(prog.roots) | prog.escaped | \
+        {fid for fid in prog.funcs if fid not in callers}
+
+    # MUST (intersection): TOP = None
+    must: Dict[str, Optional[Set[str]]] = {fid: None for fid in prog.funcs}
+    for fid in entry_zero:
+        must[fid] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fid, fi in prog.funcs.items():
+            if must[fid] is None:
+                continue
+            base = must[fid]
+            for callee, held, _s, _self in fi.calls:
+                if callee not in must:
+                    continue
+                cand = base | set(held) - {UNKNOWN_LOCK}
+                cur = must[callee]
+                new = cand if cur is None else cur & cand
+                if new != cur:
+                    must[callee] = new
+                    changed = True
+    prog.must_held = must
+
+    # MAY (union)
+    may: Dict[str, Set[str]] = {fid: set() for fid in prog.funcs}
+    changed = True
+    while changed:
+        changed = False
+        for fid, fi in prog.funcs.items():
+            for callee, held, _s, _self in fi.calls:
+                if callee not in may:
+                    continue
+                cand = may[fid] | set(held) - {UNKNOWN_LOCK}
+                if not cand <= may[callee]:
+                    may[callee] |= cand
+                    changed = True
+    prog.may_held = may
+
+    # root reachability
+    roots_of: Dict[str, Set[str]] = {fid: set() for fid in prog.funcs}
+    for fid in prog.roots:
+        roots_of[fid].add(fid)
+    changed = True
+    while changed:
+        changed = False
+        for fid, fi in prog.funcs.items():
+            if not roots_of[fid]:
+                continue
+            for callee, _h, _s, _self in fi.calls:
+                if callee in roots_of and not roots_of[fid] <= roots_of[callee]:
+                    roots_of[callee] |= roots_of[fid]
+                    changed = True
+    prog.roots_of = roots_of
+
+
+def _eff_held(prog: Program, fid: str, held: Tuple[str, ...]) -> Set[str]:
+    """MUST-effective held set at an event site."""
+    entry = prog.must_held.get(fid)
+    base = set() if entry is None else set(entry)
+    return (base | set(held)) - {UNKNOWN_LOCK}
+
+
+def _has_unknown(held: Tuple[str, ...]) -> bool:
+    return UNKNOWN_LOCK in held
+
+
+# ---- pass 5: lock-order graph ---------------------------------------------
+
+def _order_graph(prog: Program, config: Optional[LintConfig] = None) -> None:
+    edges: Dict[Tuple[str, str], Site] = {}
+    if config is not None:
+        for decl in config.raceguard_assume_edges:
+            a, _, b = decl.partition("->")
+            a, b = a.strip(), b.strip()
+            if a and b and a != b:
+                edges[(a, b)] = Site("<assumed>", 0, 0)
+    for fid, fi in prog.funcs.items():
+        may = prog.may_held.get(fid, set())
+        for lock, held, site, _via_with in fi.acquires:
+            if lock == UNKNOWN_LOCK:
+                continue
+            for h in may | (set(held) - {UNKNOWN_LOCK}):
+                if h == lock:
+                    continue          # self-edges handled separately
+                key = (h, lock)
+                old = edges.get(key)
+                if old is None or (site.path, site.line) < (old.path,
+                                                            old.line):
+                    edges[key] = site
+    prog.order_edges = edges
+
+
+def _self_deadlocks(prog: Program) -> List[Tuple[str, Site, str]]:
+    """`with self.L:` reaching another acquisition of self.L through a
+    SELF-call chain (same instance, provably) on a non-reentrant Lock."""
+    out = []
+    for ck, ci in prog.classes.items():
+        for attr, ld in ci.locks.items():
+            if ld.reentrant or ld.kind == "condition":
+                continue
+            # methods of this class that acquire the lock
+            acquirers: Dict[str, Site] = {}
+            for mname, fid in ci.methods.items():
+                for lock, _h, site, _w in prog.funcs[fid].acquires:
+                    if lock == ld.lock_id:
+                        acquirers.setdefault(fid, site)
+            if not acquirers:
+                continue
+            # self-call closure from each holder's with-body
+            self_calls: Dict[str, Set[str]] = {}
+            for mname, fid in ci.methods.items():
+                outs = set()
+                for callee, _h, _s, recv_self in prog.funcs[fid].calls:
+                    if recv_self and callee in prog.funcs:
+                        outs.add(callee)
+                self_calls[fid] = outs
+            for fid in ci.methods.values():
+                fi = prog.funcs[fid]
+                for callee, held, csite, recv_self in fi.calls:
+                    if not recv_self or ld.lock_id not in held:
+                        continue
+                    seen: Set[str] = set()
+                    stack = [callee]
+                    while stack:
+                        cur = stack.pop()
+                        if cur in seen:
+                            continue
+                        seen.add(cur)
+                        if cur in acquirers:
+                            out.append((ld.lock_id, acquirers[cur],
+                                        f"reached from {fi.qual}() which "
+                                        f"already holds it"))
+                            stack = []
+                            break
+                        stack.extend(self_calls.get(cur, ()))
+    return out
+
+
+# ---- pass 6: findings -----------------------------------------------------
+
+def _lock_short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+def _state_short(state: Tuple) -> str:
+    if state[0] == "attr":
+        return f"{state[1].split('::', 1)[-1]}.{state[2]}"
+    return f"{state[1]}:{state[2]}"
+
+
+def _compute_findings(prog: Program, config: LintConfig) -> None:
+    add = _adder(prog)
+
+    # collect events per state
+    state_writes: Dict[Tuple, List[Tuple[str, Tuple, Site]]] = {}
+    state_reads: Dict[Tuple, List[Tuple[str, Tuple, Site]]] = {}
+    for fid, fi in prog.funcs.items():
+        init = fi.name in INIT_METHODS
+        for st, held, site in fi.writes:
+            if not init:
+                state_writes.setdefault(st, []).append((fid, held, site))
+        for st, held, site in fi.reads:
+            if not init:
+                state_reads.setdefault(st, []).append((fid, held, site))
+
+    # unguarded-shared-write
+    for st, writes in sorted(state_writes.items()):
+        locked, unlocked = [], []
+        for fid, held, site in writes:
+            if _has_unknown(held):
+                continue              # benefit of the doubt
+            (locked if _eff_held(prog, fid, held) else unlocked).append(
+                (fid, held, site))
+        if locked and unlocked:
+            guards = sorted({_lock_short(l) for f, h, s in locked
+                             for l in _eff_held(prog, f, h)})
+            for fid, held, site in sorted(unlocked,
+                                          key=lambda w: (w[2].path,
+                                                         w[2].line)):
+                add("unguarded-shared-write", site,
+                    f"{_state_short(st)} is written under "
+                    f"{'/'.join(guards)} elsewhere but written here with "
+                    f"no lock held — one interleaving away from lost "
+                    f"updates; guard it or make it thread-local")
+            continue
+        # variant b: concurrent roots, no common lock across all writes.
+        # Only states with a SHARING signal participate: module globals,
+        # or attributes of a class that declares a lock — a lockless class
+        # reached from a handler is usually per-request (its instances
+        # never cross threads), and flagging every plan/builder object
+        # would drown the real races
+        owner = prog.classes.get(st[1]) if st[0] == "attr" else None
+        if st[0] != "global" and (owner is None or not owner.locks):
+            continue
+        weight = 0
+        root_names = set()
+        for fid, held, site in writes:
+            for r in prog.roots_of.get(fid, ()):
+                kind = prog.roots.get(r, "thread")
+                weight = max(weight,
+                             2 if kind in CONCURRENT_KINDS else 1)
+                root_names.add(prog.funcs[r].qual if r in prog.funcs else r)
+        if len(root_names) >= 2:
+            weight = 2
+        common = None
+        for fid, held, site in writes:
+            eff = _eff_held(prog, fid, held)
+            common = eff if common is None else (common & eff)
+        if weight >= 2 and writes and not common \
+                and not any(_has_unknown(h) for _f, h, _s in writes):
+            fid, held, site = min(writes, key=lambda w: (w[2].path,
+                                                         w[2].line))
+            add("unguarded-shared-write", site,
+                f"{_state_short(st)} is written from concurrent thread "
+                f"roots ({', '.join(sorted(root_names)[:3])}) with no "
+                f"common lock — concurrent writers race; pick one lock "
+                f"for every write")
+
+    # guard-consistency
+    for st, writes in sorted(state_writes.items()):
+        guard = None
+        ok = True
+        for fid, held, site in writes:
+            if _has_unknown(held):
+                ok = False
+                break
+            eff = _eff_held(prog, fid, held)
+            if not eff:
+                ok = False            # unguarded-shared-write territory
+                break
+            guard = eff if guard is None else (guard & eff)
+        if not ok or not guard:
+            continue
+        writer_rooted = any(prog.roots_of.get(fid) for fid, _h, _s in writes)
+        if not writer_rooted:
+            continue                  # no concurrent writer can exist
+        gnames = "/".join(sorted(_lock_short(g) for g in guard))
+        for fid, held, site in sorted(state_reads.get(st, ()),
+                                      key=lambda r: (r[2].path, r[2].line)):
+            if not prog.roots_of.get(fid):
+                continue              # not on a thread-root path
+            if _has_unknown(held):
+                continue
+            if _eff_held(prog, fid, held) & guard:
+                continue
+            add("guard-consistency", site,
+                f"{_state_short(st)} is consistently written under "
+                f"{gnames} but read here without it on a thread-root "
+                f"path — a concurrent writer can interleave; take the "
+                f"lock or snapshot under it")
+
+    # lock-order-cycle
+    sccs = _tarjan(_edge_graph(prog.order_edges))
+    for scc in sccs:
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        sites = sorted((s for (a, b), s in prog.order_edges.items()
+                        if a in scc and b in scc),
+                       key=lambda s: (s.path, s.line))
+        # anchor at a REAL acquisition site — an assumed (config-declared)
+        # edge has no line to suppress on
+        real = [s for s in sites if s.path != "<assumed>"]
+        if not real:
+            continue
+        names = " -> ".join(_lock_short(l) for l in cyc) + \
+            f" -> {_lock_short(cyc[0])}"
+        add("lock-order-cycle", real[0],
+            f"lock acquisition order cycle: {names} — two threads "
+            f"entering from opposite ends deadlock; impose one global "
+            f"order (or merge the locks)")
+    for lock_id, site, how in _self_deadlocks(prog):
+        add("lock-order-cycle", site,
+            f"{_lock_short(lock_id)} is non-reentrant but re-acquired "
+            f"here, {how} — same-thread re-entry deadlocks; use RLock "
+            f"or split a _locked helper")
+
+    # lock-in-traced is computed per-module in the rule body (needs no
+    # cross-module state); nothing precomputed here
+
+
+def _edge_graph(edges) -> Dict[str, Set[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    return graph
+
+
+def _adder(prog: Program):
+    def add(rule_name: str, site: Site, message: str) -> None:
+        prog.findings.setdefault(rule_name, {}).setdefault(
+            site.path, []).append((site.line, site.col, message))
+    return add
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strong(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strong(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DOT rendering (CLI --dot)
+# ---------------------------------------------------------------------------
+
+def render_dot(prog: Program) -> str:
+    """The static lock-order graph as graphviz DOT; cycle members red."""
+    in_cycle: Set[str] = set()
+    for scc in _tarjan(_edge_graph(prog.order_edges)):
+        if len(scc) > 1:
+            in_cycle |= scc
+    lines = ["digraph lock_order {", '  rankdir=LR;',
+             '  node [shape=box, fontsize=10];']
+    nodes = sorted({n for e in prog.order_edges for n in e})
+    for n in nodes:
+        color = ', color=red' if n in in_cycle else ''
+        lines.append(f'  "{n}" [label="{_lock_short(n)}"{color}];')
+    for (a, b), site in sorted(prog.order_edges.items()):
+        if site.path == "<assumed>":
+            lines.append(f'  "{a}" -> "{b}" [style=dashed, '
+                         f'label="assumed (config)", fontsize=8];')
+        else:
+            lines.append(f'  "{a}" -> "{b}" '
+                         f'[label="{site.path}:{site.line}", fontsize=8];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Rule registration (per-module shims over the program index)
+# ---------------------------------------------------------------------------
+
+def _program_for(ctx: ModuleContext) -> Program:
+    """The whole-program index this module's findings come from. One lint
+    run = one LintConfig instance, so the disk program is memoized ON the
+    config (analyze_tree's sig check — a stat of every member file — would
+    otherwise rerun for every (rule × module) pair)."""
+    root = Path(ctx.config.root).resolve()
+    prog = getattr(ctx.config, "_raceguard_program", None)
+    if prog is None or getattr(ctx.config, "_raceguard_root", None) != root:
+        prog = analyze_tree(root, ctx.config)
+        ctx.config._raceguard_program = prog
+        ctx.config._raceguard_root = root
+    if prog.sources.get(ctx.path) == ctx.source:
+        return prog
+    # unit-test path (check_source with synthetic source): the module is
+    # its own one-file program
+    return analyze_sources({ctx.path: ctx.source}, ctx.config)
+
+
+def _emit(ctx: ModuleContext, prog: Program,
+          rule_name: str) -> Iterable[Finding]:
+    for line, col, message in sorted(
+            prog.findings.get(rule_name, {}).get(ctx.path, ())):
+        yield ctx.finding(SimpleNamespace(lineno=line, col_offset=col),
+                          message)
+
+
+def _in_scope(ctx: ModuleContext) -> bool:
+    return ctx.path_matches(ctx.config.raceguard_modules)
+
+
+@rule("unguarded-shared-write", "error",
+      "shared attribute written with inconsistent (or no) locking")
+def check_unguarded_shared_write(ctx: ModuleContext) -> Iterable[Finding]:
+    """An attribute (or module global) written under a lock in one place
+    and with no lock in another — or written from two concurrent thread
+    roots with no common lock — races: lost updates on counters, torn
+    composite state, dict resize vs iteration. Whole-program: the writes
+    and the threads that reach them may live in different modules (config
+    `raceguard-modules`). Constructor writes (`__init__`) are exempt."""
+    if not _in_scope(ctx):
+        return
+    yield from _emit(ctx, _program_for(ctx), "unguarded-shared-write")
+
+
+@rule("lock-order-cycle", "error",
+      "cycle in the static lock-acquisition-order graph")
+def check_lock_order_cycle(ctx: ModuleContext) -> Iterable[Finding]:
+    """Lock A held while taking lock B in one path and B held while taking
+    A in another deadlocks the moment both paths run concurrently — the
+    bug ships silently on low-traffic CPU tests and bites under TPU-scale
+    fan-out. Also flags same-lock re-entry through a self-call chain on a
+    non-reentrant Lock. The dynamic witness (lockwitness.py) checks every
+    RUNTIME acquisition order is an edge of this static graph."""
+    if not _in_scope(ctx):
+        return
+    yield from _emit(ctx, _program_for(ctx), "lock-order-cycle")
+
+
+@rule("guard-consistency", "warning",
+      "guarded attribute read without its lock on a thread-root path")
+def check_guard_consistency(ctx: ModuleContext) -> Iterable[Finding]:
+    """If every (post-construction) write of an attribute happens under one
+    lock, reads on thread-root-reachable paths must hold it too: unlocked
+    readers see torn multi-field invariants and racing iterator/resize
+    states. Reads in code no spawned thread reaches are left alone, as are
+    attributes whose writers are all construction-time."""
+    if not _in_scope(ctx):
+        return
+    yield from _emit(ctx, _program_for(ctx), "guard-consistency")
+
+
+@rule("lock-in-traced", "error",
+      "lock acquired inside traced/compiled device code")
+def check_lock_in_traced(ctx: ModuleContext) -> Iterable[Finding]:
+    """A `with lock:` (or .acquire()) inside a jit/shard_map/pallas-traced
+    body runs ONCE at trace time — it guards nothing on later executions,
+    and holding a Python lock across a compiled dispatch invites deadlock
+    with the host threads that feed it. Take locks at the dispatch layer,
+    never inside traced functions."""
+    if not _in_scope(ctx):
+        return
+    from tools.druidlint.rules import _collect_traced_functions
+    extra = frozenset({"pallas_call"})
+    # nested defs inside a traced body are NOT pruned on purpose: a helper
+    # defined (and called) during tracing runs at trace time too, so its
+    # lock acquisitions are just as inert
+    for fn in _collect_traced_functions(ctx, extra):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _is_lockish(item.context_expr):
+                        yield ctx.finding(
+                            item.context_expr,
+                            f"with {_dotted(item.context_expr)}: inside "
+                            f"traced {getattr(fn, 'name', '<fn>')}() — "
+                            f"runs once at trace time, guards nothing at "
+                            f"execution; lock at the dispatch layer")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire" \
+                    and _is_lockish(node.func.value):
+                yield ctx.finding(
+                    node, f"{_dotted(node.func)}() inside traced "
+                          f"{getattr(fn, 'name', '<fn>')}() — runs once "
+                          f"at trace time, guards nothing at execution")
