@@ -2,7 +2,9 @@
 batched-vs-per-segment dispatch-amortization comparison.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
-"per_segment_rate", "batched_rate", "batch_speedup"}.
+"per_segment_rate", "batched_rate", "batch_speedup", "untraced_rate",
+"traced_rate", "trace_overhead"} — the last three track qtrace span
+overhead across BENCH_r* runs.
 
 Config mirrors BASELINE.json: TPC-H-style GroupBy (2 dims, 3 aggs, numeric
 bound filter) + TopN (1 dim, metric-ordered) over synthetic segments.
@@ -240,6 +242,46 @@ def _bench_batching(iters: int):
     }
 
 
+def _bench_tracing(iters: int):
+    """qtrace overhead in one number pair: the batch-comparison query at
+    many small segments (the worst case for per-dispatch span overhead —
+    tiny device programs, many dispatch boundaries), run with a trace root
+    open (every span live) vs without (every span a no-op thread-local
+    read). Tracked across BENCH_r* runs so a regression in span cost shows
+    up as traced_rate falling away from untraced_rate."""
+    from druid_tpu.engine.executor import QueryExecutor
+    from druid_tpu.obs import trace as qtrace
+
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_BATCH_SEGMENTS", 16))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_BATCH_ROWS", 4096))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    query = batch_groupby()
+    executor = QueryExecutor(segments)
+
+    executor.run(query)                  # warm: compile + staging
+    rates = {}
+    for label in ("untraced", "traced"):
+        times = []
+        for _ in range(max(iters, 3)):
+            t = time.time()
+            if label == "traced":
+                with qtrace.root_span("bench/query", service="bench"):
+                    executor.run(query)
+            else:
+                executor.run(query)
+            times.append(time.time() - t)
+        rates[label] = total_rows / min(times)
+        log(f"trace-bench {label}: best {min(times) * 1e3:.1f}ms "
+            f"-> {rates[label] / 1e6:.1f}M rows/s")
+    return {
+        "untraced_rate": round(rates["untraced"], 0),
+        "traced_rate": round(rates["traced"], 0),
+        "trace_overhead": round(
+            1.0 - rates["traced"] / rates["untraced"], 4),
+    }
+
+
 def main():
     rows = int(os.environ.get("DRUID_TPU_BENCH_ROWS", 100_000_000))
     n_segments = int(os.environ.get("DRUID_TPU_BENCH_SEGMENTS", 8))
@@ -286,13 +328,18 @@ def main():
     log(f"warm latency: p50 {p50:.0f}ms  p95 {p95:.0f}ms "
         f"(over {len(lat)} timed queries @ {total_rows:,} rows)")
 
-    # the add-on comparison must never cost the already-measured headline
+    # the add-on comparisons must never cost the already-measured headline
     # its ONE JSON line — degrade to an error field instead
     try:
         batch = _bench_batching(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"batch-bench failed: {type(e).__name__}: {e}")
         batch = {"batch_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        traced = _bench_tracing(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"trace-bench failed: {type(e).__name__}: {e}")
+        traced = {"trace_error": f"{type(e).__name__}: {e}"[:200]}
 
     value = 2 * total_rows / (t_gb + t_tn)
     baseline = 36_246_530.0  # Java rows/sec/core scan-aggregate upper bound
@@ -305,6 +352,7 @@ def main():
         "p95_ms": round(p95, 1),
     }
     out.update(batch)
+    out.update(traced)
     print(json.dumps(out), flush=True)
 
 

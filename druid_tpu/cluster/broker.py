@@ -28,6 +28,7 @@ from druid_tpu.cluster.metadata import SegmentDescriptor
 from druid_tpu.cluster.view import InventoryView, _is_aggregate
 from druid_tpu.engine import engines
 from druid_tpu.engine.engines import AggregatePartials
+from druid_tpu.obs import trace as qtrace
 from druid_tpu.query import filters as F
 from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery,
                                    Query, ScanQuery, SearchQuery,
@@ -137,6 +138,15 @@ class Broker:
 
     # ---- the signature path (§3.1) -------------------------------------
     def run(self, query: Query):
+        # the trace root for a query entering at the broker (trace id =
+        # queryId); when the lifecycle already opened the root this is a
+        # plain child span, and {"trace": false} makes it (and every span
+        # below it) a no-op
+        with qtrace.root_span("broker/query", query,
+                              service="druid/broker"):
+            return self._run(query)
+
+    def _run(self, query: Query):
         from druid_tpu.engine.executor import apply_interval_chunking
         query = apply_interval_chunking(query)
         if query.inner_query is not None:
@@ -148,7 +158,8 @@ class Broker:
             inner_rows = self.run(query.inner_query)
             seg = subquery_segment(query.inner_query, inner_rows)
             return QueryExecutor().run(query, segments=[seg])
-        segments = self._segments_to_query(query)
+        with qtrace.span("broker/plan"):
+            segments = self._segments_to_query(query)
         if not segments:
             return []
         if _is_aggregate(query):
@@ -253,14 +264,15 @@ class Broker:
 
         parts = self._scatter(q2, segments, rows_mode=False)
         ap = AggregatePartials.concat(parts)
-        if isinstance(query, TimeseriesQuery):
-            rows = engines.finish_timeseries(q2, ap)
-        elif isinstance(query, TopNQuery):
-            rows = engines.finish_topn(q2, ap)
-        elif isinstance(query, GroupByQuery):
-            rows = engines.finish_groupby(q2, ap)
-        else:  # pragma: no cover
-            raise TypeError(type(query).__name__)
+        with qtrace.span("broker/merge", partials=len(ap.partials)):
+            if isinstance(query, TimeseriesQuery):
+                rows = engines.finish_timeseries(q2, ap)
+            elif isinstance(query, TopNQuery):
+                rows = engines.finish_topn(q2, ap)
+            elif isinstance(query, GroupByQuery):
+                rows = engines.finish_groupby(q2, ap)
+            else:  # pragma: no cover
+                raise TypeError(type(query).__name__)
         if use_rcache and self.cache_config.populate_result_cache:
             self.cache.put("result", rkey, rows)
         return rows
@@ -329,6 +341,14 @@ class Broker:
     # ---- scatter + retry (RetryQueryRunner) ----------------------------
     def _scatter(self, query: Query, segments: List[SegmentDescriptor],
                  rows_mode: bool):
+        with qtrace.span("broker/scatter",
+                         segments=len(segments)) as scatter_span:
+            return self._scatter_rounds(query, segments, rows_mode,
+                                        scatter_span)
+
+    def _scatter_rounds(self, query: Query,
+                        segments: List[SegmentDescriptor],
+                        rows_mode: bool, scatter_span):
         # cancel token + deadline ride the whole scatter (QueryContexts
         # timeout; DELETE /druid/v2/{id} trips the token)
         qid = query.context_map.get("queryId")
@@ -375,31 +395,41 @@ class Broker:
                 if token is not None and qid and hasattr(node, "cancel"):
                     token.add_remote_cancel(
                         lambda n=node: n.cancel(qid), key=server)
-                self.view.connection_started(server)
-                try:
-                    if rows_mode:
-                        rows, served = node.run_rows(q_round, sids)
-                        return server, sids, rows, served
-                    ap, served = node.run_partials(q_round, sids)
-                    return server, sids, ap, served
-                except (QueryInterruptedError, QueryTimeoutError):
-                    raise      # cancel/deadline: abort the whole scatter
-                except ConnectionError:
-                    # unreachable server: plain failover; exhausting
-                    # replicas is a MissingSegmentsError
-                    return server, sids, None, set()
-                except Exception as e:
-                    # a sick node (HTTP 500, crash mid-query) is retried on
-                    # another replica exactly like a missing segment
-                    # (reference: query/RetryQueryRunner.java:71-80); the
-                    # error is kept PER SEGMENT so exhausting replicas
-                    # reports the real failure for a segment that actually
-                    # failed — not a recovered one's stale error
-                    for sid in sids:
-                        seg_errors[sid] = e
-                    return server, sids, None, set()
-                finally:
-                    self.view.connection_finished(server)
+                # the pool worker re-activates the scatter span, times this
+                # node's response as broker/node, and stamps the span as the
+                # remote parent into the context it POSTs — the data node
+                # re-roots its spans under it (qtrace wire propagation)
+                with qtrace.attach(scatter_span), \
+                        qtrace.span("broker/node", server=server,
+                                    segments=len(sids)) as nsp:
+                    q_call = q_round if nsp is None \
+                        else qtrace.with_traceparent(q_round, nsp)
+                    self.view.connection_started(server)
+                    try:
+                        if rows_mode:
+                            rows, served = node.run_rows(q_call, sids)
+                            return server, sids, rows, served
+                        ap, served = node.run_partials(q_call, sids)
+                        return server, sids, ap, served
+                    except (QueryInterruptedError, QueryTimeoutError):
+                        raise  # cancel/deadline: abort the whole scatter
+                    except ConnectionError:
+                        # unreachable server: plain failover; exhausting
+                        # replicas is a MissingSegmentsError
+                        return server, sids, None, set()
+                    except Exception as e:
+                        # a sick node (HTTP 500, crash mid-query) is
+                        # retried on another replica exactly like a missing
+                        # segment (reference: query/RetryQueryRunner.java:
+                        # 71-80); the error is kept PER SEGMENT so
+                        # exhausting replicas reports the real failure for
+                        # a segment that actually failed — not a recovered
+                        # one's stale error
+                        for sid in sids:
+                            seg_errors[sid] = e
+                        return server, sids, None, set()
+                    finally:
+                        self.view.connection_finished(server)
 
             with ThreadPoolExecutor(max_workers=self.max_threads) as pool:
                 outcomes = list(pool.map(run_one, by_server.items()))

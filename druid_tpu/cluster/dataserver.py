@@ -25,18 +25,24 @@ import socket
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence, Set, Tuple
 
 from druid_tpu.cluster import wire
 from druid_tpu.cluster.view import DataNode
+from druid_tpu.obs import trace as qtrace
+from druid_tpu.obs.prometheus import CONTENT_TYPE as PROM_CONTENT_TYPE
+from druid_tpu.obs.prometheus import MetricRegistry, compose_sink
 from druid_tpu.query.model import Query, query_from_json
 from druid_tpu.server.http import _json_value
 from druid_tpu.server.querymanager import (DEFAULT_TIMEOUT_MS, Deadline,
                                            QueryInterruptedError,
                                            QueryManager, QueryTimeoutError,
                                            cancel_path_id)
+from druid_tpu.utils.emitter import (QueryCountStatsMonitor,
+                                     ServiceEmitter)
 
 
 class RemoteQueryError(RuntimeError):
@@ -68,21 +74,22 @@ class DataNodeServer:
     def __init__(self, node: DataNode, host: str = "127.0.0.1",
                  port: int = 0, emitter=None,
                  device_pool_bytes: Optional[int] = None,
-                 monitor_period_seconds: float = 60.0):
+                 monitor_period_seconds: float = 60.0,
+                 trace_store: Optional[qtrace.TraceStore] = None):
+        """`trace_store` (default: the process singleton) receives this
+        node's qtrace spans and backs GET /druid/v2/trace/<queryId>; a
+        MetricRegistry always backs GET /metrics — the given `emitter`'s
+        sink is composed with it, or a registry-only ServiceEmitter is
+        created so every data node is scrapeable out of the box."""
         self.node = node
         self.query_manager = QueryManager()
-        self.emitter = emitter
-        self._monitors = None
+        self.trace_store = trace_store if trace_store is not None \
+            else qtrace.trace_store()
+        self.registry = MetricRegistry()
+        self._query_counts = QueryCountStatsMonitor()
         if device_pool_bytes is not None:
             from druid_tpu.data.devicepool import device_pool
             device_pool().configure(device_pool_bytes)
-        if emitter is not None:
-            from druid_tpu.data.devicepool import DevicePoolMonitor
-            from druid_tpu.engine.batching import BatchMetricsMonitor
-            from druid_tpu.utils.emitter import MonitorScheduler
-            self._monitors = MonitorScheduler(
-                emitter, [DevicePoolMonitor(), BatchMetricsMonitor()],
-                period_seconds=monitor_period_seconds)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,6 +132,18 @@ class DataNodeServer:
                         # announce without being hand-fed
                         # (HttpServerInventoryView's segment listing)
                         "segmentDescriptors": descs})
+                elif self.path.rstrip("/") == "/metrics":
+                    self._send(200, PROM_CONTENT_TYPE,
+                               outer.registry.exposition().encode())
+                elif self.path.startswith("/druid/v2/trace/"):
+                    qid = urllib.parse.unquote(
+                        self.path[len("/druid/v2/trace/"):].rstrip("/"))
+                    got = outer.trace_store.get(qid)
+                    if got is None:
+                        self._reply_json(404, {"error": "unknown trace",
+                                               "queryId": qid})
+                    else:
+                        self._reply_json(200, got)
                 else:
                     self._reply_json(404, {"error": "unknown path"})
 
@@ -152,6 +171,9 @@ class DataNodeServer:
                                      {"error": f"{type(e).__name__}: {e}"})
 
             def _run(self, payload, rows_mode: bool):
+                """Returns ((result, served), spans): the request's finished
+                qtrace spans ride back in the response so the broker can
+                assemble one end-to-end trace."""
                 query = query_from_json(payload["query"])
                 sids = payload.get("segments") or []
                 qid = query.context_map.get("queryId")
@@ -163,27 +185,45 @@ class DataNodeServer:
                         token.check()
                     deadline.check()
 
+                t0 = time.monotonic()
+                ok = False
                 try:
-                    check()
-                    if rows_mode:
-                        out = outer.node.run_rows(query, sids)
-                    else:
-                        out = outer.node.run_partials(query, sids,
-                                                      check=check)
-                    check()
-                    return out
+                    # re-root this node's spans under the broker's remote
+                    # parent (context traceparent); collect=True captures
+                    # the request's spans for the response payload
+                    with qtrace.root_span("datanode/query", query,
+                                          service=outer.node.name,
+                                          store=outer.trace_store,
+                                          collect=True) as root:
+                        check()
+                        if rows_mode:
+                            out = outer.node.run_rows(query, sids)
+                        else:
+                            out = outer.node.run_partials(query, sids,
+                                                          check=check)
+                        check()
+                    ok = True
+                    return out, (root.collected()
+                                 if root is not None else [])
                 finally:
                     if qid:
                         outer.query_manager.unregister(qid)
+                    outer._query_counts.on_query(ok)
+                    outer.emitter.metric(
+                        "query/time", (time.monotonic() - t0) * 1e3,
+                        dataSource=query.datasource, type=query.query_type,
+                        id=qid or "", success=str(ok).lower())
 
             def _partials(self, payload):
-                ap, served = self._run(payload, rows_mode=False)
-                self._reply_bytes(wire.dumps_partials(ap, served))
+                (ap, served), spans = self._run(payload, rows_mode=False)
+                self._reply_bytes(wire.dumps_partials(ap, served,
+                                                      trace=spans))
 
             def _rows(self, payload):
-                rows, served = self._run(payload, rows_mode=True)
+                (rows, served), spans = self._run(payload, rows_mode=True)
                 self._reply_json(200, {"rows": rows,
-                                       "served": sorted(served)})
+                                       "served": sorted(served),
+                                       "trace": spans})
 
             def do_DELETE(self):
                 qid = cancel_path_id(self.path)
@@ -197,6 +237,25 @@ class DataNodeServer:
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # every node is scrapeable: the registry joins the given emitter's
+        # sink chain (undone on stop(), so an emitter reused across server
+        # generations doesn't feed dead registries), or becomes the sink
+        # of a fresh ServiceEmitter
+        self._restore_sink = lambda: None
+        if emitter is None:
+            emitter = ServiceEmitter("druid/historical",
+                                     f"{self.host}:{self.port}",
+                                     self.registry)
+        else:
+            self._restore_sink = compose_sink(emitter, self.registry)
+        self.emitter = emitter
+        from druid_tpu.data.devicepool import DevicePoolMonitor
+        from druid_tpu.engine.batching import BatchMetricsMonitor
+        from druid_tpu.utils.emitter import MonitorScheduler
+        self._monitors = MonitorScheduler(
+            emitter, [DevicePoolMonitor(), BatchMetricsMonitor(),
+                      self._query_counts],
+            period_seconds=monitor_period_seconds)
 
     @property
     def url(self) -> str:
@@ -219,6 +278,7 @@ class DataNodeServer:
     def stop(self) -> None:
         if self._monitors is not None:
             self._monitors.stop()
+        self._restore_sink()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -329,13 +389,24 @@ class RemoteDataNodeClient:
         if ctype != wire.CONTENT_TYPE:
             raise ConnectionError(
                 f"server [{self.name}] returned {ctype}, expected partials")
-        return wire.loads_partials(data)
+        ap, served, spans = wire.loads_partials(data)
+        self._ingest_trace(spans)
+        return ap, served
 
     def run_rows(self, query: Query, segment_ids: Sequence[str]
                  ) -> Tuple[List[dict], Set[str]]:
         _, data = self._post("/druid/v2/rows", query, segment_ids)
         out = json.loads(data)
+        self._ingest_trace(out.get("trace"))
         return out["rows"], set(out["served"])
+
+    def _ingest_trace(self, spans) -> None:
+        """Merge the node's returned span tree into this (broker) process's
+        trace store — the gather half of qtrace propagation. Span-id dedupe
+        in the store makes this idempotent when broker and node share one
+        process (in-process tests)."""
+        if spans:
+            qtrace.trace_store().ingest(spans)
 
     def cancel(self, query_id: str) -> None:
         req = urllib.request.Request(

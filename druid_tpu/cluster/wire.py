@@ -171,9 +171,12 @@ def rebuild_kernels(agg_jsons: Sequence[dict]):
 # AggregatePartials
 # ---------------------------------------------------------------------------
 
-def dumps_partials(ap, served: Sequence[str] = ()) -> bytes:
+def dumps_partials(ap, served: Sequence[str] = (),
+                   trace: Sequence[dict] = ()) -> bytes:
     """Serialize AggregatePartials (+ the served-segment-id set the node is
-    acknowledging, which rides in the same payload)."""
+    acknowledging, and the node's finished trace spans — plain JSON dicts —
+    so the broker can assemble one end-to-end trace per query; both ride in
+    the same payload)."""
     tt = _TensorTable()
     partials = []
     for p in ap.partials:
@@ -190,6 +193,7 @@ def dumps_partials(ap, served: Sequence[str] = ()) -> bytes:
         "intervals": None if ap.intervals is None
         else [[iv.start, iv.end] for iv in ap.intervals],
         "served": sorted(served),
+        "trace": list(trace),
     }
     manifest, payload = tt.manifest_and_payload()
     header["tensors"] = manifest
@@ -198,7 +202,7 @@ def dumps_partials(ap, served: Sequence[str] = ()) -> bytes:
 
 
 def loads_partials(data: bytes):
-    """Returns (AggregatePartials, served_segment_ids)."""
+    """Returns (AggregatePartials, served_segment_ids, trace_spans)."""
     from druid_tpu.engine.engines import AggregatePartials
     from druid_tpu.engine.grouping import SegmentPartial
     from druid_tpu.utils.intervals import Interval
@@ -229,4 +233,4 @@ def loads_partials(data: bytes):
         spans=[tuple(s) for s in header["spans"]],
         intervals=None if intervals is None
         else tuple(Interval(a, b) for a, b in intervals))
-    return ap, set(header["served"])
+    return ap, set(header["served"]), list(header.get("trace") or ())

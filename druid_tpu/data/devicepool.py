@@ -26,6 +26,7 @@ import weakref
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Set, Tuple
 
+from druid_tpu.obs.trace import span as trace_span
 from druid_tpu.utils.emitter import Monitor
 
 
@@ -179,8 +180,14 @@ class DeviceSegmentPool:
                 self._hits += 1
                 return hit[0]
             self._misses += 1
-        value = build()
-        nbytes = entry_bytes(value)
+        # cold miss: the H2D staging cost a warm pool hides. The span times
+        # the whole build (host prep + device_put) at its existing boundary
+        with trace_span("pool/h2d",
+                        kind=str(key[0]) if key else "") as sp:
+            value = build()
+            nbytes = entry_bytes(value)
+            if sp is not None:
+                sp.attrs["bytes"] = nbytes
         with self._lock:
             self._drain_dead_locked()
             keys = self._owner_keys.get(owner)

@@ -54,6 +54,8 @@ from druid_tpu.engine.grouping import (GroupPlan, GroupSpec, KeyDim,
                                        run_grouped_aggregate,
                                        windowed_window)
 from druid_tpu.engine.kernels import AggKernel
+from druid_tpu.obs.trace import span as trace_span
+from druid_tpu.obs.trace import span_when as trace_span_when
 from druid_tpu.query.aggregators import AggregatorSpec
 from druid_tpu.utils.emitter import Monitor
 from druid_tpu.utils.granularity import Granularity
@@ -411,6 +413,9 @@ def _run_batch(chunk: List[_Plan], intervals: Sequence[Interval],
         + f"|K={K}|R={R}"
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.get(sig)
+        # the miss IS the compile event (jit traces/compiles on the first
+        # call below) — timing stays at the existing dispatch boundary
+        compiled = fn is None
         if fn is None:
             fn = _build_batched_fn(ref.spec, ref.kds, ref.filter_node,
                                    ref.kernels, ref.vc_plans, K)
@@ -420,8 +425,12 @@ def _run_batch(chunk: List[_Plan], intervals: Sequence[Interval],
         else:
             _JIT_CACHE.move_to_end(sig)
 
-    outs = fn(tuple(b.arrays for b in blocks), time0s, iv_rel, bucket_off,
-              aux)
+    with trace_span("engine/batch/dispatch", segments=K, rows=R,
+                    compile=compiled), \
+            trace_span_when(compiled, "engine/compile", kind="batched",
+                            strategy=strategy):
+        outs = fn(tuple(b.arrays for b in blocks), time0s, iv_rel,
+                  bucket_off, aux)
 
     out: List[SegmentPartial] = []
     for p, (counts, states) in zip(chunk, outs):
@@ -459,10 +468,11 @@ def run_with_batching(segs: Sequence[Segment], intervals: Sequence[Interval],
             in ("0", "false", "no"):
         return None
 
-    plans = [_plan_for(s, kds, i, intervals, granularity, aggs, flt,
-                       virtual_columns)
-             for i, (s, kds) in enumerate(zip(segs, kds_per_seg))]
-    buckets = _shape_buckets([p for p in plans if p.eligible])
+    with trace_span("engine/batch/plan", segments=len(segs)):
+        plans = [_plan_for(s, kds, i, intervals, granularity, aggs, flt,
+                           virtual_columns)
+                 for i, (s, kds) in enumerate(zip(segs, kds_per_seg))]
+        buckets = _shape_buckets([p for p in plans if p.eligible])
     if not any(len(b) >= BATCH_MIN_SEGMENTS for b in buckets):
         # nothing batches — but the per-segment planning already happened:
         # run the plain path HERE so the plans are executed, not rebuilt

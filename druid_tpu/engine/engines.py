@@ -234,26 +234,28 @@ def _make_partials(segs, intervals, query, kds_per_seg, vals_per_seg,
     `check` (cancel/timeout probe) runs at every dispatch boundary: between
     per-segment programs, between batched shape-bucket dispatches, and
     before the single sharded program."""
+    from druid_tpu.obs.trace import span as trace_span
     if check is not None:
         check()
-    merged = distributed.try_sharded(segs, intervals, query.granularity,
-                                     kds_per_seg, query.aggregations,
-                                     query.filter, query.virtual_columns)
-    if merged is not None:
-        return [merged], [vals_per_seg[0]]
-    partials = batching.run_with_batching(
-        segs, intervals, query.granularity, kds_per_seg, query.aggregations,
-        query.filter, query.virtual_columns, context=query.context_map,
-        check=check)
-    if partials is None:
-        partials = []
-        for s, kds in zip(segs, kds_per_seg):
-            if check is not None and partials:
-                check()
-            partials.append(run_grouped_aggregate(
-                s, intervals, query.granularity, kds, query.aggregations,
-                query.filter, virtual_columns=query.virtual_columns))
-    return partials, list(vals_per_seg)
+    with trace_span("engine/partials", segments=len(segs)):
+        merged = distributed.try_sharded(segs, intervals, query.granularity,
+                                         kds_per_seg, query.aggregations,
+                                         query.filter, query.virtual_columns)
+        if merged is not None:
+            return [merged], [vals_per_seg[0]]
+        partials = batching.run_with_batching(
+            segs, intervals, query.granularity, kds_per_seg,
+            query.aggregations, query.filter, query.virtual_columns,
+            context=query.context_map, check=check)
+        if partials is None:
+            partials = []
+            for s, kds in zip(segs, kds_per_seg):
+                if check is not None and partials:
+                    check()
+                partials.append(run_grouped_aggregate(
+                    s, intervals, query.granularity, kds, query.aggregations,
+                    query.filter, virtual_columns=query.virtual_columns))
+        return partials, list(vals_per_seg)
 
 
 # ---------------------------------------------------------------------------

@@ -51,6 +51,8 @@ import numpy as np
 from druid_tpu.data.segment import DeviceBlock, Segment
 from druid_tpu.engine.filters import (ConstNode, FilterNode, plan_filter,
                                       simplify_node)
+from druid_tpu.obs.trace import span as trace_span
+from druid_tpu.obs.trace import span_when as trace_span_when
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.query.aggregators import AggregatorSpec
 from druid_tpu.utils.granularity import Granularity
@@ -1111,6 +1113,11 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                              vc_plans)
         with _JIT_CACHE_LOCK:
             fn = _JIT_CACHE.get(sig)
+            # the builder-idiom miss IS the compile event: jit tracing +
+            # XLA compilation happen inside the first call below, so the
+            # dispatch span (and, on miss, the nested engine/compile span)
+            # time the existing dispatch boundary — no extra syncs
+            compiled = fn is None
             if fn is None:
                 fn = _build_device_fn(spec, len(intervals), filter_node,
                                       kernels, vc_plans)
@@ -1120,7 +1127,12 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
             else:
                 _JIT_CACHE.move_to_end(sig)
         try:
-            counts, states = fn(arrays, aux)
+            with trace_span("engine/dispatch", strategy=spec.strategy,
+                            rows=segment.n_rows, compile=compiled), \
+                    trace_span_when(compiled, "engine/compile",
+                                    kind="segment",
+                                    strategy=spec.strategy):
+                counts, states = fn(arrays, aux)
             break
         except Exception as e:
             if spec.strategy != "pallas":

@@ -1,0 +1,197 @@
+"""The single-source metrics catalog: every metric name the tree emits.
+
+The druidlint `metric-name` rule parses THIS file's METRICS dict literal and
+fails the build when any `emitter.metric("...")` literal is not declared
+here — metric-name drift (a renamed metric silently orphaning its dashboard)
+becomes a gate failure, the same discipline contracts.py applies to engine
+shape constants. Keep the dict a PLAIN LITERAL: the rule reads it with ast,
+no imports.
+
+Each entry: unit, the per-site dims (service/host are stamped on everything
+by ServiceEmitter and not repeated), the emitting site, and a help string
+(also the Prometheus # HELP text). `render_table()` produces the README's
+markdown table from the same data.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+METRICS = {
+    # ---- query lifecycle (server/lifecycle.py) -------------------------
+    "query/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "priority",
+                               "success"),
+        "site": "server/lifecycle.py, cluster/dataserver.py",
+        "help": "end-to-end query wall time"},
+    "query/wait/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id"),
+        "site": "server/lifecycle.py",
+        "help": "time queued for a scheduler slot before execution"},
+    "query/node/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "server"),
+        "site": "server/lifecycle.py (from broker/node trace spans)",
+        "help": "broker wait on one data node's response"},
+    "query/compile/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id"),
+        "site": "server/lifecycle.py (from engine/compile trace spans)",
+        "help": "jit-cache-miss compile time inside the query (absent on "
+                "cache-hit runs)"},
+    "query/stage/h2d/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id"),
+        "site": "server/lifecycle.py (from pool/h2d trace spans)",
+        "help": "device-pool cold-miss host-to-device staging time"},
+    # ---- per-segment serving (cluster/view.py) -------------------------
+    "query/segment/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "segment",
+                               "server"),
+        "site": "cluster/view.py",
+        "help": "uncached per-segment (or fused-set) execution wall time"},
+    "query/segmentAndCache/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "segment",
+                               "server"),
+        "site": "cluster/view.py",
+        "help": "per-segment serving time including cache hits"},
+    "query/cpu/time": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "segment",
+                               "server"),
+        "site": "cluster/view.py",
+        "help": "per-segment host CPU (thread) time"},
+    # ---- query counts (utils/emitter.py QueryCountStatsMonitor) --------
+    "query/count": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative queries served"},
+    "query/success/count": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative successful queries"},
+    "query/failed/count": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative failed queries"},
+    "query/count/delta": {
+        "unit": "count/period", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "queries served since the last monitor tick"},
+    "query/success/count/delta": {
+        "unit": "count/period", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "successes since the last monitor tick"},
+    "query/failed/count/delta": {
+        "unit": "count/period", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "failures since the last monitor tick"},
+    # ---- result/segment cache (utils/emitter.py CacheMonitor) ----------
+    "query/cache/total/hits": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative cache hits"},
+    "query/cache/total/misses": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative cache misses"},
+    "query/cache/total/evictions": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "cumulative cache evictions"},
+    "query/cache/total/entries": {
+        "unit": "count", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "current cache entry count"},
+    # ---- batched execution (engine/batching.py) ------------------------
+    "query/batch/segments": {
+        "unit": "count", "dims": (),
+        "site": "engine/batching.py",
+        "help": "segments fused into one batched dispatch"},
+    "query/batch/fillRatio": {
+        "unit": "ratio", "dims": (),
+        "site": "engine/batching.py",
+        "help": "real rows / padded slots of a batched dispatch"},
+    "query/batch/droppedEvents": {
+        "unit": "count", "dims": (),
+        "site": "engine/batching.py",
+        "help": "per-dispatch events lost to the bounded queue"},
+    # ---- device segment pool (data/devicepool.py) ----------------------
+    "segment/devicePool/hitRate": {
+        "unit": "ratio", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "pool hit rate over the monitor tick window"},
+    "segment/devicePool/hits": {
+        "unit": "count/period", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "pool hits since the last tick"},
+    "segment/devicePool/misses": {
+        "unit": "count/period", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "pool misses since the last tick"},
+    "segment/devicePool/evictedBytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "HBM bytes evicted since the last tick"},
+    "segment/devicePool/residentBytes": {
+        "unit": "bytes", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "HBM bytes currently pinned by pool entries"},
+    "segment/devicePool/entries": {
+        "unit": "count", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "current pool entry count"},
+    # ---- coordination (coordination/latch.py) --------------------------
+    "coordination/leader/transitions": {
+        "unit": "count", "dims": ("service", "node", "event", "term",
+                                  "leader"),
+        "site": "coordination/latch.py",
+        "help": "cumulative leadership transitions"},
+    "coordination/lease/ageMs": {
+        "unit": "ms", "dims": ("service", "node", "leader"),
+        "site": "coordination/latch.py",
+        "help": "age of the current leader lease"},
+    # ---- host/process (utils/emitter.py Sys/ProcessMonitor) ------------
+    "sys/cpu": {
+        "unit": "percent", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "host CPU utilization over the tick window"},
+    "sys/mem/used": {
+        "unit": "bytes", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "host memory in use"},
+    "sys/mem/max": {
+        "unit": "bytes", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "host memory total"},
+    "proc/rss": {
+        "unit": "bytes", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "this process's resident set size"},
+    "proc/cpu": {
+        "unit": "seconds", "dims": (),
+        "site": "utils/emitter.py",
+        "help": "this process's cumulative CPU time"},
+}
+
+
+def declared_names() -> List[str]:
+    return sorted(METRICS)
+
+
+def help_for(name: str) -> str:
+    m = METRICS.get(name)
+    if m is None:
+        return "(undeclared metric)"
+    return f"{m['help']} ({m['unit']})"
+
+
+def render_table() -> str:
+    """The catalog as a markdown table (README's Observability section)."""
+    lines = ["| metric | unit | dims | emitting site |",
+             "|---|---|---|---|"]
+    for name in sorted(METRICS):
+        m = METRICS[name]
+        dims = ", ".join(m["dims"]) if m["dims"] else "—"
+        lines.append(f"| `{name}` | {m['unit']} | {dims} | {m['site']} |")
+    return "\n".join(lines)
+
+
+def validate_emitted(names) -> List[str]:
+    """Names in `names` missing from the catalog (test helper)."""
+    return sorted(set(names) - set(METRICS))

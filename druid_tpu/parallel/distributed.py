@@ -34,6 +34,8 @@ from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
                                        needed_columns, plan_virtual_columns,
                                        windowed_window)
 from druid_tpu.engine.kernels import AggKernel, make_kernel
+from druid_tpu.obs.trace import span as trace_span
+from druid_tpu.obs.trace import span_when as trace_span_when
 from druid_tpu.parallel import context
 from druid_tpu.query.aggregators import AggregatorSpec
 from druid_tpu.utils.granularity import Granularity
@@ -223,6 +225,9 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                        len(intervals), vc_plans, K, R)
     with _CACHE_LOCK:
         fn = _FN_CACHE.get(sig)
+        # the miss IS the compile event (shard_map traces/compiles on the
+        # first call below) — timing stays at the existing dispatch boundary
+        compiled = fn is None
         if fn is None:
             fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
                                    kernels, vc_plans)
@@ -231,7 +236,10 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                 _FN_CACHE.popitem(last=False)
         else:
             _FN_CACHE.move_to_end(sig)
-    counts, states = fn(stacked, time0s, iv_rel, bucket_off, aux)
+    with trace_span("engine/sharded/dispatch", segments=K, devices=n_dev,
+                    compile=compiled), \
+            trace_span_when(compiled, "engine/compile", kind="sharded"):
+        counts, states = fn(stacked, time0s, iv_rel, bucket_off, aux)
 
     host_states = {k.name: k.host_from_device(st)
                    for k, st in zip(kernels, states)}

@@ -48,11 +48,19 @@ class QueryHttpServer:
 
     def __init__(self, lifecycle: QueryLifecycle, sql_executor=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 auth_chain=None, coordination=None, overlord=None):
+                 auth_chain=None, coordination=None, overlord=None,
+                 monitor_period_seconds: float = 60.0):
         """auth_chain: optional server.security.AuthChain — requests
         authenticate at the HTTP boundary (401 on failure) and the
         resulting AuthenticationResult flows into the lifecycle, whose
         authorizer makes the per-datasource decision (403).
+
+        Observability: a MetricRegistry always backs GET /metrics (the
+        lifecycle emitter's sink is composed with it, or a registry-only
+        ServiceEmitter is created), GET /druid/v2/trace/<queryId> serves
+        the assembled qtrace trace, and a QueryCountStatsMonitor is wired
+        into the lifecycle's on_result hook (chained with any existing
+        hook) so query success/failure counts emit per monitor tick.
 
         coordination: optional {"coordinator"|"overlord":
         LeaderParticipant} — adds the leader discovery endpoints
@@ -79,6 +87,39 @@ class QueryHttpServer:
         if sql_executor is not None:
             from druid_tpu.server.avatica import AvaticaServer
             self.avatica = AvaticaServer(sql_executor)
+
+        # ---- observability: /metrics registry + query-count monitor ----
+        from druid_tpu.obs.prometheus import MetricRegistry, compose_sink
+        from druid_tpu.utils.emitter import (MonitorScheduler,
+                                             QueryCountStatsMonitor,
+                                             ServiceEmitter)
+        self.registry = MetricRegistry()
+        # the sink rewrap + on_result chain below mutate the caller-owned
+        # lifecycle IN PLACE; stop() undoes both (guarded by identity) so
+        # a lifecycle reused across server generations doesn't accumulate
+        # dead registries and double-counting monitors
+        self._restore_sink = lambda: None
+        if lifecycle.emitter is not None:
+            self._restore_sink = compose_sink(lifecycle.emitter,
+                                              self.registry)
+            scrape_emitter = lifecycle.emitter
+        else:
+            scrape_emitter = ServiceEmitter("druid/broker", host,
+                                            self.registry)
+        self.query_counts = QueryCountStatsMonitor()
+        self._prev_on_result = prev_on_result = lifecycle.on_result
+        if prev_on_result is None:
+            lifecycle.on_result = self.query_counts.on_query
+        else:
+            def _chained(ok, _prev=prev_on_result,
+                         _qc=self.query_counts):
+                _prev(ok)
+                _qc.on_query(ok)
+            lifecycle.on_result = _chained
+        self._installed_on_result = lifecycle.on_result
+        self._monitors = MonitorScheduler(
+            scrape_emitter, [self.query_counts],
+            period_seconds=monitor_period_seconds)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -194,6 +235,29 @@ class QueryHttpServer:
                 if self.path == "/status":
                     self._reply(200, {"version": "druid-tpu-0.1",
                                       "modules": []})
+                elif self.path.rstrip("/") == "/metrics":
+                    # scrape surface: open like /status (Prometheus
+                    # scrapers do not carry Druid credentials)
+                    from druid_tpu.obs.prometheus import \
+                        CONTENT_TYPE as PROM_CTYPE
+                    data = outer.registry.exposition().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", PROM_CTYPE)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                elif self.path.startswith("/druid/v2/trace/"):
+                    if self._authenticated():
+                        import urllib.parse
+                        from druid_tpu.obs.trace import trace_store
+                        qid = urllib.parse.unquote(
+                            self.path[len("/druid/v2/trace/"):].rstrip("/"))
+                        got = trace_store().get(qid)
+                        if got is None:
+                            self._reply(404, {"error": "unknown trace",
+                                              "queryId": qid})
+                        else:
+                            self._reply(200, got)
                 elif self.path in ("/druid/v2/datasources",
                                    "/druid/v2/datasources/"):
                     if self._authenticated():
@@ -403,12 +467,24 @@ class QueryHttpServer:
         return self.auth_chain.authorize_all(
             identity, [ResourceAction(Resource(t), READ) for t in tables])
 
+    def metrics_tick(self) -> None:
+        """Drive the query-count monitor once (tests; the scheduler drives
+        it periodically after start())."""
+        self._monitors.tick()
+
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._monitors.start()
         return self
 
     def stop(self):
+        self._monitors.stop()
+        # un-chain what __init__ installed on the shared lifecycle — only
+        # if still ours (a later server generation may have re-chained)
+        if self.lifecycle.on_result is self._installed_on_result:
+            self.lifecycle.on_result = self._prev_on_result
+        self._restore_sink()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -16,6 +16,7 @@ import time
 import uuid
 from typing import Callable, List, Optional
 
+from druid_tpu.obs import trace as qtrace
 from druid_tpu.query.model import Query, query_from_json
 from druid_tpu.utils.emitter import ServiceEmitter
 
@@ -52,12 +53,16 @@ class QueryLifecycle:
                  request_logger: Optional[RequestLogger] = None,
                  authorizer: Optional[Callable[[Optional[str], Query], bool]] = None,
                  on_result: Optional[Callable[[bool], None]] = None,
-                 query_manager=None, scheduler=None):
+                 query_manager=None, scheduler=None,
+                 slow_query_ms: Optional[float] = None):
+        """slow_query_ms: queries slower than this emit an ALERT carrying
+        the full qtrace phase breakdown (the slow-query log); None = off."""
         self.runner = runner
         self.emitter = emitter
         self.request_logger = request_logger
         self.authorizer = authorizer          # (identity, query) → allowed
         self.on_result = on_result            # QueryCountStatsMonitor hook
+        self.slow_query_ms = slow_query_ms
         #: optional QueryScheduler: bounded priority-ordered admission
         #: (the PrioritizedExecutorService role, per query not per segment)
         self.scheduler = scheduler
@@ -83,10 +88,12 @@ class QueryLifecycle:
         token = self.query_manager.token(qid) \
             if self.query_manager is not None else None
         t0 = time.monotonic()
-        ok = self.scheduler.acquire(
-            priority=context_priority(query), lane=lane,
-            timeout=None if tmo is None else tmo / 1000.0,
-            should_abort=token.check if token is not None else None)
+        with qtrace.span("queue/wait", lane=lane or "",
+                         priority=context_priority(query)):
+            ok = self.scheduler.acquire(
+                priority=context_priority(query), lane=lane,
+                timeout=None if tmo is None else tmo / 1000.0,
+                should_abort=token.check if token is not None else None)
         if not ok:
             raise QueryTimeoutError(
                 "query timed out waiting for an execution slot")
@@ -160,12 +167,21 @@ class QueryLifecycle:
         query, qid = self._prepare(query, identity)
         t0 = time.monotonic()
         release = lambda: None
+        root = None
         try:
-            query, release = self._admit(query, qid)
-            rows = self.runner.run(query)
+            # the trace root (trace id = queryId): queue wait, broker
+            # phases, engine dispatches, and remote nodes' spans all
+            # assemble under it; {"trace": false} makes it a no-op
+            with qtrace.root_span(
+                    "query", query,
+                    service=self.emitter.service if self.emitter is not None
+                    else "druid/query") as root:
+                query, release = self._admit(query, qid)
+                rows = self.runner.run(query)
         except Exception as e:
             ms = (time.monotonic() - t0) * 1000
             self._log(query, qid, ms, False, error=str(e))
+            self._finish_trace(query, qid, ms, root)
             if self.on_result:
                 self.on_result(False)
             raise
@@ -175,9 +191,38 @@ class QueryLifecycle:
                 self.query_manager.unregister(qid)
         ms = (time.monotonic() - t0) * 1000
         self._log(query, qid, ms, True, n_rows=_count_rows(rows))
+        self._finish_trace(query, qid, ms, root)
         if self.on_result:
             self.on_result(True)
         return rows
+
+    def _finish_trace(self, query: Query, qid: str, ms: float,
+                      root) -> None:
+        """Phase-attributed per-query metrics from the assembled trace
+        (query/compile/time, query/stage/h2d/time, query/node/time) and the
+        slow-query log: a threshold breach emits an alert with the full
+        phase breakdown, so 'where did the 40 ms go' is answerable from the
+        metrics stream alone."""
+        if self.emitter is None:
+            return
+        # restrict to THIS run's subtree: a client-reused queryId lands
+        # several runs in one store entry, and summing across them would
+        # report phantom compile/node time on a cache-hit rerun
+        spans = qtrace.spans_under(root._store.spans(root.trace_id),
+                                   root.span_id) \
+            if root is not None and root._store is not None else []
+        if root is not None:
+            qtrace.emit_trace_metrics(self.emitter, query, qid, spans)
+        # the slow-query alert fires from the wall clock alone — a query
+        # opting out of TRACING ({"trace": false}) still breaches the
+        # threshold, it just alerts with an empty phase breakdown
+        if self.slow_query_ms is not None and ms > self.slow_query_ms:
+            self.emitter.alert(
+                "slow query: query/time above threshold",
+                queryId=qid, dataSource=query.datasource,
+                type=query.query_type, durationMs=round(ms, 3),
+                thresholdMs=self.slow_query_ms,
+                breakdown=qtrace.phase_breakdown(spans))
 
     def run_streaming(self, query: Query, identity: Optional[str] = None):
         """Streaming variant: authorize up front, yield result batches as

@@ -107,7 +107,13 @@ class FileEmitter(Emitter):
 class BatchingEmitter(Emitter):
     """Buffers events and hands batches to a sender callable — the
     HttpPostEmitter's batch/flush discipline with the transport abstracted
-    (a real deployment posts JSON arrays over HTTP)."""
+    (a real deployment posts JSON arrays over HTTP).
+
+    A background flush timer (daemon, joined on close()) drains the buffer
+    every `flush_seconds` even when NO further emit arrives — previously the
+    time-based path only fired on the next emit, so a trickle of events
+    could sit buffered forever. The timer thread acquires only self._lock
+    (briefly, to swap the buffer) and sends outside it — witness-clean."""
 
     def __init__(self, send: Callable[[List[dict]], None],
                  batch_size: int = 500, flush_seconds: float = 60.0):
@@ -117,6 +123,15 @@ class BatchingEmitter(Emitter):
         self._buf: List[dict] = []
         self._lock = threading.Lock()
         self._last_flush = time.monotonic()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True,
+                                         name="batching-emitter-flush")
+        self._flusher.start()
+
+    def _flush_loop(self):
+        while not self._stop.wait(self.flush_seconds):
+            self.flush()
 
     def emit(self, event):
         flush_now = False
@@ -136,6 +151,13 @@ class BatchingEmitter(Emitter):
             self.send(buf)
 
     def close(self):
+        """Stop AND join the flush timer before the final flush: a tick
+        mid-send while the owner tears down its transport would race."""
+        self._stop.set()
+        t = self._flusher
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=5.0)
         self.flush()
 
 
@@ -150,6 +172,12 @@ class ComposingEmitter(Emitter):
     def flush(self):
         for c in self.children:
             c.flush()
+
+    def close(self):
+        """Close children too — a composed FileEmitter's handle previously
+        leaked because only flush() propagated."""
+        for c in self.children:
+            c.close()
 
 
 class ServiceEmitter(Emitter):
@@ -257,11 +285,16 @@ class CacheMonitor(Monitor):
 
 
 class QueryCountStatsMonitor(Monitor):
-    """query success/failed counts (QueryCountStatsMonitor.java)."""
+    """query success/failed counts (QueryCountStatsMonitor.java): emits the
+    cumulative totals AND the per-period deltas since the last tick (the
+    reference's KeyedDiff semantics — rate dashboards read the deltas,
+    uptime counters the totals)."""
 
     def __init__(self):
         self.success = 0
         self.failed = 0
+        self._last_success = 0
+        self._last_failed = 0
         self._lock = threading.Lock()
 
     def on_query(self, ok: bool):
@@ -273,9 +306,16 @@ class QueryCountStatsMonitor(Monitor):
 
     def do_monitor(self, emitter):
         with self._lock:
-            emitter.metric("query/count", self.success + self.failed)
-            emitter.metric("query/success/count", self.success)
-            emitter.metric("query/failed/count", self.failed)
+            succ, fail = self.success, self.failed
+            d_succ = succ - self._last_success
+            d_fail = fail - self._last_failed
+            self._last_success, self._last_failed = succ, fail
+        emitter.metric("query/count", succ + fail)
+        emitter.metric("query/success/count", succ)
+        emitter.metric("query/failed/count", fail)
+        emitter.metric("query/count/delta", d_succ + d_fail)
+        emitter.metric("query/success/count/delta", d_succ)
+        emitter.metric("query/failed/count/delta", d_fail)
 
 
 class MonitorScheduler:

@@ -57,6 +57,9 @@ def test_bench_exits_zero_with_one_json_line():
     assert out["batched_rate"] > 0
     assert out["batch_speedup"] > 0
     assert out["batch_segments"] == 4
+    # the qtrace-overhead fields tracked across BENCH_r* runs
+    assert out["traced_rate"] > 0
+    assert out["untraced_rate"] > 0
 
 
 def test_bench_falls_back_to_cpu_on_bad_backend():

@@ -50,9 +50,13 @@ def test_wire_roundtrip_groupby(segments):
                             SelectorFilter("dimA", "v00000001"))],
         granularity="day")
     ap = engines.make_aggregate_partials(q, segments)
-    data = wire.dumps_partials(ap, served=[str(s.id) for s in segments])
-    ap2, served = wire.loads_partials(data)
+    data = wire.dumps_partials(ap, served=[str(s.id) for s in segments],
+                               trace=[{"traceId": "t", "spanId": "s",
+                                       "name": "datanode/query"}])
+    ap2, served, trace = wire.loads_partials(data)
     assert served == {str(s.id) for s in segments}
+    assert trace == [{"traceId": "t", "spanId": "s",
+                      "name": "datanode/query"}]
     assert engines.finish_groupby(q, ap2) == engines.finish_groupby(q, ap)
 
 

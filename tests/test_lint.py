@@ -97,6 +97,10 @@ VIOLATIONS = {
         "def f(self):\n"
         "    with self._lock:\n"
         "        time.sleep(1)\n"),
+    "metric-name": (
+        "druid_tpu/cluster/anything.py",
+        "def f(emitter):\n"
+        "    emitter.metric(\"query/typo/time\", 1.0)\n"),
     # ---- tracecheck rules ----
     "pallas-tile-shape": (
         "druid_tpu/engine/pallas_agg.py",
@@ -238,9 +242,9 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 
 
 def test_rule_registry_is_complete():
-    """All project rules (six control-plane + seven tracecheck + four
-    raceguard) plus the unused-suppression audit are registered with
-    severities."""
+    """All project rules (seven control-plane incl. metric-name + seven
+    tracecheck + four raceguard) plus the unused-suppression audit are
+    registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

@@ -1,0 +1,251 @@
+"""Observability metrics surfaces: the Prometheus registry + /metrics
+endpoints, the metrics catalog contract, the BatchingEmitter background
+flush fix, ComposingEmitter.close, and QueryCountStatsMonitor deltas."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from druid_tpu.obs import catalog
+from druid_tpu.obs.prometheus import MetricRegistry, metric_name
+from druid_tpu.utils.emitter import (BatchingEmitter, ComposingEmitter,
+                                     Event, FileEmitter, InMemoryEmitter,
+                                     QueryCountStatsMonitor, ServiceEmitter)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    """Exact text-format output: HELP/TYPE from the catalog, sorted label
+    sets, the high-cardinality `id` label dropped."""
+    reg = MetricRegistry()
+    em = ServiceEmitter("svc", "h1", reg)
+    em.metric("query/time", 12.5, dataSource="d", type="timeseries",
+              id="q-abc")
+    em.metric("segment/devicePool/entries", 3)
+    assert reg.exposition() == (
+        '# HELP druid_query_time end-to-end query wall time (ms)\n'
+        '# TYPE druid_query_time gauge\n'
+        'druid_query_time{dataSource="d",host="h1",service="svc",'
+        'type="timeseries"} 12.5\n'
+        '# HELP druid_segment_devicePool_entries current pool entry count '
+        '(count)\n'
+        '# TYPE druid_segment_devicePool_entries gauge\n'
+        'druid_segment_devicePool_entries{host="h1",service="svc"} 3\n')
+
+
+def test_prometheus_last_value_and_escaping():
+    reg = MetricRegistry()
+    em = ServiceEmitter("s", "h", reg)
+    em.metric("query/time", 1.0, dataSource='we"ird\nname')
+    em.metric("query/time", 2.0, dataSource='we"ird\nname')
+    text = reg.exposition()
+    assert text.count("druid_query_time{") == 1     # last value wins
+    assert r'dataSource="we\"ird\nname"' in text
+    assert " 2\n" in text
+
+
+def test_prometheus_series_cap():
+    reg = MetricRegistry(max_series=2)
+    em = ServiceEmitter("s", "h", reg)
+    for i in range(5):
+        em.metric("query/time", float(i), dataSource=f"d{i}")
+    assert reg.series_count() == 2
+    assert "druid_metric_registry_dropped_series 3" in reg.exposition()
+
+
+def test_metric_name_sanitization():
+    assert metric_name("query/batch/fillRatio") == \
+        "druid_query_batch_fillRatio"
+    assert metric_name("sys/mem-used") == "druid_sys_mem_used"
+
+
+def test_every_monitor_metric_is_cataloged():
+    """Drive every monitor against an in-memory sink and check the names it
+    emits are all declared — the runtime counterpart of the AST-level
+    metric-name lint rule."""
+    from druid_tpu.cluster import LruCache
+    from druid_tpu.data.devicepool import DevicePoolMonitor
+    from druid_tpu.engine.batching import BatchMetricsMonitor
+    from druid_tpu.utils.emitter import (CacheMonitor, MonitorScheduler,
+                                         ProcessMonitor, SysMonitor)
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("s", "h", sink)
+    qc = QueryCountStatsMonitor()
+    qc.on_query(True)
+    cache = LruCache()
+    cache.put("x", "k", 1)
+    sched = MonitorScheduler(
+        em, [SysMonitor(), ProcessMonitor(), qc, CacheMonitor(cache),
+             DevicePoolMonitor(), BatchMetricsMonitor()], 999)
+    sched.tick()
+    sched.tick()
+    missing = catalog.validate_emitted(e.metric for e in sink.metrics())
+    assert not missing, f"monitors emit uncataloged metrics: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# QueryCountStatsMonitor: per-period deltas alongside cumulative counts
+# ---------------------------------------------------------------------------
+
+def test_query_count_deltas_per_period():
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("s", "h", sink)
+    qc = QueryCountStatsMonitor()
+    qc.on_query(True)
+    qc.on_query(True)
+    qc.on_query(False)
+    qc.do_monitor(em)
+    qc.on_query(True)
+    qc.do_monitor(em)
+    qc.do_monitor(em)       # idle tick: zero deltas, stable cumulatives
+    assert [e.value for e in sink.metrics("query/count")] == [3, 4, 4]
+    assert [e.value for e in sink.metrics("query/count/delta")] == [3, 1, 0]
+    assert [e.value for e in
+            sink.metrics("query/success/count/delta")] == [2, 1, 0]
+    assert [e.value for e in
+            sink.metrics("query/failed/count/delta")] == [1, 0, 0]
+
+
+def test_broker_http_wires_query_counts(segments):
+    """The broker server path calls on_query: a query through the HTTP
+    resource shows up in the monitor's counts and on GET /metrics."""
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+    lc = QueryLifecycle(QueryExecutor(list(segments)))
+    http = QueryHttpServer(lc).start()
+    try:
+        payload = {"queryType": "timeseries", "dataSource": "test",
+                   "intervals": ["2026-01-01/2026-01-08"],
+                   "granularity": "all",
+                   "aggregations": [{"type": "count", "name": "rows"}]}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/druid/v2",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        assert http.query_counts.success == 1
+        http.metrics_tick()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/metrics") as r:
+            text = r.read().decode()
+            ctype = r.headers.get("Content-Type", "")
+        assert "text/plain" in ctype
+        lines = text.splitlines()
+        assert any(ln.startswith("druid_query_success_count{")
+                   and ln.endswith(" 1") for ln in lines), text
+        assert any(ln.startswith("druid_query_count_delta{")
+                   and ln.endswith(" 1") for ln in lines), text
+    finally:
+        http.stop()
+
+
+def test_broker_http_chains_existing_on_result(segments):
+    """Wiring the monitor must not clobber a caller-supplied on_result."""
+    from druid_tpu.engine import QueryExecutor
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+    seen = []
+    lc = QueryLifecycle(QueryExecutor(list(segments)),
+                        on_result=seen.append)
+    http = QueryHttpServer(lc).start()
+    try:
+        lc.run_json({"queryType": "timeseries", "dataSource": "test",
+                     "intervals": ["2026-01-01/2026-01-08"],
+                     "granularity": "all",
+                     "aggregations": [{"type": "count", "name": "rows"}]})
+        assert seen == [True]
+        assert http.query_counts.success == 1
+    finally:
+        http.stop()
+
+
+def test_data_node_metrics_endpoint(segments):
+    """GET /metrics on a data node: Prometheus text including query/time
+    and the devicePool gauges (the ISSUE's acceptance surface)."""
+    from druid_tpu.cluster import (DataNode, DataNodeServer,
+                                   RemoteDataNodeClient, descriptor_for)
+    from druid_tpu.query.aggregators import CountAggregator
+    from druid_tpu.query.model import TimeseriesQuery
+    from druid_tpu.utils.intervals import Interval
+    node = DataNode("promnode")
+    srv = DataNodeServer(node).start()
+    try:
+        for s in segments:
+            node.load_segment(s)
+        client = RemoteDataNodeClient(node.name, srv.url)
+        q = TimeseriesQuery.of(
+            "test", [Interval.of("2026-01-01", "2026-01-08")],
+            [CountAggregator("rows")],
+            context={"queryId": "prom-1"})
+        client.run_partials(q, [str(s.id) for s in segments])
+        srv.metrics_tick()
+        with urllib.request.urlopen(srv.url + "/metrics") as r:
+            text = r.read().decode()
+        assert 'druid_query_time{' in text
+        assert 'success="true"' in text
+        assert "druid_segment_devicePool_residentBytes" in text
+        assert "druid_segment_devicePool_entries" in text
+        assert any(ln.startswith("druid_query_count{")
+                   and ln.endswith(" 1") for ln in text.splitlines()), text
+    finally:
+        srv.stop()
+
+
+def test_data_node_composes_caller_emitter(segments):
+    """A caller-supplied emitter keeps receiving events AND the registry
+    sees them (the sink is composed, not replaced)."""
+    from druid_tpu.cluster import DataNode, DataNodeServer
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("historical", "h", sink)
+    srv = DataNodeServer(DataNode("cnode"), emitter=em).start()
+    try:
+        srv.metrics_tick()
+        assert sink.metrics("segment/devicePool/entries")
+        assert "druid_segment_devicePool_entries" in \
+            srv.registry.exposition()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# BatchingEmitter background flush + ComposingEmitter.close
+# ---------------------------------------------------------------------------
+
+def test_batching_emitter_background_flush():
+    """A trickle below batch_size must reach the sender WITHOUT further
+    emits — the background timer fires on flush_seconds (the bug was that
+    the time-based path only ran inside emit())."""
+    sent = []
+    be = BatchingEmitter(sent.append, batch_size=100, flush_seconds=0.05)
+    try:
+        be.emit(Event("metric", "query/time", 1.0, 0))
+        deadline = time.monotonic() + 5.0
+        while not sent and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sent and sent[0][0]["metric"] == "query/time"
+    finally:
+        be.close()
+
+
+def test_batching_emitter_close_joins_and_flushes():
+    sent = []
+    be = BatchingEmitter(sent.append, batch_size=100, flush_seconds=60.0)
+    be.emit(Event("metric", "query/time", 1.0, 0))
+    be.close()
+    assert sent and len(sent[0]) == 1
+    assert not be._flusher.is_alive()
+
+
+def test_composing_emitter_closes_children(tmp_path):
+    """close() must propagate: a composed FileEmitter's handle previously
+    leaked open."""
+    f1 = FileEmitter(str(tmp_path / "a.log"))
+    f2 = FileEmitter(str(tmp_path / "b.log"))
+    comp = ComposingEmitter([f1, f2])
+    comp.emit(Event("metric", "query/time", 1.0, 0))
+    comp.close()
+    assert f1._fh.closed and f2._fh.closed

@@ -1,0 +1,324 @@
+"""qtrace: end-to-end distributed query tracing.
+
+The load-bearing assertions: one distributed query against a broker
+fronting 2 REAL DataNodeServers (own TraceStores, so node spans can only
+reach the broker over the wire) yields ONE assembled trace with correct
+cross-process parentage; the first run of a query shows an engine/compile
+span where the second (jit-cache-hit) run shows none; {"trace": false}
+yields no spans anywhere; the store is a bounded ring."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from druid_tpu.cluster import (Broker, DataNode, DataNodeServer,
+                               InventoryView, RemoteDataNodeClient,
+                               descriptor_for)
+from druid_tpu.engine import QueryExecutor, batching, grouping
+from druid_tpu.obs import trace as qtrace
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery, \
+    TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+AGGS = [CountAggregator("rows"), LongSumAggregator("ls", "metLong")]
+
+
+def _clear_jit_caches():
+    """Fresh compile state so compile-vs-cached attribution is
+    deterministic regardless of what earlier tests jitted."""
+    with grouping._JIT_CACHE_LOCK:
+        grouping._JIT_CACHE.clear()
+    with batching._JIT_CACHE_LOCK:
+        batching._JIT_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# Span model unit behavior
+# ---------------------------------------------------------------------------
+
+def test_span_noop_without_root():
+    """No open root → span() must yield None and record nothing (the
+    untraced hot path pays one thread-local read)."""
+    with qtrace.span("engine/dispatch") as s:
+        assert s is None
+    assert qtrace.current_span() is None
+
+
+def test_root_and_children_nest():
+    store = qtrace.TraceStore()
+    with qtrace.root_span("query", service="svc", store=store,
+                          queryId="t-nest") as root:
+        assert root is not None and qtrace.current_span() is root
+        with qtrace.span("child", k=1) as c:
+            assert c.parent_id == root.span_id
+            assert c.trace_id == root.trace_id
+            assert c.service == "svc"
+    got = store.get(root.trace_id)
+    # get() sorts by start time: the root starts before its child
+    assert [s["name"] for s in got["spans"]] == ["query", "child"]
+    assert all(s["durationMs"] >= 0 for s in got["spans"])
+
+
+def test_attach_propagates_across_threads():
+    store = qtrace.TraceStore()
+    seen = {}
+    with qtrace.root_span("query", service="svc", store=store) as root:
+        def worker():
+            with qtrace.attach(root), qtrace.span("worker") as s:
+                seen["span"] = s
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["span"].parent_id == root.span_id
+
+
+def test_traceparent_reroot_and_opt_out():
+    store = qtrace.TraceStore()
+    q = TimeseriesQuery.of("t", [WEEK], AGGS,
+                           context={"queryId": "qq",
+                                    "traceparent": "remote-trace:abc123"})
+    with qtrace.root_span("datanode/query", q, service="n",
+                          store=store) as root:
+        assert root.trace_id == "remote-trace"
+        assert root.parent_id == "abc123"
+    off = TimeseriesQuery.of("t", [WEEK], AGGS,
+                             context={"queryId": "qq", "trace": False})
+    with qtrace.root_span("datanode/query", off, service="n",
+                          store=store) as root:
+        assert root is None
+
+
+def test_trace_store_ring_eviction():
+    store = qtrace.TraceStore(max_traces=3, max_spans_per_trace=2)
+    for i in range(5):
+        store.add_json({"traceId": f"t{i}", "spanId": f"s{i}", "name": "x",
+                        "startMs": i})
+    assert store.trace_ids() == ["t2", "t3", "t4"]
+    assert store.get("t0") is None
+    # span cap: extra spans counted, not kept; duplicates deduped
+    for j in range(4):
+        store.add_json({"traceId": "t4", "spanId": f"extra{j}", "name": "y",
+                        "startMs": j})
+    store.add_json({"traceId": "t4", "spanId": "s4", "name": "dup",
+                    "startMs": 0})
+    got = store.get("t4")
+    assert got["spanCount"] == 2 and got["droppedSpans"] == 3
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: broker fronting 2 remote data nodes over real sockets
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def traced_cluster(segments):
+    """2 DataNodeServers with their OWN TraceStores: their spans can reach
+    the broker's process store only via the response payload — the test
+    proves wire propagation, not shared-memory accident."""
+    view = InventoryView()
+    nodes = [DataNode(f"tnode{i}") for i in range(2)]
+    servers = []
+    node_stores = []
+    for node in nodes:
+        st = qtrace.TraceStore()
+        node_stores.append(st)
+        srv = DataNodeServer(node, trace_store=st).start()
+        servers.append(srv)
+        view.register(RemoteDataNodeClient(node.name, srv.url))
+    for i, s in enumerate(segments):
+        nodes[i % 2].load_segment(s)
+        view.announce(nodes[i % 2].name, descriptor_for(s))
+    broker = Broker(view)
+    yield nodes, servers, node_stores, broker
+    for srv in servers:
+        srv.stop()
+
+
+def _groupby(qid, **ctx):
+    return GroupByQuery.of(
+        "test", [WEEK], [DefaultDimensionSpec("dimA")], AGGS,
+        granularity="day", context={"queryId": qid, **ctx})
+
+
+def test_distributed_trace_assembly(traced_cluster):
+    nodes, servers, node_stores, broker = traced_cluster
+    _clear_jit_caches()
+    broker.run(_groupby("trace-e2e-1"))
+    tr = qtrace.trace_store().get("trace-e2e-1")
+    assert tr is not None and tr["traceId"] == "trace-e2e-1"
+    spans = tr["spans"]
+    by_id = {s["spanId"]: s for s in spans}
+    names = [s["name"] for s in spans]
+
+    # broker phases present
+    for phase in ("broker/query", "broker/plan", "broker/scatter",
+                  "broker/node", "broker/merge"):
+        assert phase in names, f"missing {phase} in {sorted(set(names))}"
+    # BOTH nodes' remote spans made it back over the wire
+    node_roots = [s for s in spans if s["name"] == "datanode/query"]
+    assert {s["service"] for s in node_roots} == {"tnode0", "tnode1"}
+    # parentage: every span except the single root resolves to a parent in
+    # the SAME assembled trace; node roots hang off broker/node spans
+    roots = [s for s in spans if s["parentId"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "broker/query"
+    for s in spans:
+        if s["parentId"] is not None:
+            assert s["parentId"] in by_id, f"orphan span {s['name']}"
+    for nr in node_roots:
+        assert by_id[nr["parentId"]]["name"] == "broker/node"
+    # engine phases attributed under the nodes (pool/h2d is asserted in
+    # test_lifecycle_emits_phase_metrics with FRESH segments — the session
+    # fixtures' segments may already be HBM-resident here)
+    assert "engine/partials" in names
+    # compile happened somewhere on the first run (jit caches cleared)
+    assert "engine/compile" in names
+
+    # node-local store only ever saw that node's own spans
+    for st, node in zip(node_stores, nodes):
+        local = st.spans("trace-e2e-1")
+        assert local and all(s["service"] == node.name for s in local)
+
+
+def test_compile_vs_cached_attribution(traced_cluster):
+    """First run of an identical query compiles; the second hits the jit
+    caches — its trace must contain NO engine/compile span (and emit no
+    query/compile/time)."""
+    nodes, servers, node_stores, broker = traced_cluster
+    _clear_jit_caches()
+    broker.run(_groupby("compile-1"))
+    broker.run(_groupby("compile-2"))
+    first = [s["name"] for s in qtrace.trace_store().spans("compile-1")]
+    second = [s["name"] for s in qtrace.trace_store().spans("compile-2")]
+    assert "engine/compile" in first
+    assert "engine/compile" not in second
+    # both still executed (dispatch spans present)
+    assert any(n.startswith("engine/") for n in second)
+
+
+def test_trace_false_yields_no_spans(traced_cluster):
+    nodes, servers, node_stores, broker = traced_cluster
+    broker.run(_groupby("trace-off-1", trace=False))
+    assert qtrace.trace_store().get("trace-off-1") is None
+    for st in node_stores:
+        assert st.get("trace-off-1") is None
+
+
+def test_trace_endpoint_on_data_node(traced_cluster, segments):
+    """GET /druid/v2/trace/<queryId> on a data node serves its span tree."""
+    nodes, servers, node_stores, broker = traced_cluster
+    broker.run(_groupby("node-endpoint-1"))
+    with urllib.request.urlopen(
+            servers[0].url + "/druid/v2/trace/node-endpoint-1") as r:
+        got = json.loads(r.read())
+    assert got["traceId"] == "node-endpoint-1"
+    assert all(s["service"] == nodes[0].name for s in got["spans"])
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            servers[0].url + "/druid/v2/trace/no-such-query")
+    assert ei.value.code == 404
+
+
+def test_trace_endpoint_on_broker_http(traced_cluster):
+    """The broker's QueryHttpServer serves the ASSEMBLED trace — broker
+    spans AND both nodes' remote spans — for a query run through it."""
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+    nodes, servers, node_stores, broker = traced_cluster
+    http = QueryHttpServer(QueryLifecycle(broker)).start()
+    try:
+        payload = _groupby("http-trace-1").to_json()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/druid/v2",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/druid/v2/trace/http-trace-1"
+                ) as r:
+            got = json.loads(r.read())
+        names = {s["name"] for s in got["spans"]}
+        assert "query" in names          # the lifecycle root
+        assert "broker/node" in names
+        assert "datanode/query" in names
+        services = {s["service"] for s in got["spans"]}
+        assert {"tnode0", "tnode1"} <= services
+    finally:
+        http.stop()
+
+
+# ---------------------------------------------------------------------------
+# Local (single-process) tracing + per-query phase metrics
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_emits_phase_metrics():
+    """query/compile/time + query/stage/h2d/time emit on the compiling
+    first run and NOT on the cache-hit second run; broker/node spans feed
+    query/node/time. FRESH segments so the device pool is cold (the
+    session fixtures' segments are already HBM-resident)."""
+    from druid_tpu.data.generator import ColumnSpec, DataGenerator
+    from druid_tpu.server import QueryLifecycle
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+    gen = DataGenerator((ColumnSpec("dimA", "string", cardinality=10),
+                         ColumnSpec("metLong", "long", low=0, high=100)),
+                        seed=99)
+    fresh = gen.segments(2, 1000, Interval.of("2026-01-01", "2026-01-03"),
+                         datasource="test")
+    view = InventoryView()
+    node = DataNode("mnode")
+    view.register(node)
+    for s in fresh:
+        node.load_segment(s)
+        view.announce(node.name, descriptor_for(s))
+    broker = Broker(view)
+    sink = InMemoryEmitter()
+    lc = QueryLifecycle(broker, ServiceEmitter("broker", "h", sink))
+    _clear_jit_caches()
+    lc.run(_groupby("metrics-1"))
+    lc.run(_groupby("metrics-2"))
+    compile_events = sink.metrics("query/compile/time")
+    assert [e.dims["id"] for e in compile_events] == ["metrics-1"]
+    h2d_events = sink.metrics("query/stage/h2d/time")
+    assert [e.dims["id"] for e in h2d_events] == ["metrics-1"]
+    node_events = sink.metrics("query/node/time")
+    assert {e.dims["id"] for e in node_events} == {"metrics-1", "metrics-2"}
+    assert all(e.dims["server"] == "mnode" for e in node_events)
+
+
+def test_slow_query_log_threshold(segments):
+    """Queries over the threshold emit an alert with the full phase
+    breakdown; under it, nothing."""
+    from druid_tpu.server import QueryLifecycle
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+    sink = InMemoryEmitter()
+    lc = QueryLifecycle(QueryExecutor(list(segments)),
+                        ServiceEmitter("broker", "h", sink),
+                        slow_query_ms=0.0)     # everything is slow
+    lc.run(_groupby("slow-1"))
+    alerts = [e for e in sink.events if e.kind == "alert"]
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a.dims["queryId"] == "slow-1"
+    assert isinstance(a.dims["breakdown"], dict) and a.dims["breakdown"]
+    assert all(v >= 0 for v in a.dims["breakdown"].values())
+
+    sink2 = InMemoryEmitter()
+    lc2 = QueryLifecycle(QueryExecutor(list(segments)),
+                         ServiceEmitter("broker", "h", sink2),
+                         slow_query_ms=1e9)    # nothing is slow
+    lc2.run(_groupby("slow-2"))
+    assert not [e for e in sink2.events if e.kind == "alert"]
+
+    # opting out of TRACING must not opt out of the slow-query alert —
+    # it fires from the wall clock, just with an empty breakdown
+    sink3 = InMemoryEmitter()
+    lc3 = QueryLifecycle(QueryExecutor(list(segments)),
+                         ServiceEmitter("broker", "h", sink3),
+                         slow_query_ms=0.0)
+    lc3.run(_groupby("slow-3", trace=False))
+    alerts3 = [e for e in sink3.events if e.kind == "alert"]
+    assert len(alerts3) == 1
+    assert alerts3[0].dims["queryId"] == "slow-3"
+    assert alerts3[0].dims["breakdown"] == {}

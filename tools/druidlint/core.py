@@ -125,6 +125,11 @@ _DEFAULT_CONFIG = {
     # join the static order graph, so they participate in cycle detection
     # and explain dynamic-witness observations
     "raceguard-assume-edges": [],
+    # metric-name: modules whose emitter.metric("...") literals must be
+    # declared in the metrics catalog
+    "metric-modules": ["druid_tpu/*"],
+    # metric-name: the single-source metrics catalog (METRICS dict literal)
+    "metrics-catalog": "druid_tpu/obs/catalog.py",
     # unused-suppression audit (CLI --report-unused-suppressions)
     "report-unused-suppressions": False,
 }
@@ -160,6 +165,9 @@ class LintConfig:
     raceguard_assume_edges: List[str] = field(
         default_factory=lambda: list(
             _DEFAULT_CONFIG["raceguard-assume-edges"]))
+    metric_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["metric-modules"]))
+    metrics_catalog: str = _DEFAULT_CONFIG["metrics-catalog"]
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
     #: (set by load_config/lint_paths, not a pyproject key)

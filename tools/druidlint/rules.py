@@ -8,7 +8,8 @@ wire), that boundary is configuration, not guesswork.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.druidlint.core import Finding, ModuleContext, rule
 
@@ -392,6 +393,75 @@ def _enclosed_in_deferred(ctx: ModuleContext, node: ast.AST,
             return True
         cur = ctx.parent(cur)
     return False
+
+
+# ---- metric-name ----------------------------------------------------------
+
+#: parsed catalogs keyed by absolute path; value = ((mtime_ns, size), names)
+_CATALOG_CACHE: Dict[str, Tuple[Tuple[int, int], frozenset]] = {}
+
+
+def _catalog_names(root: str, rel: str) -> frozenset:
+    """Metric names declared in the catalog module's METRICS dict literal
+    (config `metrics-catalog`). Read with ast — no project imports — and
+    memoized on (mtime, size). A missing/unparseable catalog declares
+    nothing, so every emitted literal is flagged (the gate fails loudly
+    instead of silently passing)."""
+    p = Path(root) / rel
+    try:
+        st = p.stat()
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return frozenset()
+    hit = _CATALOG_CACHE.get(str(p))
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    try:
+        tree = ast.parse(p.read_text())
+    except (OSError, SyntaxError):
+        return frozenset()
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "METRICS"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    names.add(k.value)
+    out = frozenset(names)
+    _CATALOG_CACHE[str(p)] = (key, out)
+    return out
+
+
+@rule("metric-name", "error",
+      "emitted metric name not declared in the obs/catalog.py catalog")
+def check_metric_name(ctx: ModuleContext) -> Iterable[Finding]:
+    """Every `emitter.metric("...")` literal in modules matching config
+    `metric-modules` must be declared in the single metrics catalog
+    (config `metrics-catalog`, default druid_tpu/obs/catalog.py) — a
+    renamed or typoed metric name silently orphans its dashboards and
+    alerts; the catalog makes the name set a reviewed, single-source
+    surface. Non-literal names are not checkable and pass."""
+    if not ctx.path_matches(ctx.config.metric_modules):
+        return
+    cat_rel = ctx.config.metrics_catalog
+    if ctx.path == cat_rel:
+        return
+    declared = _catalog_names(ctx.config.root, cat_rel)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "metric" \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+            if name not in declared:
+                yield ctx.finding(
+                    node, f"metric {name!r} is not declared in {cat_rel} — "
+                          f"add it to METRICS (name, unit, dims, site) or "
+                          f"fix the name drift")
 
 
 # ---- unused-suppression ---------------------------------------------------
