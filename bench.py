@@ -27,6 +27,9 @@ Environment:
   DRUID_TPU_BENCH_BATCH_SEGMENTS  segments in the batch comparison (default 16)
   DRUID_TPU_BENCH_BATCH_ROWS      rows PER SEGMENT there (default 4096)
   DRUID_TPU_BENCH_INIT_TIMEOUT    backend-init watchdog seconds (default 600)
+  DRUID_TPU_BENCH_CLIENTS         concurrent closed-loop clients (default 8)
+  DRUID_TPU_BENCH_CLIENT_QUERIES  queries per client per mode (default 12)
+  DRUID_TPU_BENCH_SCHED_ROWS      rows per segment in that mode (default 4096)
 """
 import json
 import os
@@ -282,6 +285,103 @@ def _bench_tracing(iters: int):
     }
 
 
+def _bench_scheduler():
+    """Closed-loop concurrent-client mode: N clients each issue M SMALL
+    queries (one segment apiece — too small for within-query batching, the
+    'thousands of small concurrent queries on one hot datasource' shape)
+    against a data node, once through the admission-control scheduler
+    (cross-query fusion) and once direct. Reports aggregate rows/s and
+    per-query p50/p99 latency for both modes — the scheduler's win is the
+    cross-query dispatch amortization, its cost is the batching window."""
+    import threading
+
+    from druid_tpu.cluster.view import DataNode
+    from druid_tpu.server.scheduler import (DataNodeScheduler,
+                                            SchedulerConfig)
+
+    n_clients = int(os.environ.get("DRUID_TPU_BENCH_CLIENTS", 8))
+    n_queries = int(os.environ.get("DRUID_TPU_BENCH_CLIENT_QUERIES", 12))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_SCHED_ROWS", 4096))
+    n_segments = max(n_clients, 8)
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    node = DataNode("bench-node")
+    for s in segments:
+        node.load_segment(s)
+    sids = [str(s.id) for s in segments]
+    query = batch_groupby()
+
+    def run_mode(use_sched: bool):
+        sched = None
+        if use_sched:
+            sched = DataNodeScheduler(
+                node, SchedulerConfig(batch_window_ms=3.0,
+                                      max_queue_depth=4 * n_clients,
+                                      lane_depths={})).start()
+        lat_ms = [[] for _ in range(n_clients)]
+        barrier = threading.Barrier(n_clients)
+
+        def client(ci: int, record: bool):
+            barrier.wait()
+            for k in range(n_queries):
+                sid = [sids[(ci + k) % n_segments]]
+                t = time.time()
+                if sched is not None:
+                    sched.submit(query, sid)
+                else:
+                    node.run_partials(query, sid)
+                if record:
+                    lat_ms[ci].append((time.time() - t) * 1e3)
+
+        def wave(record: bool) -> float:
+            threads = [threading.Thread(target=client, args=(ci, record))
+                       for ci in range(n_clients)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.time() - t0
+
+        try:
+            # warm waves: flush composition is timing-dependent (chunk
+            # size K is a compile key), so no warmup can GUARANTEE every
+            # shape the recorded wave will hit — two waves cover the
+            # common ones and a stray compile shows up as a p99 outlier,
+            # not a shifted p50
+            wave(record=False)
+            wave(record=False)
+            wall = wave(record=True)
+        finally:
+            if sched is not None:
+                sched.stop()
+        lats = sorted(x for per in lat_ms for x in per)
+        seg_rows = {str(s.id): s.n_rows for s in segments}
+        total_rows = sum(seg_rows[sids[(ci + k) % n_segments]]
+                         for ci in range(n_clients)
+                         for k in range(n_queries))
+        return {
+            "rate": total_rows / wall,
+            "p50_ms": lats[len(lats) // 2],
+            "p99_ms": lats[min(len(lats) - 1, int(len(lats) * 0.99))],
+        }
+
+    off = run_mode(use_sched=False)
+    on = run_mode(use_sched=True)
+    for label, r in (("off", off), ("on", on)):
+        log(f"sched-bench {label}: {r['rate'] / 1e6:.1f}M rows/s "
+            f"p50 {r['p50_ms']:.1f}ms p99 {r['p99_ms']:.1f}ms")
+    return {
+        "sched_clients": n_clients,
+        "sched_off_rate": round(off["rate"], 0),
+        "sched_on_rate": round(on["rate"], 0),
+        "sched_speedup": round(on["rate"] / off["rate"], 2),
+        "sched_off_p50_ms": round(off["p50_ms"], 2),
+        "sched_off_p99_ms": round(off["p99_ms"], 2),
+        "sched_on_p50_ms": round(on["p50_ms"], 2),
+        "sched_on_p99_ms": round(on["p99_ms"], 2),
+    }
+
+
 def main():
     rows = int(os.environ.get("DRUID_TPU_BENCH_ROWS", 100_000_000))
     n_segments = int(os.environ.get("DRUID_TPU_BENCH_SEGMENTS", 8))
@@ -340,6 +440,11 @@ def main():
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"trace-bench failed: {type(e).__name__}: {e}")
         traced = {"trace_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        sched = _bench_scheduler()
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"sched-bench failed: {type(e).__name__}: {e}")
+        sched = {"sched_error": f"{type(e).__name__}: {e}"[:200]}
 
     value = 2 * total_rows / (t_gb + t_tn)
     baseline = 36_246_530.0  # Java rows/sec/core scan-aggregate upper bound
@@ -353,6 +458,7 @@ def main():
     }
     out.update(batch)
     out.update(traced)
+    out.update(sched)
     print(json.dumps(out), flush=True)
 
 

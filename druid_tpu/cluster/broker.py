@@ -35,7 +35,8 @@ from druid_tpu.query.model import (DataSourceMetadataQuery, GroupByQuery,
                                    SegmentMetadataQuery, SelectQuery,
                                    TimeBoundaryQuery, TimeseriesQuery,
                                    TopNQuery, query_from_json)
-from druid_tpu.server.querymanager import (Deadline, QueryInterruptedError,
+from druid_tpu.server.querymanager import (Deadline, QueryCapacityError,
+                                           QueryInterruptedError,
                                            QueryManager, QueryTimeoutError)
 from druid_tpu.utils.intervals import Interval, condense
 
@@ -413,6 +414,14 @@ class Broker:
                         return server, sids, ap, served
                     except (QueryInterruptedError, QueryTimeoutError):
                         raise  # cancel/deadline: abort the whole scatter
+                    except QueryCapacityError:
+                        # the node shed the query and the client's one
+                        # Retry-After retry was shed again: the cluster is
+                        # saturated — fail fast with the clear capacity
+                        # error (429 at the resource layer) instead of
+                        # hammering other replicas with work the tier
+                        # cannot absorb
+                        raise
                     except ConnectionError:
                         # unreachable server: plain failover; exhausting
                         # replicas is a MissingSegmentsError

@@ -38,9 +38,13 @@ from druid_tpu.obs.prometheus import MetricRegistry, compose_sink
 from druid_tpu.query.model import Query, query_from_json
 from druid_tpu.server.http import _json_value
 from druid_tpu.server.querymanager import (DEFAULT_TIMEOUT_MS, Deadline,
+                                           QueryCapacityError,
                                            QueryInterruptedError,
                                            QueryManager, QueryTimeoutError,
                                            cancel_path_id)
+from druid_tpu.server.scheduler import (DataNodeScheduler,
+                                        SchedulerConfig,
+                                        SchedulerMetricsMonitor)
 from druid_tpu.utils.emitter import (QueryCountStatsMonitor,
                                      ServiceEmitter)
 
@@ -75,14 +79,22 @@ class DataNodeServer:
                  port: int = 0, emitter=None,
                  device_pool_bytes: Optional[int] = None,
                  monitor_period_seconds: float = 60.0,
-                 trace_store: Optional[qtrace.TraceStore] = None):
+                 trace_store: Optional[qtrace.TraceStore] = None,
+                 scheduler_config: Optional[SchedulerConfig] = None):
         """`trace_store` (default: the process singleton) receives this
         node's qtrace spans and backs GET /druid/v2/trace/<queryId>; a
         MetricRegistry always backs GET /metrics — the given `emitter`'s
         sink is composed with it, or a registry-only ServiceEmitter is
-        created so every data node is scrapeable out of the box."""
+        created so every data node is scrapeable out of the box.
+
+        `scheduler_config` turns on the admission-controlled cross-query
+        scheduler (server/scheduler.py): aggregate /partials requests are
+        held for the batching window and fused across queries; saturation
+        answers HTTP 429 + Retry-After instead of queueing unboundedly."""
         self.node = node
         self.query_manager = QueryManager()
+        self.scheduler: Optional[DataNodeScheduler] = None
+        self._scheduler_config = scheduler_config
         self.trace_store = trace_store if trace_store is not None \
             else qtrace.trace_store()
         self.registry = MetricRegistry()
@@ -96,21 +108,25 @@ class DataNodeServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code: int, ctype: str, data: bytes):
+            def _send(self, code: int, ctype: str, data: bytes,
+                      headers=None):
                 # the client may have hung up already (its own timeout
                 # fired) — a late reply to a dead socket is not an error
                 try:
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(data)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
                     self.end_headers()
                     self.wfile.write(data)
                 except (BrokenPipeError, ConnectionResetError):
                     self.close_connection = True
 
-            def _reply_json(self, code: int, body):
+            def _reply_json(self, code: int, body, headers=None):
                 self._send(code, "application/json",
-                           json.dumps(body, default=_json_value).encode())
+                           json.dumps(body, default=_json_value).encode(),
+                           headers=headers)
 
             def _reply_bytes(self, data: bytes):
                 self._send(200, wire.CONTENT_TYPE, data)
@@ -163,6 +179,14 @@ class DataNodeServer:
                 except QueryTimeoutError as e:
                     self._reply_json(504, {"error": "Query timed out",
                                            "errorMessage": str(e)})
+                except QueryCapacityError as e:
+                    # the scheduler shed at admission: the 429 contract —
+                    # not a hang, not a 500 — with the drain estimate as
+                    # Retry-After so a well-behaved client backs off
+                    self._reply_json(
+                        429, {"error": "Query capacity exceeded",
+                              "errorMessage": str(e)},
+                        headers={"Retry-After": e.retry_after_header()})
                 except (ValueError, KeyError) as e:
                     self._reply_json(400,
                                      {"error": f"{type(e).__name__}: {e}"})
@@ -198,6 +222,17 @@ class DataNodeServer:
                         check()
                         if rows_mode:
                             out = outer.node.run_rows(query, sids)
+                        elif outer.scheduler is not None \
+                                and outer.node.fusable(query):
+                            # admission-controlled cross-query path: the
+                            # hold opens a queue/wait span under THIS
+                            # request's root; saturation raises
+                            # QueryCapacityError (429 above). Work the
+                            # node cannot fuse (mesh/cached/per-segment-
+                            # metrics) skips the queue — it would only
+                            # serialize on the dispatcher thread
+                            out = outer.scheduler.submit(query, sids,
+                                                         check=check)
                         else:
                             out = outer.node.run_partials(query, sids,
                                                           check=check)
@@ -252,10 +287,14 @@ class DataNodeServer:
         from druid_tpu.data.devicepool import DevicePoolMonitor
         from druid_tpu.engine.batching import BatchMetricsMonitor
         from druid_tpu.utils.emitter import MonitorScheduler
+        monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
+                    self._query_counts]
+        if self._scheduler_config is not None:
+            self.scheduler = DataNodeScheduler(
+                node, self._scheduler_config, emitter=emitter)
+            monitors.append(SchedulerMetricsMonitor(self.scheduler))
         self._monitors = MonitorScheduler(
-            emitter, [DevicePoolMonitor(), BatchMetricsMonitor(),
-                      self._query_counts],
-            period_seconds=monitor_period_seconds)
+            emitter, monitors, period_seconds=monitor_period_seconds)
 
     @property
     def url(self) -> str:
@@ -271,6 +310,8 @@ class DataNodeServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self.scheduler is not None:
+            self.scheduler.start()
         if self._monitors is not None:
             self._monitors.start()
         return self
@@ -281,6 +322,10 @@ class DataNodeServer:
         self._restore_sink()
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self.scheduler is not None:
+            # after the listener: no new submits can arrive; queued
+            # waiters fail fast instead of hanging on a dead dispatcher
+            self.scheduler.stop()
 
 
 class RemoteDataNodeClient:
@@ -354,34 +399,68 @@ class RemoteDataNodeClient:
         # scatter round, so this never exceeds the original budget
         return (t / 1000.0) if t > 0 else DEFAULT_TIMEOUT_MS / 1000.0
 
+    #: never sleep longer than this on a Retry-After before the one 429
+    #: retry — a long drain estimate should fail fast at the broker, not
+    #: camp on a scatter thread
+    MAX_RETRY_AFTER_SLEEP = 2.0
+
     def _post(self, path: str, query: Query, segment_ids: Sequence[str]):
         body = json.dumps({"query": query.to_json(),
                            "segments": [str(s) for s in segment_ids]},
                           default=_json_value).encode()
-        req = urllib.request.Request(
-            self.base_url + path, data=body,
-            headers={"Content-Type": "application/json"}, method="POST")
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=self._timeout_for(query)) as r:
-                return r.headers.get_content_type(), r.read()
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            if e.code == 504:
-                raise QueryTimeoutError(detail)
-            if e.code == 500 and "cancelled" in detail.lower():
-                raise QueryInterruptedError(detail)
-            # a served HTTP error is a QUERY error — propagate the node's
-            # message instead of retrying into MissingSegmentsError
-            raise RemoteQueryError(self.name, e.code, detail)
-        except socket.timeout:
-            raise QueryTimeoutError(
-                f"server [{self.name}] did not respond in time")
-        except (urllib.error.URLError, OSError) as e:
-            if isinstance(getattr(e, "reason", None), socket.timeout):
+        # ONE total budget across the shed retry: the context timeout is
+        # the query's, not per-attempt
+        deadline = time.monotonic() + self._timeout_for(query)
+        for attempt in (0, 1):
+            req = urllib.request.Request(
+                self.base_url + path, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=max(0.1, deadline - time.monotonic())
+                        ) as r:
+                    return r.headers.get_content_type(), r.read()
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                if e.code == 429:
+                    # admission shed: distinguishable from query errors.
+                    # Retry ONCE after Retry-After (within the remaining
+                    # budget); a second shed propagates as a clear
+                    # capacity error, not an opaque RemoteQueryError
+                    try:
+                        retry_after = float(
+                            e.headers.get("Retry-After") or 1.0)
+                    except (TypeError, ValueError):
+                        retry_after = 1.0
+                    # a drain estimate past the cap means the retry is
+                    # near-certain to shed again — fail fast instead of
+                    # sleeping the cap and reissuing a doomed request
+                    if attempt == 0 \
+                            and retry_after <= self.MAX_RETRY_AFTER_SLEEP \
+                            and time.monotonic() + retry_after < deadline:
+                        time.sleep(retry_after)
+                        continue
+                    raise QueryCapacityError(
+                        f"server [{self.name}] shed the query: {detail}",
+                        retry_after_s=retry_after, server=self.name)
+                if e.code == 504:
+                    raise QueryTimeoutError(detail)
+                if e.code == 500 and "cancelled" in detail.lower():
+                    raise QueryInterruptedError(detail)
+                # a served HTTP error is a QUERY error — propagate the
+                # node's message instead of retrying into
+                # MissingSegmentsError
+                raise RemoteQueryError(self.name, e.code, detail)
+            except socket.timeout:
                 raise QueryTimeoutError(
                     f"server [{self.name}] did not respond in time")
-            raise ConnectionError(f"server [{self.name}] unreachable: {e}")
+            except (urllib.error.URLError, OSError) as e:
+                if isinstance(getattr(e, "reason", None), socket.timeout):
+                    raise QueryTimeoutError(
+                        f"server [{self.name}] did not respond in time")
+                raise ConnectionError(
+                    f"server [{self.name}] unreachable: {e}")
 
     def run_partials(self, query: Query, segment_ids: Sequence[str]
                      ) -> Tuple[object, Set[str]]:

@@ -173,9 +173,7 @@ class DataNode:
         if not self.alive:
             raise ConnectionError(f"server [{self.name}] is down")
         segs, served = self._select(segment_ids)
-        use_cache = (self.cache is not None
-                     and self.cache_config.cacheable(query)
-                     and self.cache_config.use_segment_cache)
+        use_cache = self._segment_cache_active(query)
         if not use_cache:
             if not (self.emitter is not None and self.per_segment_metrics) \
                     or self.mesh is not None or len(segs) <= 1:
@@ -257,6 +255,85 @@ class DataNode:
                     self.cache.put("segment", f"{s.id}|{qkey}", ap)
                 parts.append(ap)
         return AggregatePartials.concat(parts), served
+
+    def _segment_cache_active(self, query: Query) -> bool:
+        """Whether the per-segment results cache takes this query — the
+        ONE eligibility condition run_partials and run_partials_group must
+        agree on (a fused request must never bypass cache population the
+        serial path would have done)."""
+        return (self.cache is not None
+                and self.cache_config.cacheable(query)
+                and self.cache_config.use_segment_cache)
+
+    def fusable(self, query: Query) -> bool:
+        """Whether run_partials_group would FUSE this query with its
+        flush-mates. Work this node cannot fuse — mesh execution, segment
+        cache in play, per-segment metrics, non-aggregate queries, batching
+        opted out (process switch or {"batchSegments": false}) — gains
+        nothing from the scheduler hold and would serialize on the single
+        dispatcher thread; DataNodeServer routes it straight to
+        run_partials on the request thread instead."""
+        from druid_tpu.engine import batching
+        return (_is_aggregate(query) and self.mesh is None
+                and batching.query_enabled(query.context_map)
+                and not self._segment_cache_active(query)
+                and not (self.emitter is not None
+                         and self.per_segment_metrics))
+
+    def run_partials_group(self, requests, on_batch=None) -> List[object]:
+        """Cross-query serving: one call for a whole scheduler flush.
+        `requests` is a sequence of (query, segment_ids, check) triples;
+        returns one entry per request — (AggregatePartials, served) or the
+        Exception that request failed with (one query's cancel/timeout
+        must not fail its flush-mates).
+
+        Plan-compatible segment work FUSES across the requests into shared
+        device dispatches (engines.make_aggregate_partials_multi). Requests
+        this node cannot fuse (see `fusable`) normally never reach the
+        scheduler — DataNodeServer runs them on the request thread — but
+        any that slip through run via the normal run_partials path, so
+        semantics (cache population, per-segment metrics) stay identical.
+        `on_batch` observes each fused dispatch (query/crossBatch/*)."""
+        if not self.alive:
+            err = ConnectionError(f"server [{self.name}] is down")
+            return [err for _ in requests]
+        fused_idx: List[int] = []
+        fused_items = []
+        out: List[object] = [None] * len(requests)
+        for i, (query, segment_ids, check) in enumerate(requests):
+            if not self.fusable(query):
+                # robustness backstop — DataNodeServer bypasses the
+                # scheduler for non-fusable work, so this only fires when
+                # eligibility changed between admission and flush
+                try:
+                    out[i] = self.run_partials(query, segment_ids,
+                                               check=check)
+                except Exception as e:
+                    out[i] = e
+                continue
+            segs, served = self._select(segment_ids)
+            fused_idx.append(i)
+            fused_items.append(((query, segs, check), served))
+        if fused_items:
+            t0, c0 = time.monotonic(), time.thread_time()
+            results = engines.make_aggregate_partials_multi(
+                [item for item, _ in fused_items], on_batch=on_batch)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            cpu_ms = (time.thread_time() - c0) * 1e3
+            for i, got, ((query, segs, _), served) \
+                    in zip(fused_idx, results, fused_items):
+                if isinstance(got, BaseException):
+                    out[i] = got
+                    continue
+                if segs:
+                    # one fused timing per request, as run_partials emits
+                    # for a batched set — the flush is shared, so the
+                    # wall/cpu cost is the whole group's, not this
+                    # query's alone
+                    self._emit_segment(query, f"{len(segs)}-segments",
+                                       wall_ms, cpu_ms, cached=False)
+                out[i] = (got, served)
+        return out
 
     def run_rows(self, query: Query, segment_ids: Sequence[str]
                  ) -> Tuple[List[dict], Set[str]]:

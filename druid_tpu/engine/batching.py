@@ -32,6 +32,7 @@ cluster/dataserver.py).
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import threading
 from dataclasses import dataclass
@@ -84,6 +85,20 @@ def set_enabled(on: bool) -> bool:
 
 def enabled() -> bool:
     return _ENABLED
+
+
+def query_enabled(context: Optional[Dict]) -> bool:
+    """Whether batching applies to one query: the process switch AND the
+    per-query {"batchSegments": false} context opt-out. The ONE predicate
+    the single-query path, the cross-query path, and the scheduler's
+    routing (DataNode.fusable) must agree on — an opted-out query gains
+    nothing from the scheduler hold and must not serialize on the
+    dispatcher thread."""
+    if not _ENABLED:
+        return False
+    return not (context
+                and str(context.get("batchSegments", "true")).lower()
+                in ("0", "false", "no"))
 
 
 # Jitted batched programs keyed on (structure, K, R), LRU-bounded + locked
@@ -193,11 +208,20 @@ class _Plan:
     Wraps the shared host-side GroupPlan (grouping.plan_grouped_aggregate)
     with the batching-only derivations (ladder rung, bucket digest); the
     GroupPlan rides along so straggler fallback re-executes WITHOUT
-    re-planning (run_grouped_aggregate(plan=...))."""
+    re-planning (run_grouped_aggregate(plan=...)).
+
+    Carries its OWN intervals/granularity: a chunk may mix plans from
+    several concurrent queries (run_multi_with_batching), so per-query
+    origins (relative interval bounds, bucket start) are derived per plan,
+    not from the chunk reference. `req` tags the owning request — the
+    queryId of the split-back."""
     segment: Segment
     kds: Tuple[KeyDim, ...]
     index: int                       # position in the caller's segment list
     gplan: GroupPlan
+    intervals: Tuple[Interval, ...] = ()
+    granularity: Granularity = None
+    req: int = 0                     # owning request (multi-query split-back)
     #: False = straggler (runs per-segment, but still through this gplan)
     eligible: bool = False
     f_aux: List[np.ndarray] = None
@@ -244,7 +268,8 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     kds = tuple(kds)
     gplan = plan_grouped_aggregate(segment, intervals, granularity, kds,
                                    aggs, flt, virtual_columns)
-    plan = _Plan(segment=segment, kds=kds, index=index, gplan=gplan)
+    plan = _Plan(segment=segment, kds=kds, index=index, gplan=gplan,
+                 intervals=tuple(intervals), granularity=granularity)
     if segment.n_rows > BATCH_MAX_SEGMENT_ROWS:
         return plan
     if any(d.host_ids is not None for d in kds):
@@ -286,8 +311,16 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     plan.rung = row_rung(segment.n_rows)
     sig = grouping._structure_sig(spec, len(intervals), filter_node, kernels,
                                   gplan.vc_plans)
+    # granularity + bucket count join the digest for CROSS-QUERY grouping:
+    # the stacked aux (assemble_stacked_aux) carries one shared period /
+    # num_buckets for the whole chunk, so chunk-mates from different
+    # queries must agree on them (within one query they are constant and
+    # this changes nothing). Interval VALUES stay out — relative bounds
+    # are per-segment mapped args (iv_rel), only their COUNT is shape
+    # (already in the structure sig).
     plan.digest = (sig, plan.rung, columns,
-                   tuple(sorted((c, str(d)) for c, d in col_dtypes.items())))
+                   tuple(sorted((c, str(d)) for c, d in col_dtypes.items())),
+                   str(granularity), spec.num_buckets)
     return plan
 
 
@@ -357,11 +390,14 @@ def _build_batched_fn(spec: GroupSpec, kds: Tuple[KeyDim, ...], filter_node,
     return jax.jit(fn)
 
 
-def _run_batch(chunk: List[_Plan], intervals: Sequence[Interval],
-               granularity: Granularity) -> Optional[List[SegmentPartial]]:
+def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
     """Execute one shape bucket as a single dispatch; None = the bucket
     cannot run stacked (projection-grade group space) and the caller falls
-    back per-segment."""
+    back per-segment. The chunk may mix plans from several queries
+    (run_multi_with_batching): every per-query origin — interval bounds,
+    bucket start — is derived from the plan's OWN intervals, so
+    cross-query mates produce exactly the partials their own serial run
+    would."""
     import jax
 
     ref = chunk[0]
@@ -371,7 +407,8 @@ def _run_batch(chunk: List[_Plan], intervals: Sequence[Interval],
     def _windowed_all():
         w_all = 0
         for p in chunk:
-            w = windowed_window(p.segment, intervals, granularity, ref.spec)
+            w = windowed_window(p.segment, p.intervals, p.granularity,
+                                p.spec)
             if not w:
                 return 0
             w_all = max(w_all, w)
@@ -393,24 +430,24 @@ def _run_batch(chunk: List[_Plan], intervals: Sequence[Interval],
         "ladder rung must equal the staged row count"
 
     clip_lo, clip_hi = -(2**31) + 1, 2**31 - 1
-    iv_rel = np.zeros((K, max(len(intervals), 1), 2), dtype=np.int32)
+    iv_rel = np.zeros((K, max(len(ref.intervals), 1), 2), dtype=np.int32)
     time0s = np.zeros((K,), dtype=np.int64)
     bucket_off = np.zeros((K,), dtype=np.int32)
     for i, p in enumerate(chunk):
         t0 = p.segment.interval.start
         time0s[i] = t0
-        for j, ivl in enumerate(intervals):
+        for j, ivl in enumerate(p.intervals):
             iv_rel[i, j, 0] = min(max(ivl.start - t0, clip_lo), clip_hi)
             iv_rel[i, j, 1] = min(max(ivl.end - t0, clip_lo), clip_hi)
-        if ref.spec.bucket_mode == "uniform":
-            bucket_off[i] = min(max(int(ref.spec.bucket_starts[0]) - t0,
+        if p.spec.bucket_mode == "uniform":
+            bucket_off[i] = min(max(int(p.spec.bucket_starts[0]) - t0,
                                     clip_lo), clip_hi)
 
     aux = assemble_stacked_aux(ref.spec, ref.kds, ref.f_aux, ref.k_aux,
-                               granularity, ref.vc_luts)
+                               ref.granularity, ref.vc_luts)
     sig = "batched|" + grouping._structure_sig(
-        ref.spec, len(intervals), ref.filter_node, ref.kernels, ref.vc_plans) \
-        + f"|K={K}|R={R}"
+        ref.spec, len(ref.intervals), ref.filter_node, ref.kernels,
+        ref.vc_plans) + f"|K={K}|R={R}"
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.get(sig)
         # the miss IS the compile event (jit traces/compiles on the first
@@ -462,10 +499,7 @@ def run_with_batching(segs: Sequence[Segment], intervals: Sequence[Interval],
     None when batching is off / inapplicable (caller runs plain
     per-segment). `check` (optional cancel/timeout probe) fires between
     dispatches — batch and straggler alike."""
-    if not _ENABLED or len(segs) < BATCH_MIN_SEGMENTS:
-        return None
-    if context and str(context.get("batchSegments", "true")).lower() \
-            in ("0", "false", "no"):
+    if not query_enabled(context) or len(segs) < BATCH_MIN_SEGMENTS:
         return None
 
     with trace_span("engine/batch/plan", segments=len(segs)):
@@ -489,7 +523,7 @@ def run_with_batching(segs: Sequence[Segment], intervals: Sequence[Interval],
         for chunk in chunks:
             if check is not None and dispatched:
                 check()
-            partials = _run_batch(chunk, intervals, granularity)
+            partials = _run_batch(chunk)
             if partials is None:
                 continue
             dispatched += 1
@@ -516,3 +550,134 @@ def _run_straggler(p: _Plan, intervals, granularity, aggs, flt,
     return run_grouped_aggregate(
         p.segment, intervals, granularity, p.kds, aggs, flt,
         virtual_columns=virtual_columns, plan=p.gplan)
+
+
+# ---------------------------------------------------------------------------
+# Cross-query entry point (server/scheduler.py via engines)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BatchWork:
+    """One query's segment work, as submitted to run_multi_with_batching —
+    the same argument tuple run_with_batching takes, boxed so a scheduler
+    flush can carry many of them."""
+    segs: Sequence[Segment]
+    intervals: Sequence[Interval]
+    granularity: Granularity
+    kds_per_seg: Sequence[Sequence[KeyDim]]
+    aggs: Sequence[AggregatorSpec]
+    flt: object = None
+    virtual_columns: Sequence = ()
+    context: Optional[Dict] = None
+    check: Optional[object] = None   # cancel/timeout probe for THIS query
+
+
+def run_multi_with_batching(work: Sequence[BatchWork],
+                            on_batch=None) -> List[object]:
+    """Cross-query fused execution: plan every request's segments, group
+    plans into shape buckets ACROSS requests (the _Plan digest already
+    carries everything two dispatches must agree on, plus granularity /
+    bucket count for the cross-query case), run each bucket as single
+    dispatches, and split partials back per request by the plan's `req`
+    tag.
+
+    Returns one entry per request: a List[SegmentPartial] (same order as
+    that request's `segs`) or the Exception that request's check raised —
+    one cancelled/timed-out query must not fail its batch-mates. Results
+    are bit-identical to running each request through run_with_batching /
+    the per-segment path serially: the chunk a plan lands in changes only
+    WHICH dispatch computes it, never what it computes (per-plan origins,
+    strategy a pure function of digest-shared constants).
+
+    `on_batch(n_queries, n_segments, fill_ratio)` fires per fused dispatch
+    — the scheduler's query/crossBatch/* metrics hook."""
+    all_plans: List[List[_Plan]] = []
+    with trace_span("engine/batch/plan",
+                    queries=len(work),
+                    segments=sum(len(w.segs) for w in work)):
+        for r, w in enumerate(work):
+            opted_out = not query_enabled(w.context)
+            plans = []
+            for i, (s, kds) in enumerate(zip(w.segs, w.kds_per_seg)):
+                p = _plan_for(s, kds, i, w.intervals, w.granularity,
+                              w.aggs, w.flt, w.virtual_columns)
+                p.req = r
+                if opted_out:
+                    p.eligible = False
+                plans.append(p)
+            all_plans.append(plans)
+        buckets = _shape_buckets([p for plans in all_plans
+                                  for p in plans if p.eligible])
+
+    results: List[List[Optional[SegmentPartial]]] = \
+        [[None] * len(plans) for plans in all_plans]
+    dead: Dict[int, BaseException] = {}
+
+    def _poll_checks():
+        for r, w in enumerate(work):
+            if r in dead or w.check is None:
+                continue
+            try:
+                w.check()
+            except Exception as e:
+                dead[r] = e
+
+    dispatched = 0
+    for bucket in buckets:
+        if len(bucket) < BATCH_MIN_SEGMENTS:
+            continue
+        chunks, _remainder = _pow2_chunks(bucket)
+        for chunk in chunks:
+            if dispatched:
+                _poll_checks()
+            live = [p for p in chunk if p.req not in dead]
+            if not live:
+                continue
+            if len(live) < len(chunk):
+                # a cancelled mate shrank the chunk below its pow2 size —
+                # K is a compile key, so dispatching the odd size would
+                # pay a one-off compile; survivors take the (cached)
+                # per-segment straggler path instead
+                continue
+            try:
+                partials = _run_batch(live)
+            except Exception:
+                # a batch-specific failure must not kill queries that
+                # would succeed serially: participants fall back to the
+                # per-segment straggler path below
+                logging.getLogger(__name__).exception(
+                    "batched dispatch failed; falling back per-segment")
+                continue
+            if partials is None:
+                continue
+            dispatched += 1
+            if on_batch is not None:
+                slots = len(live) * live[0].rung
+                rows = sum(p.segment.n_rows for p in live)
+                on_batch(len({p.req for p in live}), len(live),
+                         rows / slots if slots else 0.0)
+            for p, partial in zip(live, partials):
+                results[p.req][p.index] = partial
+
+    _poll_checks()
+    out: List[object] = []
+    for r, (w, plans) in enumerate(zip(work, all_plans)):
+        if r in dead:
+            out.append(dead[r])
+            continue
+        res = results[r]
+        n_fallback = sum(1 for x in res if x is None)
+        if dispatched and n_fallback:
+            _STATS.record_fallback(n_fallback)
+        try:
+            for i, p in enumerate(plans):
+                if res[i] is None:
+                    res[i] = _run_straggler(
+                        p, w.intervals, w.granularity, w.aggs, w.flt,
+                        w.virtual_columns, w.check,
+                        first=not dispatched and i == 0)
+        except Exception as e:
+            out.append(e)
+            continue
+        out.append(res)
+    return out

@@ -340,6 +340,30 @@ def make_partials_by_segment(query, segments: Sequence[Segment],
     return out
 
 
+def _keydims_for_query(query, segs: Sequence[Segment]):
+    """Per-segment KeyDims + decode value lists for an aggregate query —
+    the one derivation every partial-producing path (single-query, multi-
+    query scheduler, by-segment split) shares."""
+    if isinstance(query, TimeseriesQuery):
+        return [[] for _ in segs], [[] for _ in segs]
+    if isinstance(query, TopNQuery):
+        keydims = [_keydim_for(s, query.dimension) for s in segs]
+        return [[kd] for kd, _ in keydims], \
+            [[values] for _, values in keydims]
+    if isinstance(query, GroupByQuery):
+        kds_per_seg, vals_per_seg = [], []
+        for s in segs:
+            kds, vals = [], []
+            for d in query.dimensions:
+                kd, v = _keydim_for(s, d)
+                kds.append(kd)
+                vals.append(v)
+            kds_per_seg.append(kds)
+            vals_per_seg.append(vals)
+        return kds_per_seg, vals_per_seg
+    raise TypeError(f"not an aggregate query: {type(query).__name__}")
+
+
 def _make_aggregate_partials_with_segs(query, segments: Sequence[Segment],
                                        clamp: bool, check
                                        ) -> Tuple[AggregatePartials,
@@ -350,30 +374,69 @@ def _make_aggregate_partials_with_segs(query, segments: Sequence[Segment],
         intervals = _clamp_to_data(intervals, segs)
     if not segs:
         return AggregatePartials([], [], [], intervals), segs
-    if isinstance(query, TimeseriesQuery):
-        kds_per_seg = [[] for _ in segs]
-        vals_per_seg = [[] for _ in segs]
-    elif isinstance(query, TopNQuery):
-        keydims = [_keydim_for(s, query.dimension) for s in segs]
-        kds_per_seg = [[kd] for kd, _ in keydims]
-        vals_per_seg = [[values] for _, values in keydims]
-    elif isinstance(query, GroupByQuery):
-        kds_per_seg, vals_per_seg = [], []
-        for s in segs:
-            kds, vals = [], []
-            for d in query.dimensions:
-                kd, v = _keydim_for(s, d)
-                kds.append(kd)
-                vals.append(v)
-            kds_per_seg.append(kds)
-            vals_per_seg.append(vals)
-    else:
-        raise TypeError(f"not an aggregate query: {type(query).__name__}")
+    kds_per_seg, vals_per_seg = _keydims_for_query(query, segs)
     partials, dim_values = _make_partials(segs, intervals, query,
                                           kds_per_seg, vals_per_seg,
                                           check=check)
     spans = [(s.min_time, s.max_time) for s in segs]
     return AggregatePartials(partials, dim_values, spans, intervals), segs
+
+
+def make_aggregate_partials_multi(items, on_batch=None) -> List[object]:
+    """Cross-query partial production: one call for a whole scheduler
+    flush. `items` is a sequence of (query, segments, check) triples —
+    aggregate queries over LOCAL segments, meshless (the scheduler routes
+    mesh/cached/row work individually). Returns one entry per item: an
+    AggregatePartials, or the Exception that item's cancel/timeout probe
+    raised.
+
+    Per-item planning (interval condensing, keydim derivation) is exactly
+    the serial path's; only the device dispatches fuse — results are
+    bit-identical to calling make_aggregate_partials per item.
+    `on_batch(n_queries, n_segments, fill)` observes each fused dispatch
+    (the scheduler's query/crossBatch/* hook)."""
+    from druid_tpu.engine.batching import BatchWork, run_multi_with_batching
+    from druid_tpu.obs.trace import span as trace_span
+
+    work: List[BatchWork] = []
+    meta: List[object] = []   # per item: (intervals, segs, vals) | result
+    for query, segments, check in items:
+        try:
+            intervals = condense(query.intervals)
+            segs = _segments_for(segments, intervals)
+            if not segs:
+                meta.append(AggregatePartials([], [], [], intervals))
+                continue
+            kds_per_seg, vals_per_seg = _keydims_for_query(query, segs)
+        except Exception as e:
+            meta.append(e)
+            continue
+        meta.append((intervals, segs, vals_per_seg))
+        work.append(BatchWork(
+            segs=segs, intervals=intervals, granularity=query.granularity,
+            kds_per_seg=kds_per_seg, aggs=query.aggregations,
+            flt=query.filter, virtual_columns=query.virtual_columns,
+            context=query.context_map, check=check))
+
+    with trace_span("engine/partials", queries=len(work),
+                    segments=sum(len(w.segs) for w in work)):
+        multi = run_multi_with_batching(work, on_batch=on_batch)
+
+    out: List[object] = []
+    it = iter(multi)
+    for m in meta:
+        if not isinstance(m, tuple):
+            out.append(m)            # precomputed empty result / error
+            continue
+        intervals, segs, vals_per_seg = m
+        got = next(it)
+        if isinstance(got, BaseException):
+            out.append(got)
+            continue
+        spans = [(s.min_time, s.max_time) for s in segs]
+        out.append(AggregatePartials(got, list(vals_per_seg), spans,
+                                     intervals))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,37 @@ METRICS = {
         "unit": "count", "dims": (),
         "site": "utils/emitter.py",
         "help": "current cache entry count"},
+    # ---- data-node scheduler (server/scheduler.py) ---------------------
+    "query/queue/depth": {
+        "unit": "count", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "queries queued at the data-node scheduler at tick time"},
+    "query/queue/wait": {
+        "unit": "ms", "dims": ("dataSource", "type", "id", "lane"),
+        "site": "server/scheduler.py",
+        "help": "time a query was held in the scheduler queue before its "
+                "flush started (emitted per query, tracing on or off)"},
+    "query/shed/count": {
+        "unit": "count/period", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "queries shed with 429 at admission since the last tick"},
+    "query/crossBatch/queries": {
+        "unit": "count", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "distinct queries fused into one cross-query dispatch"},
+    "query/crossBatch/segments": {
+        "unit": "count", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "segments stacked into one cross-query dispatch"},
+    "query/crossBatch/fillRatio": {
+        "unit": "ratio", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "real rows / padded slots of a cross-query dispatch"},
+    "query/crossBatch/droppedEvents": {
+        "unit": "count", "dims": (),
+        "site": "server/scheduler.py (SchedulerMetricsMonitor)",
+        "help": "per-dispatch events lost to the bounded event queue "
+                "(the crossBatch series undercounts by this many)"},
     # ---- batched execution (engine/batching.py) ------------------------
     "query/batch/segments": {
         "unit": "count", "dims": (),

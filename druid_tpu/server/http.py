@@ -20,7 +20,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from druid_tpu.server.lifecycle import QueryLifecycle, Unauthorized
-from druid_tpu.server.querymanager import (QueryInterruptedError,
+from druid_tpu.server.querymanager import (QueryCapacityError,
+                                           QueryInterruptedError,
                                            QueryTimeoutError)
 
 
@@ -362,6 +363,13 @@ class QueryHttpServer:
                 except QueryTimeoutError as e:
                     self._reply(504, {"error": "Query timed out",
                                       "errorMessage": str(e)})
+                except QueryCapacityError as e:
+                    # a saturated data tier shed the query (scheduler
+                    # admission): surface the same 429 + Retry-After
+                    # contract to the original client
+                    self._reply(429, {"error": "Query capacity exceeded",
+                                      "errorMessage": str(e)},
+                                {"Retry-After": e.retry_after_header()})
                 except QueryInterruptedError as e:
                     self._reply(500, {"error": "Query cancelled",
                                       "errorMessage": str(e)})

@@ -30,6 +30,26 @@ class QueryTimeoutError(RuntimeError):
     TIMED_OUT; HTTP 504 at the resource layer)."""
 
 
+class QueryCapacityError(RuntimeError):
+    """The query was shed at admission — bounded scheduler queue, lane cap,
+    or a deadline the queue cannot meet (reference:
+    QueryCapacityExceededException). HTTP 429 with a Retry-After header at
+    the resource layer; the broker surfaces it as a clear shed error
+    instead of an opaque per-segment failure."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 server: str = ""):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+        self.server = server
+
+    def retry_after_header(self) -> str:
+        """The Retry-After header value (whole seconds, floor 1) — the one
+        place the wire contract's rounding lives; the broker resource and
+        the data-node handler must answer identically."""
+        return str(max(1, round(self.retry_after_s)))
+
+
 DEFAULT_TIMEOUT_MS = 300_000
 
 

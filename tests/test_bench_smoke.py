@@ -25,6 +25,9 @@ BENCH_ENV = {
     "DRUID_TPU_BENCH_BATCH_SEGMENTS": "4",
     "DRUID_TPU_BENCH_BATCH_ROWS": "1024",
     "DRUID_TPU_BENCH_INIT_TIMEOUT": "120",
+    "DRUID_TPU_BENCH_CLIENTS": "4",
+    "DRUID_TPU_BENCH_CLIENT_QUERIES": "3",
+    "DRUID_TPU_BENCH_SCHED_ROWS": "1024",
 }
 
 
@@ -60,6 +63,15 @@ def test_bench_exits_zero_with_one_json_line():
     # the qtrace-overhead fields tracked across BENCH_r* runs
     assert out["traced_rate"] > 0
     assert out["untraced_rate"] > 0
+    # the concurrent-client scheduler comparison (contract only: this
+    # shared CI hardware cannot promise the ≥1.3x the real bench shows)
+    assert out["sched_clients"] == 4
+    assert out["sched_off_rate"] > 0
+    assert out["sched_on_rate"] > 0
+    assert out["sched_speedup"] > 0
+    for mode in ("off", "on"):
+        assert out[f"sched_{mode}_p50_ms"] > 0
+        assert out[f"sched_{mode}_p99_ms"] >= out[f"sched_{mode}_p50_ms"]
 
 
 def test_bench_falls_back_to_cpu_on_bad_backend():

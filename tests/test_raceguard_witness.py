@@ -144,6 +144,51 @@ def test_install_uninstall_restores_factories():
     assert threading.Lock is prev_lock and threading.RLock is prev_rlock
 
 
+@pytest.mark.skipif(
+    __import__("os").environ.get("DRUID_TPU_LOCK_WITNESS") == "1",
+    reason="session witness wrapped module locks at import; nothing to rewrap")
+def test_rewrap_module_locks_covers_preinstall_globals():
+    """A witness installed MID-SESSION (every per-test witness) misses
+    locks constructed at import time — the jit-cache locks and the native
+    registry, i.e. exactly the compile-cache edges. rewrap_module_locks
+    swaps the module globals for wrappers keyed on the static assignment
+    site, and uninstall() puts the raw locks back."""
+    import druid_tpu.engine.batching as batching
+    import druid_tpu.engine.grouping as grouping
+    import druid_tpu.native as native
+    import druid_tpu.parallel.distributed as distributed
+
+    w = LockWitness(str(REPO_ROOT)).install()
+    try:
+        n = w.rewrap_module_locks()
+        assert n >= 4, f"expected the known module locks wrapped, got {n}"
+        for lk in (grouping._JIT_CACHE_LOCK, batching._JIT_CACHE_LOCK,
+                   distributed._CACHE_LOCK, native._lock):
+            assert isinstance(lk, WitnessLock)
+        # the rewrap site IS the static identity raceguard derives, so
+        # observed compile-cache edges can be checked against the graph
+        cfg = load_config(REPO_ROOT)
+        prog = analyze_tree(REPO_ROOT, cfg)
+        sites = prog.lock_sites()
+        assert grouping._JIT_CACHE_LOCK.site in sites
+        assert batching._JIT_CACHE_LOCK.site in sites
+        # acquisition through the module global records an edge
+        outer = WitnessLock(w, threading.Lock(), ("druid_tpu/t.py", 1),
+                            reentrant=False)
+        with outer:
+            with batching._JIT_CACHE_LOCK:
+                pass
+        assert (outer.site, batching._JIT_CACHE_LOCK.site) \
+            in w.observed_edges()
+        # idempotent: a second pass wraps nothing
+        assert w.rewrap_module_locks([batching, grouping]) == 0
+    finally:
+        w.uninstall()
+    # raw locks restored: a later witness (or none) owns them again
+    assert not isinstance(grouping._JIT_CACHE_LOCK, WitnessLock)
+    assert not isinstance(native._lock, WitnessLock)
+
+
 def test_unexplained_edges_subgraph_check():
     from tools.druidlint.core import LintConfig
     from tools.druidlint.raceguard import analyze_sources
@@ -193,10 +238,15 @@ def stress_run():
     """Build a witnessed mini-cluster and hammer it from three directions
     at once; yields (witness, errors, pool, emitter)."""
     witness = LockWitness(str(REPO_ROOT)).install()
+    # module-level locks (jit caches, native registry) predate this
+    # install — re-wrap them so the sweep sees the compile-cache edges
+    witness.rewrap_module_locks()
     try:
         from druid_tpu.cluster.broker import Broker
         from druid_tpu.cluster.view import (DataNode, InventoryView,
                                             descriptor_for)
+        from druid_tpu.server.scheduler import (DataNodeScheduler,
+                                                SchedulerConfig)
         from druid_tpu.data import ColumnSpec, DataGenerator
         from druid_tpu.data import devicepool as dp_mod
         from druid_tpu.data.devicepool import (DevicePoolMonitor,
@@ -249,10 +299,26 @@ def stress_run():
         errors = []
         stop = threading.Event()
 
+        # the data-node scheduler joins the stress: submit threads (HTTP
+        # handler stand-ins) racing its dispatcher exercises the
+        # queue/flush handoff and the scheduler→node→engine lock chain
+        scheduler = DataNodeScheduler(
+            nodes[0], SchedulerConfig(batch_window_ms=2.0,
+                                      max_queue_depth=64))
+
         def fan_out(q, rounds):
             try:
                 for _ in range(rounds):
                     broker.run_json(q)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
+        def sched_loop(rounds):
+            try:
+                from druid_tpu.query.model import query_from_json
+                sids = [str(s.id) for s in nodes[0].segments()]
+                for _ in range(rounds):
+                    scheduler.submit(query_from_json(group_q), sids[:2])
             except Exception as e:          # pragma: no cover - must not
                 errors.append(e)
 
@@ -283,14 +349,17 @@ def stress_run():
                    threading.Thread(target=fan_out, args=(group_q, 6)),
                    threading.Thread(target=fan_out, args=(ts_q, 6)),
                    threading.Thread(target=fan_out, args=(ts_q, 6)),
+                   threading.Thread(target=sched_loop, args=(6,)),
+                   threading.Thread(target=sched_loop, args=(6,)),
                    threading.Thread(target=tick_loop, daemon=True),
                    threading.Thread(target=churn_loop, daemon=True)]
         for t in workers:
             t.start()
-        for t in workers[:4]:
+        for t in workers[:6]:
             t.join(timeout=300)
         stop.set()
-        for t in workers[4:]:
+        scheduler.stop()
+        for t in workers[6:]:
             t.join(timeout=10)
 
         yield witness, errors, pool, emitter

@@ -97,19 +97,27 @@ class LockWitness:
         self.mutation_violations: List[str] = []
         self._installed = False
         self._watched: List[Tuple[object, type]] = []
+        self._rewrapped: List[Tuple[object, str, object]] = []
         self._prev_factories = None      # what install() displaced
 
     # ---- interception ---------------------------------------------------
-    def _site_of_caller(self) -> Optional[Site]:
-        f = sys._getframe(2)             # caller of the Lock()/RLock() call
-        path = os.path.abspath(f.f_code.co_filename)
+    def _rel_under_prefixes(self, path: str) -> Optional[str]:
+        """Repo-relative form of `path` when it lives under a configured
+        prefix, else None — the ONE site-eligibility rule both caller
+        sites and rewrapped module locks key on."""
+        path = os.path.abspath(path)
         if not path.startswith(self.root + os.sep):
             return None
         rel = os.path.relpath(path, self.root).replace(os.sep, "/")
         if not any(rel.startswith(p.rstrip("/") + "/") or rel == p
                    for p in self.prefixes):
             return None
-        return (rel, f.f_lineno)
+        return rel
+
+    def _site_of_caller(self) -> Optional[Site]:
+        f = sys._getframe(2)             # caller of the Lock()/RLock() call
+        rel = self._rel_under_prefixes(f.f_code.co_filename)
+        return None if rel is None else (rel, f.f_lineno)
 
     def install(self) -> "LockWitness":
         if self._installed:
@@ -153,6 +161,12 @@ class LockWitness:
         for obj, cls in self._watched:
             obj.__class__ = cls
         self._watched.clear()
+        # put the raw locks back where rewrap_module_locks swapped them —
+        # a later witness (or none) must not record into this dead one
+        for mod, name, raw in self._rewrapped:
+            if isinstance(getattr(mod, name, None), WitnessLock):
+                setattr(mod, name, raw)
+        self._rewrapped.clear()
 
     def __enter__(self) -> "LockWitness":
         return self.install()
@@ -192,6 +206,83 @@ class LockWitness:
 
     def held_by_current(self, lock: "WitnessLock") -> bool:
         return any(h is lock for h in self._stack())
+
+    # ---- re-wrap of pre-install module-level locks ----------------------
+    def rewrap_module_locks(self, modules: Optional[Sequence] = None) -> int:
+        """Wrap locks that were constructed BEFORE install(): module-level
+        globals like the jit caches' `_JIT_CACHE_LOCK` (engine/grouping,
+        engine/batching), distributed's `_CACHE_LOCK`, and the native
+        registry `_lock` are built at import time, so a witness installed
+        mid-session never sees them — blinding the sweep to exactly the
+        compile-cache edges raceguard models.
+
+        For every already-imported project module (or the explicit
+        `modules`), the module SOURCE is ast-scanned for top-level
+        `NAME = threading.Lock()/RLock()` assignments; the live lock
+        object is wrapped in a WitnessLock keyed on the assignment's
+        (relpath, lineno) — the same site identity raceguard's
+        Program.lock_sites derives statically — and the module global is
+        swapped for the wrapper. Existing holders are unaffected: the
+        wrapper delegates to the SAME inner lock object, so mutual
+        exclusion is preserved; only acquisitions through the module
+        global after the swap are recorded (which is every future one —
+        the project always reaches these locks via their module global).
+        Idempotent: already-wrapped globals are skipped. Returns the
+        number of locks wrapped."""
+        import ast
+
+        lock_type = type(_REAL_LOCK())
+        rlock_type = type(_REAL_RLOCK())
+        if modules is None:
+            modules = [m for m in list(sys.modules.values())
+                       if self._module_site(m) is not None]
+        wrapped = 0
+        for mod in modules:
+            rel = self._module_site(mod)
+            if rel is None:
+                continue
+            try:
+                with open(mod.__file__, "r") as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in tree.body:
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not isinstance(value, ast.Call):
+                    continue
+                fn = value.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else \
+                    fn.id if isinstance(fn, ast.Name) else None
+                if name not in ("Lock", "RLock"):
+                    continue
+                for tgt in targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    obj = getattr(mod, tgt.id, None)
+                    if isinstance(obj, WitnessLock):
+                        continue     # post-install construction / rerun
+                    if not isinstance(obj, (lock_type, rlock_type)):
+                        continue
+                    site = (rel, node.lineno)
+                    self._rewrapped.append((mod, tgt.id, obj))
+                    setattr(mod, tgt.id, WitnessLock(
+                        self, obj, site,
+                        reentrant=isinstance(obj, rlock_type)))
+                    with self._meta:
+                        self.constructed[site] = \
+                            self.constructed.get(site, 0) + 1
+                    wrapped += 1
+        return wrapped
+
+    def _module_site(self, mod) -> Optional[str]:
+        """The module's repo-relative path when it lives under a
+        configured prefix, else None."""
+        path = getattr(mod, "__file__", None)
+        return None if not path else self._rel_under_prefixes(path)
 
     # ---- mutation watch -------------------------------------------------
     def watch(self, obj, attrs: Sequence[str], lock: "WitnessLock") -> None:
