@@ -30,6 +30,9 @@ Environment:
   DRUID_TPU_BENCH_CLIENTS         concurrent closed-loop clients (default 8)
   DRUID_TPU_BENCH_CLIENT_QUERIES  queries per client per mode (default 12)
   DRUID_TPU_BENCH_SCHED_ROWS      rows per segment in that mode (default 4096)
+  DRUID_TPU_BENCH_SOAK            opt-in soak mode: N query waves + server
+                                  start/stop cycles, reporting rss/fd/thread
+                                  drift in the JSON line (default off)
 """
 import json
 import os
@@ -382,6 +385,73 @@ def _bench_scheduler():
     }
 
 
+def _bench_soak():
+    """Opt-in (DRUID_TPU_BENCH_SOAK=<waves>) resource-drift mode: repeated
+    query waves + full server start/stop cycles, reporting rss/fd/thread
+    drift between a post-warmup baseline and the end state. Zero drift is
+    the contract a months-long serving process needs; any linear growth
+    here is the wedged-run (rc=124) failure class in miniature."""
+    import gc
+    import threading
+
+    from druid_tpu.cluster.dataserver import DataNodeServer
+    from druid_tpu.cluster.view import DataNode
+
+    waves = int(os.environ.get("DRUID_TPU_BENCH_SOAK", 0))
+    if waves <= 0:
+        return {}
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_SCHED_ROWS", 4096))
+    n_segments = 4
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    sids = [str(s.id) for s in segments]
+    query = batch_groupby()
+
+    def rss_kb() -> int:
+        try:
+            with open("/proc/self/status") as fh:
+                for line in fh:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1])
+        except OSError:
+            pass
+        return 0
+
+    def fd_count() -> int:
+        try:
+            return len(os.listdir("/proc/self/fd"))
+        except OSError:
+            return 0
+
+    def cycle():
+        node = DataNode("soak-node")
+        for s in segments:
+            node.load_segment(s)
+        srv = DataNodeServer(node).start()
+        try:
+            for _ in range(3):
+                node.run_partials(query, sids)
+        finally:
+            srv.stop()
+
+    cycle()                               # warmup: lazy init + compiles
+    gc.collect()
+    base = (rss_kb(), fd_count(), threading.active_count())
+    t0 = time.time()
+    for _ in range(waves):
+        cycle()
+    gc.collect()
+    end = (rss_kb(), fd_count(), threading.active_count())
+    log(f"soak: {waves} wave(s) in {time.time() - t0:.1f}s — rss drift "
+        f"{end[0] - base[0]}KB, fd drift {end[1] - base[1]}, thread "
+        f"drift {end[2] - base[2]}")
+    return {
+        "soak_waves": waves,
+        "soak_rss_drift_kb": end[0] - base[0],
+        "soak_fd_drift": end[1] - base[1],
+        "soak_thread_drift": end[2] - base[2],
+    }
+
+
 def main():
     rows = int(os.environ.get("DRUID_TPU_BENCH_ROWS", 100_000_000))
     n_segments = int(os.environ.get("DRUID_TPU_BENCH_SEGMENTS", 8))
@@ -445,6 +515,11 @@ def main():
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"sched-bench failed: {type(e).__name__}: {e}")
         sched = {"sched_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        soak = _bench_soak()
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"soak-bench failed: {type(e).__name__}: {e}")
+        soak = {"soak_error": f"{type(e).__name__}: {e}"[:200]}
 
     value = 2 * total_rows / (t_gb + t_tn)
     baseline = 36_246_530.0  # Java rows/sec/core scan-aggregate upper bound
@@ -459,6 +534,7 @@ def main():
     out.update(batch)
     out.update(traced)
     out.update(sched)
+    out.update(soak)
     print(json.dumps(out), flush=True)
 
 

@@ -196,6 +196,10 @@ class RemoteCacheServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        # reap the serve_forever thread: a stop() that returns while the
+        # acceptor still winds down strands one thread per server cycle
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
 
 class RemoteCacheClient(Cache):

@@ -322,6 +322,9 @@ class DataNodeServer:
         self._restore_sink()
         self._httpd.shutdown()
         self._httpd.server_close()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
         if self.scheduler is not None:
             # after the listener: no new submits can arrive; queued
             # waiters fail fast instead of hanging on a dead dispatcher

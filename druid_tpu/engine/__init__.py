@@ -50,4 +50,37 @@ if _cc != "0":
 
 from druid_tpu.engine.executor import QueryExecutor  # noqa: E402
 
-__all__ = ["QueryExecutor"]
+
+def release_device_caches(clear_pool: bool = False) -> dict:
+    """Drop every process-wide cache that pins device memory across
+    queries: the sharded stack cache (whole segment sets held HBM-resident
+    — and the segment OBJECTS each entry pins), the jitted-program LRUs
+    (closures capture kernel aux arrays), and, with `clear_pool=True`, the
+    device segment pool's entries. The ops surface for reclaiming HBM
+    without a restart; the leak witness's session check uses it so that
+    deliberately-pinned cache state is not mistaken for a leak. Returns
+    per-cache drop counts."""
+    from druid_tpu.engine import batching, grouping
+    from druid_tpu.parallel import distributed
+
+    with grouping._JIT_CACHE_LOCK:
+        grouping_n = len(grouping._JIT_CACHE)
+        grouping._JIT_CACHE.clear()
+    with batching._JIT_CACHE_LOCK:
+        batching_n = len(batching._JIT_CACHE)
+        batching._JIT_CACHE.clear()
+    out = {
+        "stack_entries": distributed.clear_stack_cache(),
+        "sharded_programs": distributed.clear_fn_cache(),
+        "grouping_programs": grouping_n,
+        "batching_programs": batching_n,
+    }
+    if clear_pool:
+        from druid_tpu.data.devicepool import device_pool
+        pool = device_pool()
+        out["pool_resident_bytes"] = pool.snapshot().resident_bytes
+        pool.clear()
+    return out
+
+
+__all__ = ["QueryExecutor", "release_device_caches"]

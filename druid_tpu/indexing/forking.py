@@ -101,6 +101,8 @@ class TaskActionServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def status(self, task_id: str) -> Optional[TaskStatus]:
         """Locked read of a peon-reported status — monitors poll this
@@ -516,7 +518,14 @@ class ForkingTaskRunner:
         self._shutdown = True
         with self._lock:
             procs = list(self.processes.values())
+            monitors = list(self._monitors.values())
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        # reap the monitor threads before tearing down the action server
+        # they report through: each sees its peon dead + the shutdown flag
+        # and finishes; an unjoined monitor would race the teardown below
+        for t in monitors:
+            if t.is_alive():
+                t.join(timeout=5.0)
         self.actions.stop()

@@ -105,6 +105,8 @@ class EventReceiverFirehose(Firehose):
         self.close()
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     # ---- Firehose ------------------------------------------------------
     def batches(self, batch_size: int = 65536) -> Iterator[List]:

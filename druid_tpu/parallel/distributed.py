@@ -327,6 +327,26 @@ def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
     return result
 
 
+def clear_stack_cache() -> int:
+    """Release the HBM-resident stacked segment sets (and the segment
+    objects each entry deliberately pins). Returns the entry count
+    dropped. The ops analog of unloading segments to reclaim HBM without
+    a restart — engine.release_device_caches() is the public surface."""
+    with _CACHE_LOCK:
+        n = len(_STACK_CACHE)
+        _STACK_CACHE.clear()
+        return n
+
+
+def clear_fn_cache() -> int:
+    """Drop the jitted sharded programs (their closures pin kernel aux
+    arrays across segment generations)."""
+    with _CACHE_LOCK:
+        n = len(_FN_CACHE)
+        _FN_CACHE.clear()
+        return n
+
+
 # aux layout shared with the batched path (engine/grouping.py)
 _assemble_aux = assemble_stacked_aux
 

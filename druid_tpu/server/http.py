@@ -496,3 +496,6 @@ class QueryHttpServer:
         self._restore_sink()
         self._httpd.shutdown()
         self._httpd.server_close()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)

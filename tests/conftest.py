@@ -24,6 +24,21 @@ if os.environ.get("DRUID_TPU_LOCK_WITNESS") == "1":
     from tools.druidlint.lockwitness import session_witness as _session_witness
     _session_witness(str(_Path(__file__).resolve().parent.parent))
 
+# Opt-in whole-suite leak witness (DRUID_TPU_LEAK_WITNESS=1): installed
+# BEFORE the first druid_tpu import so every project thread start is
+# attributed, with the session baseline captured at the SAME point — the
+# suite must return to its post-install resource state (threads, fds,
+# device-pool resident bytes) by pytest_unconfigure. Same process-wide
+# singleton rationale as the lock witness above.
+if os.environ.get("DRUID_TPU_LEAK_WITNESS") == "1":
+    import sys as _sys
+    from pathlib import Path as _Path
+    _root = str(_Path(__file__).resolve().parent.parent)
+    if _root not in _sys.path:
+        _sys.path.insert(0, _root)
+    from tools.druidlint.leakwitness import session_witness as _leak_witness
+    _leak_witness(_root)
+
 import jax
 
 # The environment's sitecustomize may have force-registered a TPU plugin and
@@ -116,7 +131,53 @@ def rows_as_frame(segment):
 # ---------------------------------------------------------------------------
 
 
+def pytest_collection_finish(session):
+    """Re-baseline the leak witness AFTER collection: importing the test
+    modules pulls in nearly all of druid_tpu (module singletons, jax
+    backend side effects), and those one-time allocations are process
+    state, not suite leaks. The return-to-baseline contract starts here."""
+    if os.environ.get("DRUID_TPU_LEAK_WITNESS") != "1":
+        return
+    from tools.druidlint.leakwitness import session_witness
+    w = session_witness()
+    if w is not None:
+        w.baseline = w.snapshot()
+
+
 def pytest_unconfigure(config):
+    # a lock-witness violation must not skip the leak check (or leave
+    # Thread.start monkeypatched): run both even if the first raises
+    try:
+        _unconfigure_lock_witness()
+    finally:
+        _unconfigure_leak_witness()
+
+
+def _unconfigure_leak_witness():
+    if os.environ.get("DRUID_TPU_LEAK_WITNESS") != "1":
+        return
+    from tools.druidlint.leakwitness import end_session_witness
+    w = end_session_witness()
+    if w is None or w.baseline is None:
+        return
+    # deliberately-pinned cache state is not a leak: drop the engine's
+    # device caches (stack cache pins whole segment sets) so the pool
+    # axis measures unreleased OWNERSHIP, not cache policy. The pool
+    # itself is NOT cleared — entries must die with their segments.
+    from druid_tpu.engine import release_device_caches
+    release_device_caches()
+    leaks = w.leaks(grace_s=10.0)
+    print(f"leakwitness: {len(w._started)} project thread start(s) "
+          f"witnessed, {len(leaks)} leak(s) vs the post-collection "
+          f"baseline")
+    for l in leaks:
+        print(f"leakwitness: LEAK {l}")
+    if leaks:
+        raise pytest.UsageError(
+            "leak witness found resource leaks (see lines above)")
+
+
+def _unconfigure_lock_witness():
     if os.environ.get("DRUID_TPU_LOCK_WITNESS") != "1":
         return
     from tools.druidlint.lockwitness import end_session_witness

@@ -40,12 +40,15 @@ def test_service_emitter_stamps_dims():
 def test_batching_emitter():
     batches = []
     be = BatchingEmitter(batches.append, batch_size=3)
-    em = ServiceEmitter("s", "h", be)
-    for i in range(7):
-        em.metric("m", i)
-    assert len(batches) == 2 and all(len(b) == 3 for b in batches)
-    be.flush()
-    assert sum(len(b) for b in batches) == 7
+    try:
+        em = ServiceEmitter("s", "h", be)
+        for i in range(7):
+            em.metric("m", i)
+        assert len(batches) == 2 and all(len(b) == 3 for b in batches)
+        be.flush()
+        assert sum(len(b) for b in batches) == 7
+    finally:
+        be.close()                 # the flush timer is a real thread
 
 
 def test_file_emitter(tmp_path):

@@ -28,6 +28,7 @@ BENCH_ENV = {
     "DRUID_TPU_BENCH_CLIENTS": "4",
     "DRUID_TPU_BENCH_CLIENT_QUERIES": "3",
     "DRUID_TPU_BENCH_SCHED_ROWS": "1024",
+    "DRUID_TPU_BENCH_SOAK": "2",
 }
 
 
@@ -72,6 +73,12 @@ def test_bench_exits_zero_with_one_json_line():
     for mode in ("off", "on"):
         assert out[f"sched_{mode}_p50_ms"] > 0
         assert out[f"sched_{mode}_p99_ms"] >= out[f"sched_{mode}_p50_ms"]
+    # the soak-mode drift fields (contract: present and near-zero on the
+    # countable axes; rss is allocator-noisy, so presence only)
+    assert out["soak_waves"] == 2
+    assert abs(out["soak_thread_drift"]) <= 1
+    assert abs(out["soak_fd_drift"]) <= 4
+    assert isinstance(out["soak_rss_drift_kb"], int)
 
 
 def test_bench_falls_back_to_cpu_on_bad_backend():

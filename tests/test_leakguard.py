@@ -1,0 +1,779 @@
+"""leakguard unit battery: each resource-lifecycle rule must fire on its
+positive shape, stay quiet on the released/escaped/suppressed shapes, and
+the dynamic leak witness must detect (and clear) a real runtime leak.
+
+Pattern mirrors tests/test_raceguard.py: check_source with a root-less
+config analyzes each snippet standalone through the real rule registry, so
+suppression/baseline behavior is exactly the shipped one.
+"""
+import os
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.druidlint.core import LintConfig, check_source  # noqa: E402
+
+
+def cfg(*rules) -> LintConfig:
+    c = LintConfig(rules=list(rules) if rules else [])
+    c.root = "/nonexistent-leakguard-root"
+    return c
+
+
+def findings_of(source: str, rule: str, path: str = "druid_tpu/mod.py"):
+    return [f for f in check_source(source, path, cfg(rule))
+            if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# unjoined-thread
+# ---------------------------------------------------------------------------
+
+def test_started_thread_never_joined_fires():
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unjoined-thread")
+    assert len(got) == 1
+    assert "never joined" in got[0].message
+
+
+def test_thread_joined_with_timeout_on_stop_is_quiet():
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._t.join(timeout=5.0)
+"""
+    assert findings_of(src, "unjoined-thread") == []
+
+
+def test_join_off_the_shutdown_surface_fires():
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def wait(self):
+        self._t.join(timeout=5.0)
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unjoined-thread")
+    assert len(got) == 1
+    assert "not on any shutdown path" in got[0].message
+
+
+def test_join_without_timeout_on_stop_fires():
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._t.join()
+"""
+    got = findings_of(src, "unjoined-thread")
+    assert len(got) == 1
+    assert "without a timeout" in got[0].message
+
+
+def test_unstarted_thread_is_quiet():
+    """A constructed-but-never-started Thread pins no OS resource."""
+    src = """\
+import threading
+
+class Lazy:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unjoined-thread") == []
+
+
+def test_container_threads_joined_via_snapshot_idiom_quiet():
+    """`ts = list(self._threads.values())` under the lock, join outside —
+    the exact shape the lock-scope rule forces — must count as a join."""
+    src = """\
+import threading
+
+class Runner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._threads = {}
+
+    def launch(self, key):
+        t = threading.Thread(target=self._run)
+        self._threads[key] = t
+        t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        with self._lock:
+            ts = list(self._threads.values())
+        for t in ts:
+            t.join(timeout=5.0)
+"""
+    assert findings_of(src, "unjoined-thread") == []
+
+
+def test_container_threads_never_joined_fires():
+    src = """\
+import threading
+
+class Runner:
+    def __init__(self):
+        self._threads = {}
+
+    def launch(self, key):
+        t = threading.Thread(target=self._run)
+        self._threads[key] = t
+        t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._threads.clear()
+"""
+    got = findings_of(src, "unjoined-thread")
+    assert len(got) == 1
+
+
+def test_unjoined_thread_suppression():
+    src = """\
+import threading
+
+class Pump:
+    def __init__(self):
+        self._t = threading.Thread(target=self._run)  # druidlint: disable=unjoined-thread  # daemon heartbeat, dies with process
+        self._t.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unjoined-thread") == []
+
+
+# ---------------------------------------------------------------------------
+# unreleased-resource
+# ---------------------------------------------------------------------------
+
+def test_executor_without_shutdown_fires():
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unreleased-resource")
+    assert len(got) == 1
+    assert "no release" in got[0].message
+
+
+def test_executor_shutdown_on_stop_is_quiet():
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+
+    def stop(self):
+        self._pool.shutdown(wait=True)
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
+def test_release_reachable_through_helper_is_quiet():
+    """stop() -> self._teardown() -> close(): the release is reachable
+    through the self-call closure, not just textually in stop()."""
+    src = """\
+class Holder:
+    def __init__(self, path):
+        self._fh = open(path)
+
+    def _teardown(self):
+        self._fh.close()
+
+    def stop(self):
+        self._teardown()
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
+def test_release_off_the_shutdown_surface_fires():
+    src = """\
+class Holder:
+    def __init__(self, path):
+        self._fh = open(path)
+
+    def rotate(self, path):
+        self._fh.close()
+        self._fh = open(path)
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unreleased-resource")
+    assert got, "release only in rotate() must not satisfy stop()"
+    assert "outside the shutdown surface" in got[0].message
+
+
+def test_escaped_attribute_transfers_ownership():
+    """Passing self._pool to a registrar hands off the stop obligation."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+class Fan:
+    def __init__(self, lifecycle):
+        self._pool = ThreadPoolExecutor(4)
+        lifecycle.register(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
+def test_held_threaded_service_needs_stop():
+    """A class whose ctor starts a thread is itself a resource: holding
+    one without stopping it strands the worker."""
+    src = """\
+import threading
+
+class Emitter:
+    def __init__(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        self._t.join(timeout=5.0)
+
+class Server:
+    def __init__(self):
+        self.emitter = Emitter()
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unreleased-resource")
+    assert len(got) == 1
+    assert "Server.emitter" in got[0].message
+
+
+def test_held_service_stopped_is_quiet():
+    src = """\
+import threading
+
+class Emitter:
+    def __init__(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+        pass
+
+    def close(self):
+        self._t.join(timeout=5.0)
+
+class Server:
+    def __init__(self):
+        self.emitter = Emitter()
+
+    def stop(self):
+        self.emitter.close()
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
+def test_startable_service_only_owed_when_started():
+    """A held start()/stop() object the owner never start()s is inert —
+    constructing one in a test owes nothing."""
+    quiet = """\
+class Sched:
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+class Owner:
+    def __init__(self):
+        self.sched = Sched()
+"""
+    assert findings_of(quiet, "unreleased-resource") == []
+    noisy = quiet + """\
+
+class Starter:
+    def __init__(self):
+        self.sched = Sched()
+        self.sched.start()
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(noisy, "unreleased-resource")
+    assert len(got) == 1
+    assert "Starter.sched" in got[0].message
+
+
+# ---------------------------------------------------------------------------
+# leak-on-error-path
+# ---------------------------------------------------------------------------
+
+def test_acquire_then_raising_call_fires():
+    src = """\
+import json
+
+def load(path, meta):
+    fh = open(path)
+    parsed = json.loads(meta)
+    return fh, parsed
+"""
+    got = findings_of(src, "leak-on-error-path")
+    assert len(got) == 1
+    assert "`fh`" in got[0].message
+
+
+def test_context_manager_is_quiet():
+    src = """\
+import json
+
+def load(path, meta):
+    with open(path) as fh:
+        parsed = json.loads(meta)
+        return fh.read(), parsed
+"""
+    assert findings_of(src, "leak-on-error-path") == []
+
+
+def test_try_finally_is_quiet():
+    src = """\
+import json
+
+def load(path, meta):
+    fh = open(path)
+    try:
+        parsed = json.loads(meta)
+        return fh.read(), parsed
+    finally:
+        fh.close()
+"""
+    assert findings_of(src, "leak-on-error-path") == []
+
+
+def test_immediate_ownership_transfer_is_quiet():
+    """`self._fh = fh` right after the open: the owner's release rules
+    take over; later raise-capable calls are not THIS function's leak."""
+    src = """\
+import json
+
+class Holder:
+    def __init__(self, path, meta):
+        fh = open(path)
+        self._fh = fh
+        self.meta = json.loads(meta)
+
+    def close(self):
+        self._fh.close()
+"""
+    assert findings_of(src, "leak-on-error-path") == []
+
+
+def test_methods_on_the_resource_itself_are_quiet():
+    """fh.write() raising still leaks fh, but flagging the universal
+    open-write-close shape would be noise — only FOREIGN calls count."""
+    src = """\
+def dump(path, payload):
+    fh = open(path, "w")
+    fh.write(payload)
+    return fh
+"""
+    assert findings_of(src, "leak-on-error-path") == []
+
+
+# ---------------------------------------------------------------------------
+# finalizer-unsafe
+# ---------------------------------------------------------------------------
+
+def test_finalizer_taking_lock_fires():
+    src = """\
+import threading
+import weakref
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _purge(self):
+        with self._lock:
+            pass
+
+    def track(self, obj):
+        weakref.finalize(obj, self._purge)
+"""
+    got = findings_of(src, "finalizer-unsafe")
+    assert len(got) == 1
+    assert "self-deadlock" in got[0].message
+
+
+def test_finalizer_lock_via_transitive_call_fires():
+    src = """\
+import threading
+import weakref
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _evict(self):
+        with self._lock:
+            pass
+
+    def _purge(self):
+        self._evict()
+
+    def track(self, obj):
+        weakref.finalize(obj, self._purge)
+"""
+    assert len(findings_of(src, "finalizer-unsafe")) == 1
+
+
+def test_del_taking_lock_fires():
+    src = """\
+import threading
+
+class Handle:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        with self._lock:
+            pass
+"""
+    got = findings_of(src, "finalizer-unsafe")
+    assert len(got) == 1
+    assert "__del__" in got[0].message
+
+
+def test_lock_free_finalizer_is_quiet():
+    """The devicepool idiom: finalizers only append to an atomic deque."""
+    src = """\
+import collections
+import weakref
+
+class Pool:
+    def __init__(self):
+        self._dead = collections.deque()
+
+    def _note_dead(self, token):
+        self._dead.append(token)
+
+    def track(self, obj, token):
+        weakref.finalize(obj, self._note_dead, token)
+"""
+    assert findings_of(src, "finalizer-unsafe") == []
+
+
+# ---------------------------------------------------------------------------
+# stop-start-pairing
+# ---------------------------------------------------------------------------
+
+def test_unrestored_foreign_wiring_fires():
+    src = """\
+class Lifecycle:
+    def __init__(self):
+        self.on_result = None
+
+class Chainer:
+    def __init__(self, life: Lifecycle):
+        self.life = life
+
+    def start(self):
+        self.life.on_result = self._cb
+
+    def _cb(self):
+        pass
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "stop-start-pairing")
+    assert len(got) == 1
+    assert "Lifecycle.on_result" in got[0].message
+
+
+def test_restored_wiring_is_quiet():
+    src = """\
+class Lifecycle:
+    def __init__(self):
+        self.on_result = None
+
+class Chainer:
+    def __init__(self, life: Lifecycle):
+        self.life = life
+        self._prev = None
+
+    def start(self):
+        self._prev = self.life.on_result
+        self.life.on_result = self._cb
+
+    def _cb(self):
+        pass
+
+    def stop(self):
+        self.life.on_result = self._prev
+"""
+    assert findings_of(src, "stop-start-pairing") == []
+
+
+def test_restore_closure_at_wiring_site_is_quiet():
+    """The compose_sink idiom: the undo lives in a nested closure created
+    by the wiring function itself."""
+    src = """\
+class Emitter:
+    def __init__(self):
+        self.sink = None
+
+class Composer:
+    def __init__(self, emitter: Emitter):
+        self.emitter = emitter
+        self._restore = None
+
+    def start(self):
+        emitter = self.emitter
+        prev = emitter.sink
+
+        def restore():
+            emitter.sink = prev
+
+        emitter.sink = self._sink
+        self._restore = restore
+
+    def _sink(self):
+        pass
+
+    def stop(self):
+        self._restore()
+"""
+    assert findings_of(src, "stop-start-pairing") == []
+
+
+def test_own_state_and_owned_objects_are_not_wiring():
+    """Writes to self.* and to objects this class itself constructs die
+    with the class — no pairing obligation."""
+    src = """\
+class Worker:
+    def __init__(self):
+        self.running = False
+
+class Owner:
+    def __init__(self):
+        self.worker = Worker()
+        self.running = False
+
+    def start(self):
+        self.running = True
+        self.worker.running = True
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "stop-start-pairing") == []
+
+
+# ---------------------------------------------------------------------------
+# leak witness (dynamic)
+# ---------------------------------------------------------------------------
+
+def _witness_for(tmp_path):
+    from tools.druidlint.leakwitness import LeakWitness
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir(exist_ok=True)
+    src_path = pkg / "leaky.py"
+    src_path.write_text("""\
+import threading
+
+
+def start_worker(event):
+    t = threading.Thread(target=event.wait, daemon=True)
+    t.start()
+    return t
+""")
+    ns = {}
+    code = compile(src_path.read_text(), str(src_path), "exec")
+    exec(code, ns)
+    return LeakWitness(str(tmp_path)), ns["start_worker"]
+
+
+def test_witness_attributes_and_clears_thread_leak(tmp_path):
+    witness, start_worker = _witness_for(tmp_path)
+    release = threading.Event()
+    with witness:
+        base = witness.snapshot()
+        t = start_worker(release)
+        try:
+            leaks = witness.leaks(base, grace_s=0.2)
+            assert any("druid_tpu/leaky.py" in l and "thread leak" in l
+                       for l in leaks), leaks
+            release.set()
+            t.join(timeout=5.0)
+            assert witness.leaks(base, grace_s=5.0) == []
+        finally:
+            release.set()
+
+
+def test_witness_ignores_foreign_threads(tmp_path):
+    """Threads started with no project frame on the stack (pytest, jax)
+    are never attributed."""
+    witness, _ = _witness_for(tmp_path)
+    release = threading.Event()
+    with witness:
+        base = witness.snapshot()
+        t = threading.Thread(target=release.wait, daemon=True)
+        t.start()
+        try:
+            assert witness.leaks(base, grace_s=0.2) == []
+        finally:
+            release.set()
+            t.join(timeout=5.0)
+
+
+def test_witness_detects_fd_leak(tmp_path):
+    witness, _ = _witness_for(tmp_path)
+    with witness:
+        base = witness.snapshot()
+        if not base.fds:
+            return                   # platform without /proc/self/fd
+        fh = open(tmp_path / "leaked.txt", "w")
+        try:
+            leaks = witness.leaks(base, grace_s=0.2)
+            assert any("fd leak" in l and "leaked.txt" in l
+                       for l in leaks), leaks
+        finally:
+            fh.close()
+        assert witness.leaks(base, grace_s=2.0) == []
+
+
+def test_witness_fd_axis_counts_targets_not_fd_numbers(tmp_path):
+    """The fd axis is a multiset of readlink targets: re-opening a
+    baseline file on a DIFFERENT fd number is not growth (log-rotation
+    shape), while a second concurrent open of the same target is a leak
+    even though the baseline fd number may have been reused."""
+    witness, _ = _witness_for(tmp_path)
+    path = tmp_path / "rotated.log"
+    with witness:
+        held = open(path, "w")
+        try:
+            base = witness.snapshot()
+            if not base.fds:
+                return               # platform without /proc/self/fd
+            # close + re-open: lands on some fd (often the same number,
+            # sometimes not) — either way the target count is unchanged
+            held.close()
+            held = open(path, "w")
+            assert witness.leaks(base, grace_s=0.2) == []
+            # a SECOND open of the same target is real growth
+            extra = open(path, "r")
+            try:
+                leaks = witness.leaks(base, grace_s=0.2)
+                assert any("fd leak" in l and "rotated.log" in l
+                           for l in leaks), leaks
+            finally:
+                extra.close()
+            assert witness.leaks(base, grace_s=2.0) == []
+        finally:
+            held.close()
+
+
+def test_witness_detects_pool_growth(tmp_path, monkeypatch):
+    from druid_tpu.data import devicepool
+
+    class FakeBlock:
+        nbytes = 4096
+
+    pool = devicepool.DeviceSegmentPool(budget_bytes=1 << 20)
+    monkeypatch.setattr(devicepool, "_POOL", pool)
+    witness, _ = _witness_for(tmp_path)
+
+    class Owner:
+        pass
+
+    owner_obj = Owner()
+    with witness:
+        base = witness.snapshot()
+        token = pool.register_owner(owner_obj)
+        pool.get_or_build(token, ("blk",), FakeBlock)
+        leaks = witness.leaks(base, grace_s=0.2)
+        assert any("device pool leak" in l for l in leaks), leaks
+        pool.purge_owner(token)
+        assert witness.leaks(base, grace_s=2.0) == []
+
+
+@pytest.mark.skipif(
+    os.environ.get("DRUID_TPU_LEAK_WITNESS") == "1",
+    reason="the session-wide witness owns the singleton slot")
+def test_witness_session_singleton():
+    from tools.druidlint import leakwitness
+    try:
+        w1 = leakwitness.session_witness(str(Path(__file__).parent.parent))
+        w2 = leakwitness.session_witness(str(Path(__file__).parent.parent))
+        assert w1 is w2
+        assert w1.baseline is not None
+    finally:
+        leakwitness.end_session_witness()
+    assert leakwitness.session_witness() is None

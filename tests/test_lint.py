@@ -23,24 +23,46 @@ from tools.druidlint.core import split_by_baseline  # noqa: E402
 
 
 def test_tree_is_clean_and_fast():
-    """`python -m tools.druidlint --fail-on-new` exits 0 on the shipped
-    tree, and the full-package scan stays under the 10s budget. The first
-    run may be cold (fresh checkout: no .druidlint-cache.json — raceguard's
-    whole-program pass alone costs several seconds); the budget is enforced
-    on the mtime-cached scan, which is what every scan after the first is."""
+    """`python -m tools.druidlint --all --fail-on-new` — the UNIFIED gate:
+    all four analyzer families (druidlint/tracecheck/raceguard/leakguard)
+    in one process over the shared program/cache pass — exits 0 on the
+    shipped tree under a single wall-clock budget. The first run may be
+    cold (fresh checkout: no .druidlint-cache.json — the whole-program
+    index alone costs several seconds); the budget is enforced on the
+    mtime-cached scan, which is what every scan after the first is."""
     proc = subprocess.run(
-        [sys.executable, "-m", "tools.druidlint", "--fail-on-new"],
+        [sys.executable, "-m", "tools.druidlint", "--all", "--fail-on-new"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, (
         f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
     t0 = time.monotonic()
     proc = subprocess.run(
-        [sys.executable, "-m", "tools.druidlint", "--fail-on-new"],
+        [sys.executable, "-m", "tools.druidlint", "--all", "--fail-on-new",
+         "--json"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
     elapsed = time.monotonic() - t0
     assert proc.returncode == 0, (
         f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
-    assert elapsed < 10.0, f"druidlint scan took {elapsed:.1f}s (budget 10s)"
+    assert elapsed < 10.0, (
+        f"unified gate took {elapsed:.1f}s (budget 10s for all four "
+        f"families together)")
+    payload = json.loads(proc.stdout)
+    assert set(payload["families"]) == {"druidlint", "tracecheck",
+                                        "raceguard", "leakguard"}
+    for name, info in payload["families"].items():
+        assert info["rules"] > 0, f"family {name} registered no rules"
+        assert info["findings"] == 0
+
+
+def test_all_rejects_only():
+    """--all is the whole gate by definition; a rule subset would verify
+    less than the unified contract claims."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--all",
+         "--only", "swallowed-exception"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "--only" in proc.stderr
 
 
 def test_baseline_is_near_empty():
@@ -216,6 +238,59 @@ VIOLATIONS = {
         "    with _lock:\n"
         "        return x + 1\n"
         "fn = jax.jit(kernel)\n"),
+    # ---- leakguard rules ----
+    "unjoined-thread": (
+        "druid_tpu/cluster/leakything.py",
+        "import threading\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        pass\n"
+        "    def stop(self):\n"
+        "        pass\n"),
+    "unreleased-resource": (
+        "druid_tpu/cluster/leakything.py",
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class Fan:\n"
+        "    def __init__(self):\n"
+        "        self._pool = ThreadPoolExecutor(4)\n"
+        "    def stop(self):\n"
+        "        pass\n"),
+    "leak-on-error-path": (
+        "druid_tpu/storage/leakything.py",
+        "import json\n"
+        "def load(path, meta):\n"
+        "    fh = open(path)\n"
+        "    parsed = json.loads(meta)\n"
+        "    return fh, parsed\n"),
+    "finalizer-unsafe": (
+        "druid_tpu/data/leakything.py",
+        "import threading\n"
+        "import weakref\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _purge(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def track(self, obj):\n"
+        "        weakref.finalize(obj, self._purge)\n"),
+    "stop-start-pairing": (
+        "druid_tpu/server/leakything.py",
+        "class Lifecycle:\n"
+        "    def __init__(self):\n"
+        "        self.on_result = None\n"
+        "class Chainer:\n"
+        "    def __init__(self, life: Lifecycle):\n"
+        "        self.life = life\n"
+        "    def start(self):\n"
+        "        self.life.on_result = self._cb\n"
+        "    def _cb(self):\n"
+        "        pass\n"
+        "    def stop(self):\n"
+        "        pass\n"),
 }
 
 
@@ -243,8 +318,8 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 
 def test_rule_registry_is_complete():
     """All project rules (seven control-plane incl. metric-name + seven
-    tracecheck + four raceguard) plus the unused-suppression audit are
-    registered with severities."""
+    tracecheck + four raceguard + five leakguard) plus the
+    unused-suppression audit are registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

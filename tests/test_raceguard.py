@@ -575,6 +575,253 @@ class View:
     assert ("View._lock", "Timeline._lock") in edges
 
 
+def test_iteration_element_typing_extends_order_graph():
+    """`for rs in self._replicas.values():` types the loop variable from
+    the Dict value annotation — acquisitions inside the element class
+    join the order graph (the ROADMAP replica-set/timeline rider)."""
+    src = '''\
+import threading
+from typing import Dict
+
+class ReplicaSet:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, ReplicaSet] = {}
+
+    def sweep(self):
+        with self._lock:
+            for rs in self._replicas.values():
+                rs.poke()
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("View._lock", "ReplicaSet._lock") in edges
+
+
+def test_iteration_element_typing_items_and_list():
+    """`for k, rs in d.items()` binds the SECOND target; plain iteration
+    binds elements for List (sequence) annotations but NOT for Dict
+    (plain mapping iteration yields keys, typing them as values would
+    fabricate edges)."""
+    src = '''\
+import threading
+from typing import Dict, List
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_name: Dict[str, Node] = {}
+        self._all: List[Node] = []
+
+    def sweep_items(self):
+        with self._lock:
+            for name, n in self._by_name.items():
+                n.poke()
+
+    def sweep_list(self):
+        with self._lock:
+            for n in self._all:
+                n.poke()
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("View._lock", "Node._lock") in edges
+    # mapping keys must NOT be typed as elements
+    prog2 = analyze_sources(
+        {"druid_tpu/m.py": '''\
+import threading
+from typing import Dict
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_name: Dict[str, Node] = {}
+
+    def sweep(self):
+        with self._lock:
+            for n in self._by_name:
+                n.poke()
+'''}, cfg())
+    edges2 = {(a.split("::")[-1], b.split("::")[-1])
+              for a, b in prog2.order_edges}
+    assert ("View._lock", "Node._lock") not in edges2
+
+
+def test_comprehension_target_does_not_clobber_typed_local():
+    """Comprehension targets are their own scope in py3: a comprehension
+    reusing a typed local's name must not invalidate that binding (the
+    binder's reassigned-twice rule would otherwise silently drop the
+    (View._lock, Node._lock) edge), and a comprehension over a typed
+    List still types calls INSIDE its own body."""
+    src = '''\
+import threading
+from typing import List
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class Elem:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class View:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._elems: List[Elem] = []
+        self.ids = ("a", "b")
+        self.node = Node()
+
+    def sweep(self):
+        ids = [n for n in self.ids]       # untyped comp reuses the name
+        n = self.node                     # ...of a typed local
+        with self._lock:
+            n.poke()
+        return ids
+
+    def names(self):
+        with self._lock:
+            return [e.poke() for e in self._elems]
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    # sweep(): the statement binding survives the comprehension
+    assert ("View._lock", "Node._lock") in edges
+    # names(): the comp body itself still resolves via the List element
+    assert ("View._lock", "Elem._lock") in edges
+
+
+MANUAL_REGION = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def locked_bump(self):
+        self._lock.acquire()
+        try:
+            self.n += 1
+        finally:
+            self._lock.release()
+
+    def other(self):
+        {other_body}
+"""
+
+
+def test_manual_acquire_release_region_counts_as_locked():
+    """Positive/negative pair for manual held regions: a write inside an
+    acquire()/try/finally-release() region is LOCKED (mixing it with an
+    unlocked write fires; two manual regions are consistent)."""
+    # negative: both writes inside manual regions → quiet
+    quiet = MANUAL_REGION.format(other_body="""self._lock.acquire()
+        try:
+            self.n = 0
+        finally:
+            self._lock.release()""")
+    assert findings_of(quiet, "unguarded-shared-write") == []
+    # positive: one manual region + one bare write → the bare write fires
+    noisy = MANUAL_REGION.format(other_body="self.n = 0")
+    got = findings_of(noisy, "unguarded-shared-write")
+    assert len(got) == 1
+    assert got[0].line == 16                 # the bare write in other()
+
+
+def test_manual_release_ends_the_held_region():
+    """A write AFTER the statement-level release() is unlocked again —
+    the region must not extend past the release."""
+    src = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def sloppy(self):
+        self._lock.acquire()
+        self.n += 1
+        self._lock.release()
+        self.n = 0
+"""
+    got = findings_of(src, "unguarded-shared-write")
+    assert len(got) == 1
+    assert got[0].line == 16                 # only the post-release write
+
+
+def test_manual_region_held_at_call_sites_joins_order_graph():
+    """Calls made between acquire() and release() carry the lock in both
+    dataflows — a nested acquisition inside the region is an order edge."""
+    src = '''\
+import threading
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class Outer:
+    def __init__(self, inner: Inner):
+        self._lock = threading.Lock()
+        self.inner = inner
+
+    def run(self):
+        self._lock.acquire()
+        try:
+            self.inner.poke()
+        finally:
+            self._lock.release()
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("Outer._lock", "Inner._lock") in edges
+
+
 def test_thread_root_discovery_kinds():
     src = """\
 import threading

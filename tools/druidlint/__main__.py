@@ -15,9 +15,14 @@ import sys
 import time
 from pathlib import Path
 
-from tools.druidlint.core import (lint_paths, load_baseline, load_config,
-                                  registered_rules, save_baseline,
-                                  split_by_baseline)
+from tools.druidlint.core import (family_of, lint_paths, load_baseline,
+                                  load_config, registered_rules,
+                                  save_baseline, split_by_baseline)
+
+#: the four analyzer families --all asserts are all registered and runs in
+#: ONE process over ONE shared program/cache pass (tier-1 used to pay the
+#: whole-program index once per analyzer CLI invocation)
+_ALL_FAMILIES = ("druidlint", "tracecheck", "raceguard", "leakguard")
 
 
 def main(argv=None) -> int:
@@ -44,7 +49,17 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", action="store_true",
                     help="print the raceguard lock-order graph as graphviz "
                          "DOT (cycle members red) and exit")
+    ap.add_argument("--all", action="store_true", dest="all_families",
+                    help="unified gate: assert all four analyzer families "
+                         "(druidlint/tracecheck/raceguard/leakguard) are "
+                         "registered, run them in one process over the "
+                         "shared caches, and report findings per family")
     args = ap.parse_args(argv)
+
+    if args.all_families and args.only:
+        print("druidlint: --all runs every family; it cannot be combined "
+              "with --only", file=sys.stderr)
+        return 2
 
     if args.update_baseline and (args.paths or args.only):
         # a partial scan (by path OR by rule subset) would overwrite — and
@@ -74,6 +89,15 @@ def main(argv=None) -> int:
             return 2
     if args.report_unused_suppressions:
         config.report_unused_suppressions = True
+    if args.all_families:
+        # a family that fails to import/register would otherwise degrade
+        # the gate silently — the unified runner makes absence an error
+        present = {family_of(r) for r in registered_rules().values()}
+        missing = [f for f in _ALL_FAMILIES if f not in present]
+        if missing:
+            print(f"druidlint: --all: analyzer famil(ies) missing from the "
+                  f"registry: {missing}", file=sys.stderr)
+            return 2
     if args.dot:
         from tools.druidlint.raceguard import analyze_tree, render_dot
         print(render_dot(analyze_tree(root, config)), end="")
@@ -105,12 +129,33 @@ def main(argv=None) -> int:
         new, old, stale = findings, [], []
         report = findings
 
+    rules = registered_rules()
+
+    def fam(f):
+        r = rules.get(f.rule)
+        return family_of(r) if r is not None else "druidlint"
+
+    counts = {name: 0 for name in _ALL_FAMILIES}
+    if args.all_families:
+        for f in report:
+            counts[fam(f)] = counts.get(fam(f), 0) + 1
+
     if args.as_json:
-        print(json.dumps({"findings": [f.to_json() | {"col": f.col,
-                                                      "severity": f.severity}
-                                       for f in report],
-                          "grandfathered": len(old),
-                          "stale_baseline": stale}, indent=2))
+        payload = {"findings": [f.to_json() | {"col": f.col,
+                                               "severity": f.severity}
+                                | ({"family": fam(f)}
+                                   if args.all_families else {})
+                                for f in report],
+                   "grandfathered": len(old),
+                   "stale_baseline": stale}
+        if args.all_families:
+            payload["families"] = {
+                name: {"rules": sum(1 for r in rules.values()
+                                    if family_of(r) == name),
+                       "findings": counts.get(name, 0)}
+                for name in _ALL_FAMILIES}
+            payload["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(payload, indent=2))
     else:
         for f in report:
             print(f.format())
@@ -118,9 +163,16 @@ def main(argv=None) -> int:
             print(f"druidlint: note: baseline entry no longer fires "
                   f"(remove it): {key}")
         label = "new finding(s)" if args.fail_on_new else "finding(s)"
-        print(f"druidlint: {len(report)} {label}, {len(old)} "
-              f"grandfathered, {len(stale)} stale baseline entr(ies) "
-              f"in {elapsed:.2f}s")
+        if args.all_families:
+            per_family = ", ".join(f"{name} {counts.get(name, 0)}"
+                                   for name in _ALL_FAMILIES)
+            print(f"druidlint --all: {per_family} {label}; {len(old)} "
+                  f"grandfathered, {len(stale)} stale baseline entr(ies) "
+                  f"in {elapsed:.2f}s (one shared program pass)")
+        else:
+            print(f"druidlint: {len(report)} {label}, {len(old)} "
+                  f"grandfathered, {len(stale)} stale baseline entr(ies) "
+                  f"in {elapsed:.2f}s")
     return 1 if report else 0
 
 

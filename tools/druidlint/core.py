@@ -79,7 +79,19 @@ def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import rules as _rules  # noqa: F401 (registration)
     from tools.druidlint import tracecheck as _tracecheck  # noqa: F401
     from tools.druidlint import raceguard as _raceguard  # noqa: F401
+    from tools.druidlint import leakguard as _leakguard  # noqa: F401
     return dict(_RULES)
+
+
+#: analyzer family of a rule, derived from the registering module — the
+#: unified `--all` runner groups findings and timings by this
+_FAMILIES = {"rules": "druidlint", "tracecheck": "tracecheck",
+             "raceguard": "raceguard", "leakguard": "leakguard"}
+
+
+def family_of(r: Rule) -> str:
+    mod = getattr(r.check, "__module__", "") or ""
+    return _FAMILIES.get(mod.rsplit(".", 1)[-1], "druidlint")
 
 
 # ---- configuration -------------------------------------------------------

@@ -1,0 +1,277 @@
+"""leakwitness: a dynamic return-to-baseline witness for leakguard.
+
+leakguard's static model proves a release call is REACHABLE; the witness
+proves it actually RAN. It snapshots the three resource axes a long-running
+query service bleeds on — live project-started threads, open file
+descriptors, and device-pool resident bytes — and asserts that after a unit
+of work (a fixture, a soak cycle, the whole suite) the process returns to
+its baseline. A leak the static analyzer cannot see (a release behind a
+condition that never held, a thread whose join silently timed out) shows up
+here with the site that started it, exactly like lockwitness closes the
+loop on raceguard's order graph.
+
+Mechanics:
+  * install() monkeypatches threading.Thread.start: when any thread starts
+    while a frame under the configured prefixes (default druid_tpu/) is on
+    the caller's stack, the witness records (weakref(thread), site, name) —
+    the site is the nearest project frame, so executor workers attribute to
+    the submit/executor construction site and servers to their start().
+    Threads started from jax, pytest or the stdlib alone pass unrecorded.
+  * snapshot() captures a watermark into that append-only start log, the
+    open-fd table from /proc/self/fd (fd -> readlink target; platforms
+    without procfs degrade to no fd tracking), and the device pool's
+    resident bytes/entries (0 when druid_tpu.data.devicepool was never
+    imported).
+  * leaks(baseline) polls with gc.collect() until clean or a grace
+    deadline: project threads started AFTER the baseline must be dead, the
+    multiset of leak-worthy descriptor targets must not have grown
+    (regular files and sockets count; anon inodes, pipes, /dev, /proc and
+    shared-library mappings are runtime noise — counted by readlink
+    target, not fd number, which the kernel reuses), and pool resident
+    bytes must return to
+    baseline within a slack. gc runs inside the loop because CPython closes
+    GC'd files/sockets and the pool purges dead owners at the next
+    snapshot() — "released by collection" is not a leak, it is the
+    ownership-transfer idiom working.
+
+Whole-suite mode: DRUID_TPU_LEAK_WITNESS=1 makes conftest install a session
+witness before the first druid_tpu import and fail the run from
+pytest_unconfigure if the suite did not return to its post-import baseline.
+
+Test-only: nothing in druid_tpu imports this module.
+"""
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+Site = Tuple[str, int]                    # (repo-relative path, lineno)
+
+#: process-wide session witness (see session_witness; same two-conftest
+#: rationale as lockwitness.session_witness)
+_SESSION: Optional["LeakWitness"] = None
+
+
+def session_witness(root: Optional[str] = None,
+                    prefixes: Sequence[str] = ("druid_tpu",)
+                    ) -> Optional["LeakWitness"]:
+    """Singleton install-and-baseline. First call (with `root`) installs
+    the witness and captures the session baseline; later calls return the
+    same witness. conftest executes twice per process (as `conftest` and as
+    `tests.conftest`) — a second witness would reset the baseline and
+    shadow the start log."""
+    global _SESSION
+    if _SESSION is None and root is not None:
+        _SESSION = LeakWitness(root, prefixes).install()
+        _SESSION.baseline = _SESSION.snapshot()
+    return _SESSION
+
+
+def end_session_witness() -> Optional["LeakWitness"]:
+    global _SESSION
+    w, _SESSION = _SESSION, None
+    if w is not None:
+        w.uninstall()
+    return w
+
+
+#: readlink targets that are runtime noise, not project leaks: event/epoll
+#: anon inodes and pipes back thread pools and jax's runtime, /dev and
+#: /proc churn with the platform, and .so targets appear when a library
+#: dlopens lazily mid-session.
+_FD_NOISE_PREFIXES = ("anon_inode:", "pipe:", "/dev/", "/proc/", "/sys/")
+_FD_NOISE_SUFFIXES = (".so",)
+
+
+def _fd_leakworthy(target: str) -> bool:
+    if target.startswith(_FD_NOISE_PREFIXES):
+        return False
+    if target.endswith(_FD_NOISE_SUFFIXES) or ".so." in target:
+        return False
+    return True
+
+
+@dataclass(frozen=True)
+class LeakSnapshot:
+    """One point-in-time resource baseline."""
+    started_watermark: int                # len() of the witness start log
+    thread_count: int                     # all alive threads (visibility)
+    fds: Tuple[Tuple[int, str], ...]      # (fd, readlink target)
+    pool_resident: int
+    pool_entries: int
+
+
+class LeakWitness:
+    """Holds the project-thread start log for one install()/uninstall()
+    span plus snapshot/compare logic. `baseline` is set by session_witness
+    (or by the caller) for the session-wide mode."""
+
+    def __init__(self, root: str, prefixes: Sequence[str] = ("druid_tpu",)):
+        self.root = os.path.abspath(root)
+        self.prefixes = tuple(prefixes)
+        self._meta = threading.Lock()
+        #: append-only: (weakref to thread, start site, thread name)
+        self._started: List[Tuple[weakref.ref, Site, str]] = []
+        self._installed = False
+        self._real_start = None
+        self.baseline: Optional[LeakSnapshot] = None
+
+    # ---- interception ---------------------------------------------------
+    def _rel_under_prefixes(self, path: str) -> Optional[str]:
+        path = os.path.abspath(path)
+        if not path.startswith(self.root + os.sep):
+            return None
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        if not any(rel.startswith(p.rstrip("/") + "/") or rel == p
+                   for p in self.prefixes):
+            return None
+        return rel
+
+    def _project_site_on_stack(self, frame) -> Optional[Site]:
+        """Nearest frame under a configured prefix walking outward — the
+        attribution site for a thread start reached through stdlib layers
+        (executor submit, socketserver process_request)."""
+        depth = 0
+        while frame is not None and depth < 64:
+            rel = self._rel_under_prefixes(frame.f_code.co_filename)
+            if rel is not None:
+                return (rel, frame.f_lineno)
+            frame = frame.f_back
+            depth += 1
+        return None
+
+    def install(self) -> "LeakWitness":
+        if self._installed:
+            return self
+        witness = self
+        real_start = threading.Thread.start
+
+        def start(thread, *args, **kwargs):
+            site = witness._project_site_on_stack(sys._getframe(1))
+            if site is not None:
+                with witness._meta:
+                    witness._started.append(
+                        (weakref.ref(thread), site, thread.name))
+            return real_start(thread, *args, **kwargs)
+
+        self._real_start = real_start
+        threading.Thread.start = start
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Thread.start = self._real_start
+            self._real_start = None
+            self._installed = False
+
+    def __enter__(self) -> "LeakWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ---- observation ----------------------------------------------------
+    def live_project_threads(self, since: int = 0
+                             ) -> List[Tuple[Site, str]]:
+        """(site, name) of witnessed threads at log index >= `since` that
+        are still alive."""
+        with self._meta:
+            entries = list(self._started[since:])
+        out = []
+        for ref, site, name in entries:
+            t = ref()
+            if t is not None and t.is_alive():
+                out.append((site, name))
+        return out
+
+    @staticmethod
+    def open_fds() -> Tuple[Tuple[int, str], ...]:
+        out = []
+        try:
+            names = os.listdir("/proc/self/fd")
+        except OSError:
+            return ()                    # no procfs: fd axis disabled
+        for n in names:
+            try:
+                out.append((int(n), os.readlink(f"/proc/self/fd/{n}")))
+            except (OSError, ValueError):
+                pass                     # fd closed mid-listing
+        return tuple(sorted(out))
+
+    @staticmethod
+    def pool_stats() -> Tuple[int, int]:
+        """(resident_bytes, entries) — snapshot() drains finalizer-reported
+        dead owners, so this reflects segment GC that already happened."""
+        mod = sys.modules.get("druid_tpu.data.devicepool")
+        if mod is None:
+            return (0, 0)
+        s = mod.device_pool().snapshot()
+        return (s.resident_bytes, s.entries)
+
+    def snapshot(self) -> LeakSnapshot:
+        with self._meta:
+            watermark = len(self._started)
+        resident, entries = self.pool_stats()
+        return LeakSnapshot(started_watermark=watermark,
+                            thread_count=threading.active_count(),
+                            fds=self.open_fds(),
+                            pool_resident=resident,
+                            pool_entries=entries)
+
+    # ---- comparison -----------------------------------------------------
+    def _compare(self, baseline: LeakSnapshot,
+                 pool_slack_bytes: int) -> List[str]:
+        out = []
+        for site, name in self.live_project_threads(
+                baseline.started_watermark):
+            out.append(f"thread leak: '{name}' started at "
+                       f"{site[0]}:{site[1]} is still alive")
+        # fd axis: compare MULTISETS of leak-worthy readlink targets, not
+        # (fd number, target) identity — the kernel reuses the lowest free
+        # number, so a leaked re-open of a baseline file can land on the
+        # baseline's own fd (invisible to an identity check), while a
+        # legitimately re-opened baseline file on a higher number is not
+        # growth and must not fail the gate.
+        base_counts = Counter(t for _, t in baseline.fds
+                              if _fd_leakworthy(t))
+        current = self.open_fds()
+        excess = Counter(t for _, t in current
+                         if _fd_leakworthy(t)) - base_counts
+        for fd, target in current:
+            if excess.get(target, 0) > 0:
+                excess[target] -= 1
+                out.append(f"fd leak: fd {fd} -> {target} (more open than "
+                           f"at baseline)")
+        resident, entries = self.pool_stats()
+        if resident > baseline.pool_resident + pool_slack_bytes:
+            out.append(f"device pool leak: resident {resident}B / "
+                       f"{entries} entr(ies), baseline was "
+                       f"{baseline.pool_resident}B / "
+                       f"{baseline.pool_entries} — dead owners were not "
+                       f"purged or live segments escaped the fixture")
+        return out
+
+    def leaks(self, baseline: Optional[LeakSnapshot] = None,
+              grace_s: float = 5.0,
+              pool_slack_bytes: int = 0) -> List[str]:
+        """Violations vs `baseline` (default: the session baseline), after
+        polling with gc.collect() for up to `grace_s` — a thread between
+        join(timeout) returning and really exiting, a GC-owned socket, or
+        an undrained pool owner gets its grace; a real leak stays."""
+        baseline = baseline or self.baseline
+        assert baseline is not None, "no baseline snapshot"
+        deadline = time.monotonic() + grace_s
+        while True:
+            out = self._compare(baseline, pool_slack_bytes)
+            if not out or time.monotonic() >= deadline:
+                return out
+            gc.collect()
+            time.sleep(0.05)

@@ -148,6 +148,9 @@ class ClassInfo:
     attr_types: Dict[str, str] = field(default_factory=dict)  # attr → class
     #: container attrs (`self._nodes: Dict[str, DataNode]`) → element class
     elem_types: Dict[str, str] = field(default_factory=dict)
+    #: container attrs → "mapping" | "sequence" (plain iteration yields
+    #: elements only for sequences; mappings yield keys)
+    elem_kind: Dict[str, str] = field(default_factory=dict)
     properties: Set[str] = field(default_factory=set)
     is_handler: bool = False
 
@@ -519,6 +522,9 @@ def _bind_and_walk(prog: Program, config: LintConfig) -> None:
                                                node.annotation)
                         if eck is not None:
                             ci.elem_types.setdefault(node.target.attr, eck)
+                            ci.elem_kind.setdefault(
+                                node.target.attr,
+                                _container_kind(node.annotation))
     _build_subclass_map(prog)
     _ctor_param_attr_pass(prog)
     # per-function event walks
@@ -657,7 +663,8 @@ def _resolve_value(prog: Program, mod: ModuleInfo, scope: _Scope,
             if expr.attr in ci.attr_types:
                 return ("instance", ci.attr_types[expr.attr])
             if expr.attr in ci.elem_types:
-                return ("container", ci.elem_types[expr.attr])
+                return ("container", ci.elem_types[expr.attr],
+                        ci.elem_kind.get(expr.attr, "mapping"))
             if expr.attr in ci.properties:
                 # a property ACCESS is a call, not a callable value: the
                 # expression's type is the property's return annotation
@@ -716,11 +723,30 @@ def _resolve_value(prog: Program, mod: ModuleInfo, scope: _Scope,
     return None
 
 
-_CONTAINER_HEADS = {"Dict", "dict", "List", "list", "Set", "set",
-                    "Sequence", "Iterable", "Tuple", "tuple", "Deque",
-                    "deque", "OrderedDict", "DefaultDict", "defaultdict",
-                    "Mapping", "MutableMapping"}
+_MAPPING_HEADS = {"Dict", "dict", "OrderedDict", "DefaultDict",
+                  "defaultdict", "Mapping", "MutableMapping"}
+_SEQUENCE_HEADS = {"List", "list", "Set", "set", "Sequence", "Iterable",
+                   "Tuple", "tuple", "Deque", "deque", "FrozenSet",
+                   "frozenset"}
+_CONTAINER_HEADS = _MAPPING_HEADS | _SEQUENCE_HEADS
 _CONTAINER_GETTERS = {"get", "setdefault", "pop", "popleft", "popitem"}
+
+
+def _container_kind(ann: ast.AST) -> str:
+    """"mapping" or "sequence" for a container annotation head (after
+    unwrapping Optional and quoted forms the way _elem_annotation does)."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return "mapping"
+    if isinstance(ann, ast.Subscript):
+        head = _terminal(ann.value)
+        if head == "Optional":
+            return _container_kind(ann.slice)
+        if head in _SEQUENCE_HEADS:
+            return "sequence"
+    return "mapping"
 
 
 def _elem_annotation(prog: Program, mod: ModuleInfo, scope: _Scope,
@@ -883,15 +909,92 @@ def _param_bindings(prog: Program, mod: ModuleInfo,
     return frame
 
 
+def _stmt_store_names(node: ast.AST) -> List[str]:
+    """Names a STATEMENT binds in function scope (assignment/loop/with
+    targets, walrus) — comprehension targets are excluded by construction
+    (ast.comprehension is not matched; its target is only reachable
+    through the comprehension node itself)."""
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.For,
+                           ast.NamedExpr)):
+        targets = [node.target]
+    elif isinstance(node, ast.withitem):
+        targets = [node.optional_vars] if node.optional_vars else []
+    else:
+        return []
+    return [n.id for t in targets for n in ast.walk(t)
+            if isinstance(n, ast.Name)]
+
+
 def _local_frame(prog: Program, mod: ModuleInfo, fi: FuncInfo,
                  outer_frames: List[Dict[str, Tuple]]) -> Dict[str, Tuple]:
     """Single-assignment local bindings inside one function: `x = self`,
     `x = Class(...)`, `x = self.view.node(...)` (return annotation),
-    `x = imported_name` — plus annotated parameters."""
+    `x = imported_name`, annotated parameters — and ITERATION ELEMENTS:
+    `for rs in self._replicas.values()` binds rs to the Dict's value
+    class, `for n in self._nodes` to a List's element class, `for k, rs
+    in self._replicas.items()` binds rs — so the order graph and guard
+    rules extend into replica-set/timeline-style loop bodies."""
     frame: Dict[str, Tuple] = _param_bindings(prog, mod, fi)
     params = set(frame)
     assigned_twice: Set[str] = set()
+
+    def bind(name: str, got: Optional[Tuple]) -> None:
+        if name in assigned_twice:
+            return
+        if name in frame and name not in params:
+            del frame[name]
+            assigned_twice.add(name)
+            return
+        if got is not None:
+            frame[name] = got[:2] if got[0] == "instance" else got
+        elif name in params:
+            del frame[name]           # reassigned param: binding unknown
+            assigned_twice.add(name)
+
+    def iter_element(it: ast.AST, scope: _Scope) -> Optional[Tuple]:
+        """Element binding of an iteration source: .values()/.items()
+        hand back mapping values; plain iteration yields elements only
+        for sequence-kind containers."""
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("values", "items"):
+            base = _resolve_value(prog, mod, scope, it.func.value)
+            if base is not None and base[0] == "container":
+                return ("instance", base[1])
+            return None
+        got = _resolve_value(prog, mod, scope, it)
+        if got is not None and got[0] == "container" \
+                and len(got) > 2 and got[2] == "sequence":
+            return ("instance", got[1])
+        return None
+
+    def iter_bindings(node) -> List[Tuple[str, Optional[Tuple]]]:
+        """(name, binding) pairs an iteration construct (For statement or
+        comprehension generator) establishes for its target."""
+        scope = _Scope(mod, outer_frames + [dict(frame)])
+        elem = iter_element(node.iter, scope)
+        tgt = node.target
+        if isinstance(tgt, ast.Name):
+            # plain target over .items() iterates pairs, not values
+            is_items = isinstance(node.iter, ast.Call) \
+                and isinstance(node.iter.func, ast.Attribute) \
+                and node.iter.func.attr == "items"
+            return [(tgt.id, None if is_items else elem)]
+        if isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2 \
+                and isinstance(tgt.elts[1], ast.Name) \
+                and isinstance(node.iter, ast.Call) \
+                and isinstance(node.iter.func, ast.Attribute) \
+                and node.iter.func.attr == "items":
+            return [(tgt.elts[1].id, elem)]
+        return []
+
+    comp_nodes: List[ast.comprehension] = []
+    stmt_bound: Set[str] = set(params)
+
     for node in _own(fi):
+        for name in _stmt_store_names(node):
+            stmt_bound.add(name)
         if isinstance(node, _FUNC_DEFS):
             nested = f"{fi.path}::{fi.qual}.<locals>.{node.name}"
             if nested in prog.funcs:
@@ -902,20 +1005,36 @@ def _local_frame(prog: Program, mod: ModuleInfo, fi: FuncInfo,
                 frame.setdefault(node.name, ("class", nested))
         elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
-            name = node.targets[0].id
-            if name in assigned_twice:
-                continue
-            if name in frame and name not in params:
-                del frame[name]
-                assigned_twice.add(name)
-                continue
             scope = _Scope(mod, outer_frames + [dict(frame)])
-            got = _resolve_value(prog, mod, scope, node.value)
-            if got is not None:
-                frame[name] = got[:2] if got[0] == "instance" else got
-            elif name in params:
-                del frame[name]       # reassigned param: binding unknown
-                assigned_twice.add(name)
+            bind(node.targets[0].id,
+                 _resolve_value(prog, mod, scope, node.value))
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            # `nodes: List[DataNode] = []` — a typed local container
+            scope = _Scope(mod, outer_frames + [dict(frame)])
+            tck = _resolve_annotation(prog, mod, scope, node.annotation)
+            if tck is not None:
+                bind(node.target.id, ("instance", tck))
+            else:
+                eck = _elem_annotation(prog, mod, scope, node.annotation)
+                if eck is not None:
+                    bind(node.target.id,
+                         ("container", eck, _container_kind(node.annotation)))
+        elif isinstance(node, ast.For):
+            for name, got in iter_bindings(node):
+                bind(name, got)
+        elif isinstance(node, ast.comprehension):
+            comp_nodes.append(node)
+    # Comprehension targets are their OWN scope in py3 — they never leak
+    # into function locals, so they must neither invalidate nor fabricate
+    # a statement-level binding (bind() treats a second write as
+    # "reassigned: unknown", which would silently drop the typed local and
+    # its order edges). Bind them only for names no statement stores, so
+    # calls inside the comprehension body still resolve.
+    for node in comp_nodes:
+        for name, got in iter_bindings(node):
+            if name not in stmt_bound and name not in assigned_twice:
+                bind(name, got)
     return frame
 
 
@@ -947,7 +1066,20 @@ def _walk_function(prog: Program, fi: FuncInfo) -> None:
         return Site(fi.path, getattr(node, "lineno", 1),
                     getattr(node, "col_offset", 0))
 
+    def _manual_lock_stmt(node, which: str) -> Optional[str]:
+        """`X.acquire()` / `X.release()` as a bare statement on a
+        RESOLVED project lock — the manual held-region protocol. Unknown
+        lockish receivers stay event-only (extending held with UNKNOWN
+        would grant benefit-of-the-doubt skips the code didn't earn)."""
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == which:
+                return resolve_lock(call.func.value)
+        return None
+
     def walk(body, held: Tuple[str, ...]):
+        held = tuple(held)
         for node in body:
             if isinstance(node, _FUNC_DEFS + (ast.ClassDef, ast.Lambda)):
                 continue              # nested defs walk as their own funcs
@@ -965,6 +1097,36 @@ def _walk_function(prog: Program, fi: FuncInfo) -> None:
                     else:
                         _expr_events(item.context_expr, held)
                 walk(node.body, inner)
+                continue
+            # manual held regions: a statement-level `X.acquire()` holds X
+            # for the REST of this block (or until a statement-level
+            # release); `try: … finally: X.release()` releases after the
+            # Try. Both lock-set dataflows see the region through the held
+            # tuples recorded on every event inside it.
+            mlid = _manual_lock_stmt(node, "acquire")
+            if mlid is not None:
+                fi.acquires.append((mlid, held, site(node.value), False))
+                if mlid not in held:
+                    held = held + (mlid,)
+                continue
+            rlid = _manual_lock_stmt(node, "release")
+            if rlid is not None:
+                if rlid in held:
+                    held = tuple(l for l in held if l != rlid)
+                continue
+            if isinstance(node, ast.Try):
+                released = set()
+                for st in node.finalbody:
+                    r = _manual_lock_stmt(st, "release")
+                    if r is not None:
+                        released.add(r)
+                walk(node.body, held)
+                for h in node.handlers:
+                    walk(h.body, held)
+                walk(node.orelse, held)
+                walk(node.finalbody, held)
+                if released:
+                    held = tuple(l for l in held if l not in released)
                 continue
             _stmt_events(node, held)
             for sub in _child_blocks(node):
