@@ -2,9 +2,11 @@
 batched-vs-per-segment dispatch-amortization comparison.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
-"per_segment_rate", "batched_rate", "batch_speedup", "untraced_rate",
-"traced_rate", "trace_overhead"} — the last three track qtrace span
-overhead across BENCH_r* runs.
+"per_segment_rate", "batched_rate", "batch_speedup", "packed_rate",
+"decoded_rate", "pack_ratio", "untraced_rate", "traced_rate",
+"trace_overhead"} — packed_* compare compressed-domain vs decoded staging
+on the cold-miss H2D path; traced_* track qtrace span overhead across
+BENCH_r* runs.
 
 Config mirrors BASELINE.json: TPC-H-style GroupBy (2 dims, 3 aggs, numeric
 bound filter) + TopN (1 dim, metric-ordered) over synthetic segments.
@@ -245,6 +247,56 @@ def _bench_batching(iters: int):
         "batch_speedup": round(rates["batched"] / rates["per_segment"], 2),
         "batch_segments": n_segments,
         "batch_fill_ratio": round(fill, 3),
+    }
+
+
+def _bench_packed(iters: int):
+    """Compressed-domain cold-miss comparison: the batch query over the
+    small-segment shape with the device pool CLEARED before every timed
+    run, so each run pays the full H2D staging tax — once with bit-packed
+    staging (data/packed.py) and once decoded. The packed win is the
+    smaller bus transfer + the pool holding pack-ratio more segments;
+    pack_ratio reports the measured decoded/actual byte ratio of the
+    packed run's pool residency."""
+    from druid_tpu.data import packed
+    from druid_tpu.data.devicepool import device_pool
+    from druid_tpu.engine.executor import QueryExecutor
+
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_BATCH_SEGMENTS", 16))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_BATCH_ROWS", 4096))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    query = batch_groupby()
+    executor = QueryExecutor(segments)
+    pool = device_pool()
+
+    rates = {}
+    pack_ratio = 0.0
+    for label, on in (("decoded", False), ("packed", True)):
+        prev = packed.set_enabled(on)
+        try:
+            t = time.time()
+            executor.run(query)          # warm: compile once per mode
+            log(f"packed-bench warmup {label}: {time.time() - t:.2f}s")
+            times = []
+            for _ in range(max(iters, 3)):
+                pool.clear()             # force the cold-miss H2D path
+                t = time.time()
+                executor.run(query)
+                times.append(time.time() - t)
+            if on:
+                pack_ratio = pool.snapshot().packed_ratio
+        finally:
+            packed.set_enabled(prev)
+        best = min(times)
+        rates[label] = total_rows / best
+        log(f"packed-bench {label}: best {best * 1e3:.1f}ms over "
+            f"{len(times)} cold iters -> {rates[label] / 1e6:.1f}M rows/s")
+    log(f"packed-bench pool pack ratio: {pack_ratio:.2f}x")
+    return {
+        "packed_rate": round(rates["packed"], 0),
+        "decoded_rate": round(rates["decoded"], 0),
+        "pack_ratio": round(pack_ratio, 3),
     }
 
 
@@ -506,6 +558,11 @@ def main():
         log(f"batch-bench failed: {type(e).__name__}: {e}")
         batch = {"batch_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        packed_cmp = _bench_packed(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"packed-bench failed: {type(e).__name__}: {e}")
+        packed_cmp = {"packed_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         traced = _bench_tracing(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"trace-bench failed: {type(e).__name__}: {e}")
@@ -532,6 +589,7 @@ def main():
         "p95_ms": round(p95, 1),
     }
     out.update(batch)
+    out.update(packed_cmp)
     out.update(traced)
     out.update(sched)
     out.update(soak)

@@ -228,9 +228,11 @@ class DataNodeServer:
                             # hold opens a queue/wait span under THIS
                             # request's root; saturation raises
                             # QueryCapacityError (429 above). Work the
-                            # node cannot fuse (mesh/cached/per-segment-
-                            # metrics) skips the queue — it would only
-                            # serialize on the dispatcher thread
+                            # node cannot fuse (mesh/per-segment-metrics)
+                            # skips the queue — it would only serialize on
+                            # the dispatcher thread. Segment-cache queries
+                            # DO queue: hits resolve inline in the flush,
+                            # misses join the fused wave
                             out = outer.scheduler.submit(query, sids,
                                                          check=check)
                         else:

@@ -202,19 +202,7 @@ class DataNode:
                                        cached=False)
                 ap = AggregatePartials.concat(parts)
             return ap, served
-        qkey = query_cache_key(query)
-        parts: List[AggregatePartials] = []
-        to_compute: List[Segment] = []
-        for s in segs:
-            t0 = time.monotonic()
-            hit = self.cache.get("segment", f"{s.id}|{qkey}")
-            if hit is not None:
-                parts.append(hit)
-                self._emit_segment(query, s.id,
-                                   (time.monotonic() - t0) * 1e3, 0.0,
-                                   cached=True)
-            else:
-                to_compute.append(s)
+        qkey, parts, to_compute = self._cache_scan(query, segs)
         if to_compute and (self.mesh is not None
                            or (self.emitter is not None
                                and self.per_segment_metrics)):
@@ -232,8 +220,7 @@ class DataNode:
                                    (time.monotonic() - t0) * 1e3,
                                    (time.thread_time() - c0) * 1e3,
                                    cached=False)
-                if self.cache_config.populate_segment_cache:
-                    self.cache.put("segment", f"{s.id}|{qkey}", ap)
+                self._cache_put(qkey, [(s, ap)])
                 parts.append(ap)
         elif to_compute:
             # the whole miss set in ONE wave: shape-compatible misses fuse
@@ -250,11 +237,38 @@ class DataNode:
                                (time.monotonic() - t0) * 1e3,
                                (time.thread_time() - c0) * 1e3,
                                cached=False)
-            for s, ap in zip(to_compute, per_seg):
-                if self.cache_config.populate_segment_cache:
-                    self.cache.put("segment", f"{s.id}|{qkey}", ap)
-                parts.append(ap)
+            self._cache_put(qkey, zip(to_compute, per_seg))
+            parts.extend(per_seg)
         return AggregatePartials.concat(parts), served
+
+    def _cache_scan(self, query: Query, segs: Sequence[Segment]
+                    ) -> Tuple[str, List[AggregatePartials], List[Segment]]:
+        """(qkey, hit partials, miss segments): the timed per-segment cache
+        scan — THE one hit/miss discipline; run_partials (request thread)
+        and run_partials_group (scheduler flush) both use it, so cache
+        semantics cannot diverge between the two execution paths."""
+        qkey = query_cache_key(query)
+        hit_parts: List[AggregatePartials] = []
+        to_compute: List[Segment] = []
+        for s in segs:
+            t0 = time.monotonic()
+            hit = self.cache.get("segment", f"{s.id}|{qkey}")
+            if hit is not None:
+                hit_parts.append(hit)
+                self._emit_segment(query, s.id,
+                                   (time.monotonic() - t0) * 1e3, 0.0,
+                                   cached=True)
+            else:
+                to_compute.append(s)
+        return qkey, hit_parts, to_compute
+
+    def _cache_put(self, qkey: str, pairs) -> None:
+        """Populate per-segment cache entries (gated on the config), the
+        counterpart of _cache_scan shared by both serving paths."""
+        if not self.cache_config.populate_segment_cache:
+            return
+        for s, ap in pairs:
+            self.cache.put("segment", f"{s.id}|{qkey}", ap)
 
     def _segment_cache_active(self, query: Query) -> bool:
         """Whether the per-segment results cache takes this query — the
@@ -267,16 +281,21 @@ class DataNode:
 
     def fusable(self, query: Query) -> bool:
         """Whether run_partials_group would FUSE this query with its
-        flush-mates. Work this node cannot fuse — mesh execution, segment
-        cache in play, per-segment metrics, non-aggregate queries, batching
-        opted out (process switch or {"batchSegments": false}) — gains
-        nothing from the scheduler hold and would serialize on the single
-        dispatcher thread; DataNodeServer routes it straight to
-        run_partials on the request thread instead."""
+        flush-mates. Work this node cannot fuse — mesh execution,
+        per-segment metrics, non-aggregate queries, batching opted out
+        (process switch or {"batchSegments": false}) — gains nothing from
+        the scheduler hold and would serialize on the single dispatcher
+        thread; DataNodeServer routes it straight to run_partials on the
+        request thread instead.
+
+        Segment-cache-active queries DO fuse (PR 7 follow-on closed):
+        run_partials_group resolves cache hits inline during the flush and
+        sends only the MISS set into the fused wave, splitting the results
+        back into per-segment cache entries — a hot datasource's cached
+        queries no longer serialize per-query inside a flush."""
         from druid_tpu.engine import batching
         return (_is_aggregate(query) and self.mesh is None
                 and batching.query_enabled(query.context_map)
-                and not self._segment_cache_active(query)
                 and not (self.emitter is not None
                          and self.per_segment_metrics))
 
@@ -298,7 +317,7 @@ class DataNode:
             err = ConnectionError(f"server [{self.name}] is down")
             return [err for _ in requests]
         fused_idx: List[int] = []
-        fused_items = []
+        fused_items = []        # ((query, segs, check), (served, cache_meta))
         out: List[object] = [None] * len(requests)
         for i, (query, segment_ids, check) in enumerate(requests):
             if not self.fusable(query):
@@ -312,27 +331,56 @@ class DataNode:
                     out[i] = e
                 continue
             segs, served = self._select(segment_ids)
-            fused_idx.append(i)
-            fused_items.append(((query, segs, check), served))
+            if self._segment_cache_active(query):
+                # cache hits resolve INSIDE the flush (no device work, no
+                # per-query routing); only the miss set joins the fused
+                # wave, and its results split back into per-segment cache
+                # entries identical to the serial path's (the scan/put
+                # discipline is _cache_scan/_cache_put — shared with
+                # run_partials, so the two paths cannot drift)
+                qkey, hit_parts, to_compute = self._cache_scan(query, segs)
+                if not to_compute:
+                    # the hot-datasource shape: a fully-cached query costs
+                    # the flush nothing at all
+                    out[i] = (AggregatePartials.concat(hit_parts), served)
+                    continue
+                fused_idx.append(i)
+                fused_items.append(((query, to_compute, check),
+                                    (served, (hit_parts, to_compute, qkey))))
+            else:
+                fused_idx.append(i)
+                fused_items.append(((query, segs, check), (served, None)))
         if fused_items:
             t0, c0 = time.monotonic(), time.thread_time()
             results = engines.make_aggregate_partials_multi(
                 [item for item, _ in fused_items], on_batch=on_batch)
             wall_ms = (time.monotonic() - t0) * 1e3
             cpu_ms = (time.thread_time() - c0) * 1e3
-            for i, got, ((query, segs, _), served) \
+            for i, got, ((query, segs, _), (served, cache_meta)) \
                     in zip(fused_idx, results, fused_items):
                 if isinstance(got, BaseException):
                     out[i] = got
                     continue
-                if segs:
-                    # one fused timing per request, as run_partials emits
-                    # for a batched set — the flush is shared, so the
-                    # wall/cpu cost is the whole group's, not this
-                    # query's alone
-                    self._emit_segment(query, f"{len(segs)}-segments",
-                                       wall_ms, cpu_ms, cached=False)
-                out[i] = (got, served)
+                if cache_meta is None:
+                    if segs:
+                        # one fused timing per request, as run_partials
+                        # emits for a batched set — the flush is shared,
+                        # so the wall/cpu cost is the whole group's, not
+                        # this query's alone
+                        self._emit_segment(query, f"{len(segs)}-segments",
+                                           wall_ms, cpu_ms, cached=False)
+                    out[i] = (got, served)
+                    continue
+                hit_parts, to_compute, qkey = cache_meta
+                per_seg = engines.split_partials_by_segment(got, to_compute)
+                self._cache_put(qkey, zip(to_compute, per_seg))
+                self._emit_segment(query,
+                                   f"{len(to_compute)}-segment-misses",
+                                   wall_ms, cpu_ms, cached=False)
+                # hit parts first, computed parts after — the same order
+                # run_partials' cached path concatenates in
+                out[i] = (AggregatePartials.concat(hit_parts + per_seg),
+                          served)
         return out
 
     def run_rows(self, query: Query, segment_ids: Sequence[str]

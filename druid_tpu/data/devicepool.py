@@ -46,7 +46,10 @@ def _default_budget() -> int:
 
 def entry_bytes(value) -> int:
     """Actual device bytes a pool entry pins: DeviceBlocks count their
-    array dict, containers count their leaves, arrays their nbytes."""
+    array dict, containers count their leaves, arrays their nbytes.
+    PackedColumn entries (and any pytree mixing packed words with aux
+    arrays) count their COMPRESSED words bytes — the pool budgets what HBM
+    actually holds, so effective capacity multiplies by the pack ratio."""
     if value is None:
         return 0
     arrays = getattr(value, "arrays", None)
@@ -60,6 +63,27 @@ def entry_bytes(value) -> int:
     return int(nbytes) if nbytes is not None else 0
 
 
+def entry_logical_bytes(value) -> int:
+    """Decoded-equivalent bytes of a pool entry: what the same data would
+    pin if staged fully decoded. Equals entry_bytes for plain arrays;
+    PackedColumns report rows × element width. logical / actual is the
+    pool's packedRatio — the effective-capacity multiplier."""
+    if value is None:
+        return 0
+    logical = getattr(value, "logical_nbytes", None)
+    if logical is not None:
+        return int(logical)
+    arrays = getattr(value, "arrays", None)
+    if isinstance(arrays, dict):
+        return sum(entry_logical_bytes(v) for v in arrays.values())
+    if isinstance(value, dict):
+        return sum(entry_logical_bytes(v) for v in value.values())
+    if isinstance(value, (tuple, list)):
+        return sum(entry_logical_bytes(v) for v in value)
+    nbytes = getattr(value, "nbytes", None)
+    return int(nbytes) if nbytes is not None else 0
+
+
 @dataclass
 class PoolStats:
     hits: int = 0
@@ -67,6 +91,7 @@ class PoolStats:
     evictions: int = 0
     evicted_bytes: int = 0
     resident_bytes: int = 0
+    logical_bytes: int = 0
     entries: int = 0
     budget_bytes: int = 0
 
@@ -75,6 +100,13 @@ class PoolStats:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
 
+    @property
+    def packed_ratio(self) -> float:
+        """Decoded-equivalent bytes / actual resident bytes: 1.0 when
+        nothing is packed, the effective-capacity multiplier otherwise."""
+        return self.logical_bytes / self.resident_bytes \
+            if self.resident_bytes else 1.0
+
 
 class DeviceSegmentPool:
     """Byte-budgeted LRU over (owner, key) -> device value."""
@@ -82,7 +114,8 @@ class DeviceSegmentPool:
     def __init__(self, budget_bytes: Optional[int] = None):
         self._budget = budget_bytes            # None -> resolve lazily
         self._lock = threading.Lock()
-        self._entries: "collections.OrderedDict[Tuple, Tuple[object, int]]" \
+        # key -> (value, actual_bytes, logical_bytes)
+        self._entries: "collections.OrderedDict[Tuple, Tuple[object, int, int]]" \
             = collections.OrderedDict()
         self._owner_keys: Dict[int, Set[Tuple]] = {}
         self._owner_seq = itertools.count(1)
@@ -93,6 +126,7 @@ class DeviceSegmentPool:
         # are drained under the lock at the next pool operation.
         self._dead_owners: "collections.deque[int]" = collections.deque()
         self._resident = 0
+        self._logical = 0
         self._hits = 0
         self._misses = 0
         self._evictions = 0
@@ -154,6 +188,7 @@ class DeviceSegmentPool:
             value = self._entries.pop(key, None)
             if value is not None:
                 freed += value[1]
+                self._logical -= value[2]
         self._resident -= freed
         return freed
 
@@ -186,8 +221,12 @@ class DeviceSegmentPool:
                         kind=str(key[0]) if key else "") as sp:
             value = build()
             nbytes = entry_bytes(value)
+            logical = entry_logical_bytes(value)
             if sp is not None:
+                # "bytes" is what actually crossed the bus (compressed for
+                # packed entries); logicalBytes the decoded-equivalent size
                 sp.attrs["bytes"] = nbytes
+                sp.attrs["logicalBytes"] = logical
         with self._lock:
             self._drain_dead_locked()
             keys = self._owner_keys.get(owner)
@@ -199,9 +238,11 @@ class DeviceSegmentPool:
             old = self._entries.pop(full_key, None)
             if old is not None:
                 self._resident -= old[1]
-            self._entries[full_key] = (value, nbytes)
+                self._logical -= old[2]
+            self._entries[full_key] = (value, nbytes, logical)
             keys.add(full_key)
             self._resident += nbytes
+            self._logical += logical
             budget = self.budget_bytes
             if budget > 0:
                 self._evict_to(budget, keep=full_key)
@@ -218,10 +259,11 @@ class DeviceSegmentPool:
                     return
                 self._entries.move_to_end(key)
                 continue
-            _, nbytes = self._entries.pop(key)
+            _, nbytes, logical = self._entries.pop(key)
             # key[0] is the owner token (get_or_build prefixes it)
             self._owner_keys.get(key[0], set()).discard(key)
             self._resident -= nbytes
+            self._logical -= logical
             self._evictions += 1
             self._evicted_bytes += nbytes
 
@@ -233,6 +275,7 @@ class DeviceSegmentPool:
             for keys in self._owner_keys.values():
                 keys.clear()
             self._resident = 0
+            self._logical = 0
 
     # ---- observability --------------------------------------------------
     def snapshot(self) -> PoolStats:
@@ -242,6 +285,7 @@ class DeviceSegmentPool:
                              evictions=self._evictions,
                              evicted_bytes=self._evicted_bytes,
                              resident_bytes=self._resident,
+                             logical_bytes=self._logical,
                              entries=len(self._entries),
                              budget_bytes=self.budget_bytes)
 
@@ -277,3 +321,4 @@ class DevicePoolMonitor(Monitor):
                        s.evicted_bytes - last.evicted_bytes)
         emitter.metric("segment/devicePool/residentBytes", s.resident_bytes)
         emitter.metric("segment/devicePool/entries", s.entries)
+        emitter.metric("segment/devicePool/packedRatio", s.packed_ratio)

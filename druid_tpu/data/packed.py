@@ -1,0 +1,299 @@
+"""Bit-packed device columns: compressed-in-HBM staging for narrow columns.
+
+The HBM wall and the H2D bus are the cold-query taxes (ROADMAP open item 2):
+a fully-decoded int32 dictionary-id column spends 32 bits per row on values
+that need ceil(log2(cardinality)). Following the compressed-domain operator
+design of *GPU Acceleration of SQL Analytics on Compressed Data* (PAPERS.md),
+eligible columns stage as bit-packed int32 words and stay packed in HBM; the
+traced program unpacks them on-device (XLA fuses the shift/mask into the
+consumers, so the full-width array exists only transiently inside the
+program), and the pallas aggregation kernel consumes the words directly,
+unpacking per VMEM tile (engine/pallas_agg.py packed-input variant).
+
+Encoding (one canonical layout shared by the XLA and pallas decoders):
+  * width w ∈ contracts.PACK_WIDTHS (4/8/16 bits; each divides the 32-bit
+    word, so vpw = 32 // w values share one word and no value crosses a
+    word boundary);
+  * values are stored biased: stored = value - base, base a pow2-quantized
+    lower bound (0 for dictionary ids) so negatives pack without sign bits;
+  * tile-planar order: view the padded column [n] as the device tile layout
+    [n // 128, 128]; vpw CONSECUTIVE ROWS of that view share a word row —
+    word[q, l] packs rows q*vpw .. q*vpw+vpw-1 at lane l. A pallas block of
+    R = BLK // 128 value rows therefore maps to exactly R // vpw word rows,
+    and the in-kernel unpack is a pure VPU shift/mask/reshape (no gather).
+
+Eligibility is a PURE FUNCTION of column stats (dictionary cardinality,
+cached column min/max): plan signatures stay stable across executions and
+identical stats yield identical pack descriptors on every path (per-segment,
+batched, scheduler-fused). Columns that do not benefit — floats, int64-staged
+longs, cardinality above 2^16 — fall back to decoded staging.
+
+Pack ratio = 32 / width ≥ 2x, so a byte-budgeted device pool holds that many
+more segments and every cold miss ships that many fewer PCIe bytes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: lane width of the device tile layout the packing is planar over; must
+#: match contracts.LANE (asserted lazily in _widths to keep this module
+#: importable without triggering the engine package import).
+_LANE = 128
+
+_ENABLED = os.environ.get("DRUID_TPU_PACKED", "1").lower() \
+    not in ("0", "false", "no")
+_ENABLED_LOCK = threading.Lock()
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the process-wide packing default; returns the previous value
+    (bench/test toggle, the batching.set_enabled discipline)."""
+    global _ENABLED
+    with _ENABLED_LOCK:
+        prev = _ENABLED
+        _ENABLED = bool(on)
+        return prev
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _contracts():
+    # lazy: importing the engine package at data-module import time would
+    # cycle (engine -> data.segment -> packed); the submodule import is
+    # safe once anything engine-side is loading (same pattern as
+    # devicepool._default_budget)
+    from druid_tpu.engine import contracts
+    return contracts
+
+
+def _widths() -> Tuple[int, ...]:
+    c = _contracts()
+    assert c.LANE == _LANE
+    return c.PACK_WIDTHS
+
+
+def _word_bits() -> int:
+    return _contracts().PACK_WORD_BITS
+
+
+# ---------------------------------------------------------------------------
+# PackedColumn: the staged representation (a jax pytree)
+# ---------------------------------------------------------------------------
+
+_REGISTERED = False
+_REGISTER_LOCK = threading.Lock()
+
+
+def _ensure_registered():
+    """Register PackedColumn as a jax pytree on first construction: `words`
+    is the only leaf; the pack descriptor rides the treedef, so jit
+    programs specialize per descriptor exactly like they do per dtype.
+    Construction happens only at staging time, so the uncontended lock
+    acquisition per instance is noise next to the device_put."""
+    global _REGISTERED
+    with _REGISTER_LOCK:
+        if _REGISTERED:
+            return
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            PackedColumn,
+            lambda pc: ((pc.words,),
+                        (pc.width, pc.base, pc.rows, pc.dtype_str)),
+            lambda aux, leaves: PackedColumn(leaves[0], *aux),
+        )
+        _REGISTERED = True
+
+
+class PackedColumn:
+    """A bit-packed column: int32 `words` (device or host) + descriptor.
+
+    rows is the DECODED length (the staged padded row count); words has
+    rows // vpw entries. dtype_str names the decoded dtype ("int32" for
+    dictionary ids and int32-staged longs)."""
+
+    __slots__ = ("words", "width", "base", "rows", "dtype_str")
+
+    def __init__(self, words, width: int, base: int, rows: int,
+                 dtype_str: str = "int32"):
+        _ensure_registered()
+        self.words = words
+        self.width = int(width)
+        self.base = int(base)
+        self.rows = int(rows)
+        self.dtype_str = dtype_str
+
+    @property
+    def vpw(self) -> int:
+        return _word_bits() // self.width
+
+    @property
+    def nbytes(self) -> int:
+        """ACTUAL bytes pinned (the device pool's accounting unit)."""
+        return int(getattr(self.words, "nbytes", 0))
+
+    @property
+    def logical_nbytes(self) -> int:
+        """Decoded-equivalent bytes (the pool's packedRatio numerator)."""
+        return int(self.rows * np.dtype(self.dtype_str).itemsize)
+
+    def descriptor(self) -> Tuple[int, int, int, str]:
+        return (self.width, self.base, self.rows, self.dtype_str)
+
+    def __repr__(self):
+        return (f"PackedColumn(w{self.width}, base={self.base}, "
+                f"rows={self.rows}, {self.dtype_str})")
+
+
+# ---------------------------------------------------------------------------
+# Planning (pure functions of column stats)
+# ---------------------------------------------------------------------------
+
+def width_for(hi: int, base: int) -> int:
+    """Smallest contract width holding values in [base, hi], or 0."""
+    span = max(int(hi) - int(base), 0)
+    bits = max(span.bit_length(), 1)
+    for w in _widths():
+        if bits <= w:
+            return w
+    return 0
+
+
+def plan_column(segment, name: str) -> Optional[Tuple[int, int]]:
+    """(width, base) when `name` pack-benefits in `segment`, else None.
+
+    Pure function of the column's stats: dictionary cardinality for string
+    dims, cached min/max for int32-staged long metrics. Floats, int64-staged
+    longs (range needs >16 bits anyway), and high-cardinality dims (> 2^16)
+    return None — decoded staging."""
+    dim = segment.dims.get(name)
+    if dim is not None:
+        w = width_for(max(int(dim.cardinality) - 1, 0), 0)
+        return (w, 0) if w else None
+    m = segment.metrics.get(name)
+    if m is None:
+        return None
+    vals = np.asarray(m.values)
+    if vals.ndim != 1 or not np.issubdtype(vals.dtype, np.integer):
+        # 2-D complex states (HLL registers et al.) stage as-is: the
+        # packer and both decoders are 1-D tile-planar only
+        return None
+    if segment.staged_dtype(name) != np.int32:
+        return None
+    lo, hi = segment.column_minmax(name)
+    # pow2-quantized base: an exact base would split batching shape buckets
+    # on every per-segment min; quantization keeps descriptors coarse
+    base = 0 if lo >= 0 else -(1 << ((-int(lo) - 1).bit_length()))
+    w = width_for(hi, base)
+    return (w, base) if w else None
+
+
+def plan_columns(segment, columns: Sequence[str]) -> Tuple:
+    """((name, width, base), ...) for the pack-eligible subset of `columns`,
+    sorted by name; () when packing is disabled. This tuple IS the pack
+    descriptor: it joins the device-pool staging key, the per-segment plan
+    signature, and the batching shape-bucket digest, so every execution
+    path shares one decode story."""
+    if not _ENABLED:
+        return ()
+    out = []
+    for c in sorted(set(columns)):
+        p = plan_column(segment, c)
+        if p is not None:
+            out.append((c, p[0], p[1]))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Host-side pack / unpack
+# ---------------------------------------------------------------------------
+
+def pack_padded(padded: np.ndarray, width: int, base: int) -> np.ndarray:
+    """Pack a PADDED decoded column (length a multiple of 128 * vpw — any
+    DEFAULT_ROW_ALIGN-padded staging array qualifies) into int32 words in
+    the canonical tile-planar layout. Stored values are masked to the
+    width, so padding-row fill that falls outside [base, hi] wraps
+    deterministically instead of corrupting neighbor slots; every consumer
+    masks padding rows out, so their decoded values never matter."""
+    vpw = _word_bits() // width
+    n = int(padded.shape[0])
+    assert n % (_LANE * vpw) == 0, \
+        f"packed column length {n} not a multiple of {_LANE * vpw}"
+    mask = np.uint32((1 << width) - 1)
+    u = ((padded.astype(np.int64) - base)
+         & np.int64((1 << width) - 1)).astype(np.uint32)
+    v3 = u.reshape(-1, vpw, _LANE)
+    words = np.zeros((v3.shape[0], _LANE), dtype=np.uint32)
+    for s in range(vpw):
+        words |= (v3[:, s, :] & mask) << np.uint32(s * width)
+    return words.reshape(-1).view(np.int32)
+
+
+def unpack_host(pc_or_words, width: Optional[int] = None,
+                base: Optional[int] = None, rows: Optional[int] = None,
+                dtype="int32") -> np.ndarray:
+    """Exact host inverse of pack_padded (tests / debugging)."""
+    if isinstance(pc_or_words, PackedColumn):
+        pc = pc_or_words
+        words, width, base = np.asarray(pc.words), pc.width, pc.base
+        rows, dtype = pc.rows, pc.dtype_str
+    else:
+        words = np.asarray(pc_or_words)
+    vpw = _word_bits() // width
+    w2 = words.view(np.uint32).reshape(-1, _LANE)
+    out = np.empty((w2.shape[0], vpw, _LANE), dtype=np.uint32)
+    for s in range(vpw):
+        out[:, s, :] = (w2 >> np.uint32(s * width)) \
+            & np.uint32((1 << width) - 1)
+    return (out.reshape(rows).astype(np.int64) + base).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Device-side (traced) unpack
+# ---------------------------------------------------------------------------
+
+def unpack_device(pc: PackedColumn):
+    """Traced: decode a PackedColumn to its full-width 1-D array. Pure
+    int32 shift/mask/reshape — XLA fuses it into the consumers, so outside
+    pallas the decoded array never materializes in HBM on its own."""
+    import jax.numpy as jnp
+
+    width, vpw = pc.width, pc.vpw
+    m = jnp.int32((1 << width) - 1)
+    w2 = pc.words.reshape(-1, _LANE)
+    sh = jnp.int32(width) * jnp.arange(vpw, dtype=jnp.int32)
+    # arithmetic >> then & mask: sign-extension bits are cut off, so int32
+    # words with the top bit set (width-16 slot 1) decode exactly
+    v = (w2[:, None, :] >> sh[None, :, None]) & m
+    if pc.base:
+        v = v + jnp.int32(pc.base)
+    v = v.reshape(pc.rows)
+    dt = jnp.dtype(pc.dtype_str)
+    return v.astype(dt) if v.dtype != dt else v
+
+
+def unpack_columns(arrays: Dict) -> Dict:
+    """Traced: dict with every PackedColumn entry decoded (others pass
+    through). The ONE decode entry point the per-segment and stacked
+    program builders call, so the decode story cannot diverge."""
+    out = dict(arrays)
+    for k, v in arrays.items():
+        if isinstance(v, PackedColumn):
+            out[k] = unpack_device(v)
+    return out
+
+
+def split_packed(arrays: Dict) -> Tuple[Dict, Dict]:
+    """(packed entries, dense view of everything): the program-top helper —
+    the dense view feeds filters/keys/XLA strategies, the packed dict feeds
+    pallas_reduce's packed-input variant."""
+    packed = {k: v for k, v in arrays.items() if isinstance(v, PackedColumn)}
+    if not packed:
+        return packed, arrays
+    return packed, unpack_columns(arrays)

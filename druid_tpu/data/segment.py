@@ -160,6 +160,10 @@ class DeviceBlock:
       "<dim>":         int32 dictionary ids
       "<metric>":      int64 / float32 / float64 values
       "__valid":       bool row-validity mask (False on padding rows)
+
+    Pack-eligible dim/metric entries may instead be data/packed.py
+    PackedColumn values (bit-packed int32 words + descriptor, a jax
+    pytree): compressed in HBM, decoded inside the traced program.
     """
     segment_id: SegmentId
     n_rows: int
@@ -242,11 +246,20 @@ class Segment:
                      perm_key=None) -> DeviceBlock:
         """Stage (a subset of) columns to device, padded to static shape.
 
-        Staging is cached per (columns, row_align, device, perm_key) in the
-        process-wide byte-budgeted device pool; repeated queries over the
-        same segment hit HBM-resident arrays — the analog of the reference
-        keeping segments mmapped and page-cached
+        Staging is cached per (columns, row_align, device, perm_key, pack
+        descriptor) in the process-wide byte-budgeted device pool; repeated
+        queries over the same segment hit HBM-resident arrays — the analog
+        of the reference keeping segments mmapped and page-cached
         (server/.../SegmentLoaderLocalCacheManager.java).
+
+        Pack-eligible columns (data/packed.py — narrow dictionary ids,
+        small-range int32-staged longs; a pure function of column stats)
+        stage as bit-packed PackedColumn words: compressed in HBM, so the
+        pool's byte budget holds pack-ratio more segments and a cold miss
+        ships pack-ratio fewer H2D bytes. The traced programs decode them
+        on-device (grouping/packed.unpack_columns; the pallas kernel
+        per-tile). The descriptor joins the cache key, so flipping
+        packed.set_enabled never serves a mismatched representation.
 
         `perm` applies a row permutation host-side before staging (the sorted
         projection path); callers must pass a stable hashable `perm_key`
@@ -256,19 +269,26 @@ class Segment:
         row_align >= n_rows pads to EXACTLY row_align rows, so batch-mates on
         the same ladder rung stack into one [K, R] program.
         """
+        from druid_tpu.data import packed as packed_mod
         if perm is not None and perm_key is None:
             raise ValueError("device_block(perm=...) requires perm_key")
         if columns is None:
             columns = list(self.dims.keys()) + list(self.metrics.keys())
+        packs = packed_mod.plan_columns(self, columns)
         key = ("block", tuple(sorted(set(columns))), row_align,
-               getattr(device, "id", None), perm_key)
+               getattr(device, "id", None), perm_key, packs)
         return self._pool.get_or_build(
             self._pool_owner, key,
-            lambda: self._stage_block(columns, row_align, device, perm))
+            lambda: self._stage_block(columns, row_align, device, perm,
+                                      packs))
 
     def _stage_block(self, columns: Sequence[str], row_align: int,
-                     device, perm: Optional[np.ndarray]) -> DeviceBlock:
+                     device, perm: Optional[np.ndarray],
+                     packs: Tuple = ()) -> DeviceBlock:
         import jax
+
+        from druid_tpu.data import packed as packed_mod
+        pack_for = {name: (w, base) for name, w, base in packs}
 
         pad_n = max(row_align, ((self.n_rows + row_align - 1) // row_align) * row_align)
         time0 = self.interval.start
@@ -308,9 +328,19 @@ class Segment:
 
         put = (lambda a: jax.device_put(a, device)) if device is not None \
             else jax.device_put
+
+        def _stage(name: str, v: np.ndarray):
+            p = pack_for.get(name)
+            if p is None:
+                return put(v)
+            w, base = p
+            words = packed_mod.pack_padded(v, w, base)
+            return packed_mod.PackedColumn(put(words), w, base, v.shape[0],
+                                           str(v.dtype))
+
         return DeviceBlock(
             segment_id=self.id, n_rows=self.n_rows, padded_rows=pad_n,
-            time0=time0, arrays={k: put(v) for k, v in arrays.items()},
+            time0=time0, arrays={k: _stage(k, v) for k, v in arrays.items()},
             dictionaries=dictionaries,
         )
 

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from druid_tpu.data import packed
 from druid_tpu.data.segment import DEFAULT_ROW_ALIGN, Segment
 from druid_tpu.engine import grouping
 from druid_tpu.engine.contracts import (BATCH_MAX_SEGMENT_ROWS,
@@ -229,6 +230,7 @@ class _Plan:
     columns: Tuple[str, ...] = ()
     col_dtypes: Dict[str, np.dtype] = None
     rung: int = 0
+    packs: Tuple = ()                # pack descriptor (data/packed.py)
     digest: Tuple = None             # hashable shape-bucket prefilter
 
     @property
@@ -309,8 +311,13 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     plan.columns = columns
     plan.col_dtypes = col_dtypes
     plan.rung = row_rung(segment.n_rows)
+    # pack descriptor (pure fn of column stats, pow2-quantized widths/bases
+    # precisely so near-identical segments keep sharing buckets): packed
+    # inputs change the stacked program's treedef, so chunk-mates must
+    # agree on it — it joins both the signature and the digest
+    plan.packs = packed.plan_columns(segment, columns)
     sig = grouping._structure_sig(spec, len(intervals), filter_node, kernels,
-                                  gplan.vc_plans)
+                                  gplan.vc_plans, plan.packs)
     # granularity + bucket count join the digest for CROSS-QUERY grouping:
     # the stacked aux (assemble_stacked_aux) carries one shared period /
     # num_buckets for the whole chunk, so chunk-mates from different
@@ -447,7 +454,7 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
                                ref.granularity, ref.vc_luts)
     sig = "batched|" + grouping._structure_sig(
         ref.spec, len(ref.intervals), ref.filter_node, ref.kernels,
-        ref.vc_plans) + f"|K={K}|R={R}"
+        ref.vc_plans, ref.packs) + f"|K={K}|R={R}"
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.get(sig)
         # the miss IS the compile event (jit traces/compiles on the first

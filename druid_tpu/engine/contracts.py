@@ -78,6 +78,22 @@ BATCH_MAX_SEGMENT_ROWS = 1 << 21
 #: plan structure — the compile-count bound of the batched path.
 BATCH_ROW_ALIGN = 1024
 
+# ---- compressed-domain packing (data/packed.py) ---------------------------
+
+#: bits per packed storage word (int32 words: the narrowest element Mosaic
+#: tiles natively, and the dtype every unpack shift/mask stays in).
+PACK_WORD_BITS = 32
+
+#: supported pack widths, each dividing PACK_WORD_BITS so no value crosses a
+#: word boundary and values-per-word (vpw = 32 // width) divides the
+#: sublane row count of every pallas block (R = BLK // LANE ∈ {8, 16}).
+#: Width 2 (vpw 16) is deliberately absent: vpw must divide R for the
+#: in-kernel per-tile unpack, and 16 does not divide the wide-window R=8.
+#: Quantizing ceil(log2(cardinality)) up to these widths keeps pack
+#: descriptors coarse, so near-identical segments share plan signatures
+#: (the same design rule as SumKernel.chunk_rows pow2 quantization).
+PACK_WIDTHS = (4, 8, 16)
+
 # ---- device segment pool --------------------------------------------------
 
 #: default HBM byte budget for the process-wide device segment pool
@@ -140,4 +156,12 @@ SYMBOL_BOUNDS = {
     "W": (LANE, MAX_W, LANE),
     "len(uniq_fields)": (0, MAX_PALLAS_FIELDS, 1),
     "len(out_defs)": (1, MAX_PALLAS_SLOTS, 1),
+    # packed-input variant (pallas_agg packed word tiles): vpw = 32 // width
+    # over PACK_WIDTHS, and Rw = R // vpw word rows per block — the worst
+    # case (width 16, BLK_SMALL_W) is R // 2 = 8 rows. Enforced at runtime
+    # by pallas_reduce's vpw-divides-R assertion.
+    "vpw": (2, 8, 2),
+    "Rw": (1, 8, 1),
+    "len(dense_fields)": (0, MAX_PALLAS_FIELDS, 1),
+    "len(packed_rws)": (0, MAX_PALLAS_FIELDS, 1),
 }

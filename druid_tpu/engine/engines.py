@@ -324,6 +324,16 @@ def make_partials_by_segment(query, segments: Sequence[Segment],
                 check()
             out.append(make_aggregate_partials(query, [s], clamp=clamp))
         return out
+    return _split_by_segment(ap, segs, segments)
+
+
+def _split_by_segment(ap: AggregatePartials, segs: Sequence[Segment],
+                      segments: Sequence[Segment]
+                      ) -> List[AggregatePartials]:
+    """Split a per-segment AggregatePartials (partials parallel to `segs`)
+    into one entry per input segment; a segment absent from `segs` (outside
+    the query intervals) yields an EMPTY partials object — exactly what the
+    per-miss cache loop would have stored for it."""
     remaining: Dict[int, List[int]] = {}
     for i, s in enumerate(segs):
         remaining.setdefault(id(s), []).append(i)
@@ -338,6 +348,21 @@ def make_partials_by_segment(query, segments: Sequence[Segment],
         else:
             out.append(AggregatePartials([], [], [], ap.intervals))
     return out
+
+
+def split_partials_by_segment(ap: AggregatePartials,
+                              segments: Sequence[Segment]
+                              ) -> List[AggregatePartials]:
+    """Public splitter for per-segment partial sets produced WITHOUT mesh
+    fusion (make_aggregate_partials_multi items): `ap.partials` is parallel
+    to `_segments_for(segments, ap.intervals)` by construction, so the
+    per-input-segment split is exact. The data node's scheduler-fused
+    segment-cache path uses this to turn one fused wave's results back
+    into per-segment cache entries identical to the serial path's."""
+    segs = _segments_for(segments, ap.intervals or [])
+    assert len(ap.partials) == len(segs), \
+        "split_partials_by_segment needs unfused per-segment partials"
+    return _split_by_segment(ap, segs, segments)
 
 
 def _keydims_for_query(query, segs: Sequence[Segment]):

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from druid_tpu.data import packed as packed_mod
 from druid_tpu.data.segment import DeviceBlock, Segment
 from druid_tpu.engine.filters import (ConstNode, FilterNode, plan_filter,
                                       simplify_node)
@@ -369,13 +370,20 @@ def fuse_filter_update(arrays: Dict, mask, key, it,
                        dim_cols: Tuple, has_remap: Tuple,
                        filter_node: Optional[FilterNode],
                        kernels: Sequence[AggKernel], num_total: int,
-                       strategy: str = "mixed", window: int = 0):
+                       strategy: str = "mixed", window: int = 0,
+                       packed_cols: Optional[Dict] = None):
     """Traced: the shared tail of the grouped-aggregate program — fuse dim
     ids into the key (through optional remap tables), apply the filter mask,
     and run every kernel's segmented reduction via the selected strategy.
     Both the per-segment (_build_device_fn) and sharded
     (parallel/distributed.py) builders call this, so keying/update semantics
-    cannot diverge between paths."""
+    cannot diverge between paths.
+
+    `arrays` is the DENSE view (the program top already decoded any
+    bit-packed columns — data/packed.py); `packed_cols` carries the
+    original PackedColumns so the pallas strategy can consume the words
+    directly and unpack per VMEM tile. XLA dead-code-eliminates whichever
+    representation a strategy leaves unused."""
     import jax
     import jax.numpy as jnp
 
@@ -412,7 +420,8 @@ def fuse_filter_update(arrays: Dict, mask, key, it,
     if strategy == "pallas":
         from druid_tpu.engine import pallas_agg
         return pallas_agg.pallas_reduce(arrays, mask, key, kernels,
-                                        num_total, window)
+                                        num_total, window,
+                                        packed_cols=packed_cols)
 
     if strategy == "windowed":
         return _windowed_reduce(arrays, mask, key, kernels, num_total, window)
@@ -711,7 +720,7 @@ def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
 
 
 def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
-                   vc_plans) -> str:
+                   vc_plans, packs: Tuple = ()) -> str:
     dims_sig = ",".join(
         f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in spec.dims)
     # repr(expr) is the rewritten AST structure — two segments share a
@@ -728,6 +737,10 @@ def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
         f"aggs={';'.join(k.signature() for k in kernels)}",
         f"total={spec.num_total}",
         f"strat={spec.strategy}:{spec.window}",
+        # the pack descriptor (data/packed.plan_columns) is program
+        # structure: packed inputs have different treedefs/shapes, so two
+        # executions share a jitted program only when their packing agrees
+        f"packs={packs}",
     ])
 
 
@@ -749,6 +762,11 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
 
     def fn(arrays: Dict[str, object], aux: Tuple):
         it = iter(aux)
+        # decode bit-packed columns at the program top: HBM keeps the words,
+        # XLA fuses the shift/mask decode into every consumer; the pallas
+        # strategy additionally receives the raw words (packed_cols) and
+        # unpacks per tile inside the kernel instead
+        packed_cols, arrays = packed_mod.split_packed(arrays)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
@@ -792,7 +810,8 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
         return fuse_filter_update(arrays, mask, key, it, dims_for_key,
                                   remaps_for_key, filter_node, kernels,
                                   num_total, strategy=spec.strategy,
-                                  window=spec.window)
+                                  window=spec.window,
+                                  packed_cols=packed_cols or None)
 
     return jax.jit(fn)
 
@@ -863,6 +882,11 @@ def make_stacked_segment_fn(spec: GroupSpec, kds: Sequence[KeyDim],
 
     def per_segment(arrays, time0, iv_rel, bucket_off, aux):
         it = iter(aux)
+        # same decode-at-top story as _build_device_fn: stacked blocks may
+        # carry bit-packed columns (the batched path stages through the
+        # same pool); the sharded path host-stacks decoded arrays, so this
+        # is a no-op there
+        packed_cols, arrays = packed_mod.split_packed(arrays)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
@@ -889,7 +913,8 @@ def make_stacked_segment_fn(spec: GroupSpec, kds: Sequence[KeyDim],
 
         return fuse_filter_update(arrays, mask, key, it, dim_cols, has_remap,
                                   filter_node, kernels, num_total,
-                                  strategy=spec.strategy, window=spec.window)
+                                  strategy=spec.strategy, window=spec.window,
+                                  packed_cols=packed_cols or None)
 
     return per_segment
 
@@ -1087,6 +1112,11 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
         spec.host_keys_cache = perm_key
         needed = base_needed  # key prefused: dim columns stay host-side
 
+    # pack descriptor of the staged column set: must be derived IDENTICALLY
+    # to device_block's own planning (pure fn of column stats), and joins
+    # the jit-cache signature — a packed and a decoded staging of the same
+    # structure are different programs
+    packs = packed_mod.plan_columns(segment, sorted(needed))
     block = segment.device_block(sorted(needed), perm=perm, perm_key=perm_key)
 
     arrays = dict(block.arrays)
@@ -1110,7 +1140,7 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                         vc_plans, vc_luts)
     while True:
         sig = _structure_sig(spec, len(intervals), filter_node, kernels,
-                             vc_plans)
+                             vc_plans, packs)
         with _JIT_CACHE_LOCK:
             fn = _JIT_CACHE.get(sig)
             # the builder-idiom miss IS the compile event: jit tracing +

@@ -22,6 +22,12 @@ Stock-XLA strategies measured 21-77M rows/s on this chip for G≈131k; the
 windowed XLA path needs a sorted layout plus an L2 scatter pass. This kernel
 fuses the whole reduction.
 
+Value columns that staged bit-packed (data/packed.py) stream into the
+kernel AS WORDS: an R//vpw-row tile per block that unpacks to the [R, 128]
+value tile with int32 shifts/masks in VMEM — the compressed-domain
+execution of the ROADMAP's HBM-wall item. The decoded column never exists
+in HBM; unpack is exact, so packed and dense runs are bit-identical.
+
 Off-TPU the projection falls back to the XLA windowed path
 (grouping._windowed_reduce); tests exercise this kernel via the pallas
 interpreter (force_interpret()).
@@ -129,9 +135,16 @@ def usable(kernels: Sequence, col_dtypes: Dict, span: int,
 
 
 def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
-                  span: int):
+                  span: int, packed_cols: Optional[Dict] = None):
     """Traced: (counts int32 [num_total], per-kernel states), the same
-    contract as grouping's scatter/blocked paths."""
+    contract as grouping's scatter/blocked paths.
+
+    `arrays` is the dense view; `packed_cols` (data/packed.py
+    PackedColumns) supplies bit-packed words for value fields that staged
+    compressed — those stream into the kernel AS WORDS (an R//vpw-row tile
+    per block instead of R) and unpack per tile in VMEM, so the decoded
+    column never materializes in HBM. Unpack is exact, so results stay
+    bit-identical to the dense path."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -165,13 +178,40 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
     keyx = pad_rows(keyx, SENTINEL).reshape(n2 // 128, 128)
 
     # kernel inputs: key + one value column per op that reads one (the
-    # same layout helper usable() sized the plan with)
+    # same layout helper usable() sized the plan with). Dense fields lead,
+    # packed fields trail — their word tiles have a different shape, and a
+    # stable operand order keeps the in_specs expression analyzable.
     uniq_fields = op_fields(ops)
     assert len(uniq_fields) <= MAX_PALLAS_FIELDS, \
         f"{len(uniq_fields)} value columns exceed the pallas field cap"
-    field_ix = {f: i for i, f in enumerate(uniq_fields)}
+    pcs = {}
+    if packed_cols:
+        for f in uniq_fields:
+            pc = packed_cols.get(f)
+            # vpw divides R by the PACK_WIDTHS contract; a descriptor that
+            # violates it (or a row-count mismatch) falls back to the dense
+            # view of that field — correctness never depends on packing
+            if pc is not None and R % pc.vpw == 0 and pc.rows == n:
+                pcs[f] = pc
+    dense_fields = [f for f in uniq_fields if f not in pcs]
+    packed_fields = [f for f in uniq_fields if f in pcs]
+    field_ix = {f: i for i, f in enumerate(dense_fields + packed_fields)}
     vals2 = [pad_rows(arrays[f], np.array(0, arrays[f].dtype))
-             .reshape(n2 // 128, 128) for f in uniq_fields]
+             .reshape(n2 // 128, 128) for f in dense_fields]
+    packed_desc = []                 # (width, vpw, base) per packed field
+    packed_rws = []                  # word rows per block, per packed field
+    for f in packed_fields:
+        pc = pcs[f]
+        words = pc.words
+        pad_w = n2 // pc.vpw - words.shape[0]
+        if pad_w:
+            # zero words decode to `base` on padding rows; padding rows
+            # carry the key SENTINEL, so no op ever matches them
+            words = jnp.concatenate(
+                [words, jnp.zeros((pad_w,), words.dtype)])
+        vals2.append(words.reshape(n2 // pc.vpw // 128, 128))
+        packed_desc.append((pc.width, pc.vpw, pc.base))
+        packed_rws.append(R // pc.vpw)
 
     # flush period for int32 limb sums: lo grows ≤ BLK·max_abs per block and
     # chunk_rows·max_abs ≤ 2^30 by SumKernel's analysis, so chunk_rows // BLK
@@ -241,6 +281,22 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
         r0 = abase // c128
         lane = jax.lax.broadcasted_iota(jnp.int32, (R, 128, 128), 2)
 
+        # materialize every field's [R, 128] value tile once per block.
+        # Packed fields arrive as [R // vpw, 128] word tiles and unpack
+        # here — int32 shift/mask on the VPU, then a reshape that restores
+        # exactly the tile-planar row order pack_padded encoded (value row
+        # q*vpw + s lives in word row q at bit slot s); arithmetic >> is
+        # safe because the mask cuts the sign-extension bits
+        vals_t = [vrefs[j][:, :] for j in range(len(dense_fields))]
+        for j, (wd, vpw, base) in enumerate(packed_desc):
+            wt = vrefs[len(dense_fields) + j][:, :]      # [R // vpw, 128]
+            sh = jnp.int32(wd) * jax.lax.broadcasted_iota(
+                jnp.int32, (R // vpw, vpw, 128), 1)
+            pv = (wt[:, None, :] >> sh) & jnp.int32((1 << wd) - 1)
+            if base:
+                pv = pv + jnp.int32(base)
+            vals_t.append(pv.reshape(R, 128))
+
         # per window-row matches, shared across every op
         for wr in range(Wr):
             match = ((local - wr * 128)[:, :, None] == lane)  # [R,128,128]
@@ -256,7 +312,7 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
                     continue
                 if op[0] in ("zero", "empty"):
                     continue
-                v = vrefs[field_ix[op[1]]][:, :]
+                v = vals_t[field_ix[op[1]]]
                 if op[0] == "sum_i32":
                     part = jnp.sum(jnp.where(match, v[:, :, None],
                                              jnp.int32(0)),
@@ -305,11 +361,18 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
     # index-map constants must be typed AND built inside the lambda: under
     # the repo-global x64 flag a Python-int 0 promotes to i64 and Mosaic
     # fails to legalize the (i32, i64) func.return of the index map, while a
-    # closure-captured jnp scalar is rejected as a captured tracer
+    # closure-captured jnp scalar is rejected as a captured tracer (the
+    # BENCH_r04 failure class; tracecheck pallas-accum-dtype guards it).
+    # Packed word tiles declare (Rw, 128) = (R // vpw, 128) blocks — the
+    # index map is still block-granular, so (i, 0) addresses word rows
     grid_spec = pl.GridSpec(
         grid=(nblk,),
-        in_specs=[pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)),
-                               memory_space=pltpu.VMEM)] * (1 + len(uniq_fields)),
+        in_specs=([pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)),
+                                memory_space=pltpu.VMEM)]
+                  * (1 + len(dense_fields))
+                  + [pl.BlockSpec((Rw, 128), lambda i: (i, jnp.int32(0)),
+                                  memory_space=pltpu.VMEM)
+                     for Rw in packed_rws]),
         out_specs=[pl.BlockSpec((G2 // 128, 128),
                                 lambda i: (jnp.int32(0), jnp.int32(0)),
                                 memory_space=pltpu.VMEM)] * len(out_defs),

@@ -167,6 +167,13 @@ METRICS = {
         "unit": "count", "dims": (),
         "site": "data/devicepool.py",
         "help": "current pool entry count"},
+    "segment/devicePool/packedRatio": {
+        "unit": "ratio", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "decoded-equivalent bytes / actual resident bytes of "
+                "compressed-domain pool entries (1.0 = nothing packed); "
+                "the pool/h2d trace span's bytes attr is likewise the "
+                "COMPRESSED bus transfer, logicalBytes the decoded size"},
     # ---- coordination (coordination/latch.py) --------------------------
     "coordination/leader/transitions": {
         "unit": "count", "dims": ("service", "node", "event", "term",

@@ -61,6 +61,12 @@ def test_bench_exits_zero_with_one_json_line():
     assert out["batched_rate"] > 0
     assert out["batch_speedup"] > 0
     assert out["batch_segments"] == 4
+    # the compressed-domain cold-miss comparison (contract only: rates
+    # positive and the pool really held compressed bytes — the ≥3x
+    # capacity bar lives in test_packed.py where the shape is controlled)
+    assert out["packed_rate"] > 0
+    assert out["decoded_rate"] > 0
+    assert out["pack_ratio"] > 1.0
     # the qtrace-overhead fields tracked across BENCH_r* runs
     assert out["traced_rate"] > 0
     assert out["untraced_rate"] > 0

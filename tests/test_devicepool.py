@@ -207,3 +207,70 @@ def test_clear_keeps_live_owners_cacheable(fresh_pool):
     fresh_pool.get_or_build(token, ("k",),
                             lambda: np.zeros(8, dtype=np.int64))
     assert fresh_pool.snapshot().entries == 1
+
+
+def test_entry_bytes_counts_packed_entries_compressed():
+    """Satellite contract: pool accounting must not undercount (or
+    double-count) the packed-staging entry shapes — PackedColumns alone,
+    inside DeviceBlock-style dicts, and in tuples/pytrees mixed with aux
+    arrays. entry_bytes counts the COMPRESSED words; entry_logical_bytes
+    the decoded equivalent."""
+    from druid_tpu.data import packed
+    from druid_tpu.data.devicepool import entry_logical_bytes
+
+    rows = 2048
+    vals = np.arange(rows, dtype=np.int32) % 200          # width 8, base 0
+    pc = packed.PackedColumn(packed.pack_padded(vals, 8, 0), 8, 0, rows)
+    assert pc.vpw == 4
+    assert entry_bytes(pc) == rows // 4 * 4               # words bytes
+    assert entry_logical_bytes(pc) == rows * 4            # decoded bytes
+
+    # DeviceBlock-style dict mixing packed and dense columns
+    dense = np.zeros(rows, dtype=np.int32)
+    class FakeBlock:
+        arrays = {"packed": pc, "dense": dense}
+    assert entry_bytes(FakeBlock()) == pc.nbytes + dense.nbytes
+    assert entry_logical_bytes(FakeBlock()) == rows * 4 + dense.nbytes
+
+    # tuples/pytrees of packed words + aux (derived-entry shapes)
+    aux = np.zeros(16, dtype=np.int64)
+    assert entry_bytes((pc, aux)) == pc.nbytes + aux.nbytes
+    assert entry_bytes([pc, {"a": aux}, (pc,)]) \
+        == 2 * pc.nbytes + aux.nbytes
+    assert entry_logical_bytes((pc, aux)) == rows * 4 + aux.nbytes
+    assert entry_logical_bytes(None) == 0
+
+
+def test_pool_accounts_packed_entries_and_ratio(fresh_pool):
+    """Inserting packed pytree entries: resident tracks compressed bytes,
+    logical tracks decoded bytes, packed_ratio reports the multiplier, and
+    eviction/purge keep both in sync."""
+    from druid_tpu.data import packed
+
+    class Owner:
+        pass
+
+    owner_obj = Owner()
+    token = fresh_pool.register_owner(owner_obj)
+    rows = 4096
+    vals = (np.arange(rows) % 16).astype(np.int32)        # width 4 -> 8x
+    pc = packed.PackedColumn(packed.pack_padded(vals, 4, 0), 4, 0, rows)
+    aux = np.zeros(128, dtype=np.int32)
+    fresh_pool.get_or_build(token, ("p",), lambda: (pc, aux))
+    s = fresh_pool.snapshot()
+    assert s.resident_bytes == pc.nbytes + aux.nbytes
+    assert s.logical_bytes == rows * 4 + aux.nbytes
+    assert s.packed_ratio > 3.0                           # 8x words + aux
+    fresh_pool.clear()
+    s2 = fresh_pool.snapshot()
+    assert s2.resident_bytes == 0 and s2.logical_bytes == 0
+    assert s2.packed_ratio == 1.0
+
+
+def test_pool_monitor_emits_packed_ratio(fresh_pool):
+    sink = InMemoryEmitter()
+    emitter = ServiceEmitter("historical", "host1", sink)
+    mon = devicepool.DevicePoolMonitor(fresh_pool)
+    mon.do_monitor(emitter)
+    ratios = sink.metrics("segment/devicePool/packedRatio")
+    assert ratios and ratios[-1].value == 1.0             # empty pool

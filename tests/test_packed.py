@@ -1,0 +1,450 @@
+"""Compressed-domain device execution (data/packed.py + the engine decode
+story): exact-equality parity between packed and decoded staging over mixed
+dtypes and all execution paths, pure-stats pack planning, the ≥3x
+effective-pool-capacity contract on the bench's small-segment shape, and
+the pallas packed-input variant (interpret mode).
+
+Parity assertions are EXACT (`==` on finished rows / array_equal on
+states, floats included): bit-unpacking is exact reconstruction, so whether
+a column staged packed or decoded may never change a result's bits."""
+import numpy as np
+import pytest
+
+import druid_tpu.engine  # noqa: F401  (x64 on before jax numerics)
+from druid_tpu.data import devicepool, packed
+from druid_tpu.data.devicepool import DeviceSegmentPool
+from druid_tpu.data.generator import ColumnSpec, DataGenerator
+from druid_tpu.data.segment import SegmentBuilder, ValueType
+from druid_tpu.engine import pallas_agg
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-05-01", "2026-05-02")
+
+SCHEMA = (
+    ColumnSpec("dimA", "string", cardinality=12, distribution="uniform"),
+    ColumnSpec("dimB", "string", cardinality=900, distribution="zipf"),
+    ColumnSpec("metLong", "long", low=-50, high=9000),
+    ColumnSpec("metFloat", "float", distribution="normal", mean=10.0,
+               std=4.0),
+    ColumnSpec("metDouble", "double", low=0.0, high=1.0),
+)
+
+GROUPBY = {
+    "queryType": "groupBy", "dataSource": "pk", "intervals": [str(IV)],
+    "granularity": "all",
+    "dimensions": ["dimA", "dimB"],
+    "aggregations": [
+        {"type": "count", "name": "n"},
+        {"type": "longSum", "name": "ls", "fieldName": "metLong"},
+        {"type": "longMin", "name": "lm", "fieldName": "metLong"},
+        {"type": "floatMax", "name": "fx", "fieldName": "metFloat"},
+        {"type": "doubleSum", "name": "ds", "fieldName": "metDouble"},
+    ],
+    "filter": {"type": "bound", "dimension": "metLong", "lower": 0,
+               "upper": 8000, "ordering": "numeric"},
+}
+
+TIMESERIES = {
+    "queryType": "timeseries", "dataSource": "pk", "intervals": [str(IV)],
+    "granularity": "hour",
+    "aggregations": GROUPBY["aggregations"],
+}
+
+TOPN = {
+    "queryType": "topN", "dataSource": "pk", "intervals": [str(IV)],
+    "granularity": "all", "dimension": "dimB", "metric": "ls",
+    "threshold": 9,
+    "aggregations": [{"type": "count", "name": "n"},
+                     {"type": "longSum", "name": "ls",
+                      "fieldName": "metLong"}],
+}
+
+
+@pytest.fixture
+def fresh_pool(monkeypatch):
+    pool = DeviceSegmentPool(budget_bytes=1 << 40)
+    monkeypatch.setattr(devicepool, "_POOL", pool)
+    return pool
+
+
+def _segments(n=4, rows=2500, seed=23):
+    return DataGenerator(SCHEMA, seed=seed).segments(
+        n, rows, IV, datasource="pk")
+
+
+def _run_both(query_json, segments):
+    """(decoded results, packed results) over fresh executions."""
+    ex = QueryExecutor(segments)
+    prev = packed.set_enabled(False)
+    try:
+        dec = ex.run_json(query_json)
+        packed.set_enabled(True)
+        pk = ex.run_json(query_json)
+    finally:
+        packed.set_enabled(prev)
+    return dec, pk
+
+
+# ---------------------------------------------------------------------------
+# encoder unit level
+# ---------------------------------------------------------------------------
+
+def test_pack_roundtrip_all_widths():
+    rng = np.random.default_rng(0)
+    for width, lo, hi in ((4, 0, 15), (8, -100, 100), (16, -5000, 40000)):
+        base = 0 if lo >= 0 else -(1 << ((-lo - 1).bit_length()))
+        w = packed.width_for(hi, base)
+        assert w == width
+        v = rng.integers(lo, hi + 1, size=4096).astype(np.int32)
+        words = packed.pack_padded(v, w, base)
+        assert words.dtype == np.int32
+        assert words.nbytes * (32 // w) == v.nbytes
+        np.testing.assert_array_equal(
+            packed.unpack_host(words, w, base, 4096, "int32"), v)
+
+
+def test_unpack_device_matches_host():
+    import jax
+    rng = np.random.default_rng(1)
+    v = rng.integers(-900, 900, size=2048).astype(np.int32)
+    w = packed.width_for(900, -1024)
+    pc = packed.PackedColumn(
+        jax.device_put(packed.pack_padded(v, w, -1024)), w, -1024, 2048)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(packed.unpack_device)(pc)), v)
+
+
+def test_packed_column_is_a_pytree():
+    import jax
+    pc = packed.PackedColumn(np.zeros(256, np.int32), 8, -16, 1024)
+    leaves, treedef = jax.tree_util.tree_flatten(pc)
+    assert len(leaves) == 1
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert back.descriptor() == pc.descriptor()
+    # the descriptor rides the treedef: jit specializes per descriptor
+    pc2 = packed.PackedColumn(np.zeros(512, np.int32), 4, 0, 4096)
+    assert treedef != jax.tree_util.tree_flatten(pc2)[1]
+
+
+def test_plan_column_is_pure_function_of_stats(fresh_pool):
+    b = SegmentBuilder("pk", IV)
+    for i in range(64):
+        b.add_row(IV.start + i, {"low": f"v{i % 9}", "high": f"u{i}"},
+                  {"small": i % 50, "neg": (i % 40) - 20,
+                   "big": 2 ** 40 + i, "f": float(i)})
+    s = b.build()
+    assert packed.plan_column(s, "low") == (4, 0)        # card 9 -> 4 bits
+    assert packed.plan_column(s, "high") == (8, 0)       # card 64 -> 8 bits
+    assert packed.plan_column(s, "small") == (8, 0)      # [0, 49]
+    w, base = packed.plan_column(s, "neg")               # [-20, 19]
+    assert base == -32 and w == 8                        # pow2-quantized
+    assert packed.plan_column(s, "big") is None          # int64-staged
+    assert packed.plan_column(s, "f") is None            # float: decoded
+    assert packed.plan_column(s, "__time_offset") is None
+    # plan_columns is the ordered descriptor and respects the switch
+    packs = packed.plan_columns(s, ["neg", "low", "f"])
+    assert packs == (("low", 4, 0), ("neg", 8, -32))
+    prev = packed.set_enabled(False)
+    try:
+        assert packed.plan_columns(s, ["low"]) == ()
+    finally:
+        packed.set_enabled(prev)
+
+
+def test_complex_integer_columns_never_pack(fresh_pool):
+    """REGRESSION (review finding): a 2-D ComplexColumn with an INTEGER
+    state dtype (complex columns load with arbitrary dtypes) must not get
+    a pack plan — the packer and both decoders are 1-D tile-planar only,
+    so a packed 2-D column would crash every query reading it."""
+    from druid_tpu.data.dictionary import Dictionary
+    from druid_tpu.data.segment import (ComplexColumn, Segment, SegmentId,
+                                        StringDimColumn)
+    n = 64
+    time_ms = np.arange(n, dtype=np.int64) + IV.start
+    d = Dictionary(["a", "b"])
+    seg = Segment(
+        SegmentId("pk", IV, "v0"), time_ms,
+        {"d": StringDimColumn((np.arange(n) % 2).astype(np.int32), d)},
+        {"hll": ComplexColumn(np.zeros((n, 16), dtype=np.int32), "hu")})
+    assert packed.plan_column(seg, "hll") is None
+    prev = packed.set_enabled(True)
+    try:
+        block = seg.device_block(["d", "hll"])
+    finally:
+        packed.set_enabled(prev)
+    assert not isinstance(block.arrays["hll"], packed.PackedColumn)
+    assert isinstance(block.arrays["d"], packed.PackedColumn)
+
+
+def test_high_cardinality_dim_falls_back_to_decoded(fresh_pool):
+    n = (1 << 16) + 8                     # cardinality past the 16-bit cap
+    b = SegmentBuilder("pk", IV)
+    b.add_columns(np.arange(n, dtype=np.int64) + IV.start,
+                  {"wide": [f"u{i:07d}" for i in range(n)]},
+                  {"m": np.arange(n, dtype=np.int64)})
+    s = b.build()
+    assert s.dims["wide"].cardinality > 1 << 16
+    assert packed.plan_column(s, "wide") is None
+    assert packed.width_for((1 << 16) - 1, 0) == 16     # boundary: packs
+    assert packed.width_for(1 << 16, 0) == 0            # one past: decoded
+
+
+# ---------------------------------------------------------------------------
+# engine parity (the acceptance bar: exact equality, floats included)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("qjson", [GROUPBY, TIMESERIES, TOPN],
+                         ids=["groupBy", "timeseries", "topN"])
+def test_packed_results_exactly_equal_decoded(fresh_pool, qjson):
+    dec, pk = _run_both(qjson, _segments())
+    assert dec == pk
+
+
+def test_parity_holds_with_batching_disabled(fresh_pool):
+    from druid_tpu.engine import batching
+    prev = batching.set_enabled(False)
+    try:
+        dec, pk = _run_both(GROUPBY, _segments())
+    finally:
+        batching.set_enabled(prev)
+    assert dec == pk
+
+
+def test_parity_with_virtual_column_reading_packed_input(fresh_pool):
+    q = dict(GROUPBY)
+    q["virtualColumns"] = [{"type": "expression", "name": "v",
+                            "expression": "metLong * 2 + 1",
+                            "outputType": "long"}]
+    q["aggregations"] = GROUPBY["aggregations"] + [
+        {"type": "longSum", "name": "vs", "fieldName": "v"}]
+    dec, pk = _run_both(q, _segments())
+    assert dec == pk
+
+
+def test_packed_staging_actually_engages(fresh_pool):
+    """Guard against silently testing nothing: the packed run must hold
+    compressed bytes in the pool (ratio > 1) and stage strictly fewer
+    bytes than the decoded staging of the same segments."""
+    segs = _segments()
+    ex = QueryExecutor(segs)
+    prev = packed.set_enabled(False)
+    try:
+        ex.run_json(GROUPBY)
+        decoded_resident = fresh_pool.snapshot().resident_bytes
+        fresh_pool.clear()
+        packed.set_enabled(True)
+        ex.run_json(GROUPBY)
+    finally:
+        packed.set_enabled(prev)
+    s = fresh_pool.snapshot()
+    assert s.packed_ratio > 1.3, s
+    assert s.resident_bytes < decoded_resident
+
+
+def test_pack_descriptor_keys_the_batching_digest(fresh_pool):
+    """Chunk-mates must agree on the pack descriptor: same-stats segments
+    share one shape bucket (the pow2 quantization contract), and a segment
+    whose value range needs a wider width lands in a DIFFERENT bucket —
+    never in a mixed-treedef batch."""
+    from druid_tpu.engine import batching
+    from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+    from druid_tpu.utils.granularity import Granularity
+
+    # non-negative metric range: base stays 0 for every segment, so the
+    # stats-derived plan constants (mm_base, chunk_rows, pack width) agree
+    # across segments — the shape that MUST share one bucket
+    schema = (SCHEMA[0], SCHEMA[1],
+              ColumnSpec("metLong", "long", low=0, high=9000),
+              SCHEMA[3], SCHEMA[4])
+    segs = DataGenerator(schema, seed=23).segments(
+        4, 1500, IV, datasource="pk")
+    aggs = [CountAggregator("n"), LongSumAggregator("ls", "metLong")]
+    plans = [batching._plan_for(s, [], i, [IV], Granularity.of("all"),
+                                aggs, None, [])
+             for i, s in enumerate(segs)]
+    assert all(p.eligible for p in plans)
+    assert len({p.packs for p in plans}) == 1
+    assert len({p.digest for p in plans}) == 1
+    assert plans[0].packs                      # descriptor actually present
+
+    # a wider-range segment: same structure, different pack width
+    wide = DataGenerator(
+        (SCHEMA[0], SCHEMA[1],
+         ColumnSpec("metLong", "long", low=0, high=200_000),
+         SCHEMA[3], SCHEMA[4]), seed=29).segments(
+            1, 1500, IV, datasource="pk")[0]
+    p_wide = batching._plan_for(wide, [], 0, [IV], Granularity.of("all"),
+                                aggs, None, [])
+    assert p_wide.eligible
+    assert p_wide.packs != plans[0].packs
+    assert p_wide.digest != plans[0].digest
+
+
+# ---------------------------------------------------------------------------
+# pallas packed-input variant (interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_pallas_packed_input_bit_identical(monkeypatch):
+    import jax.numpy as jnp
+    from druid_tpu.engine.kernels import (CountKernel, MinMaxKernel,
+                                          SumKernel)
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             FloatSumAggregator,
+                                             LongMaxAggregator,
+                                             LongMinAggregator,
+                                             LongSumAggregator)
+
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    rng = np.random.default_rng(3)
+    n, groups, num_total = 20480, 300, 512
+    key = np.sort(rng.integers(0, groups, size=n)).astype(np.int32)
+    mask = rng.random(n) < 0.9
+    vlong = rng.integers(-1000, 1000, size=n).astype(np.int32)
+    vfloat = rng.normal(0.0, 100.0, size=n).astype(np.float32)
+    kb = key.reshape(-1, pallas_agg.SPAN_BLOCK)
+    span = int((kb.max(axis=1) - kb.min(axis=1) + 1).max())
+
+    ks = SumKernel(LongSumAggregator("ls", "vlong"), ValueType.LONG)
+    ks.chunk_rows = 1 << 20
+    kernels = [CountKernel(CountAggregator("n")), ks,
+               SumKernel(FloatSumAggregator("fs", "vfloat"),
+                         ValueType.FLOAT),
+               MinMaxKernel(LongMinAggregator("lm", "vlong"),
+                            ValueType.LONG, False),
+               MinMaxKernel(LongMaxAggregator("lx", "vlong"),
+                            ValueType.LONG, True)]
+    arrays = {"vlong": jnp.asarray(vlong), "vfloat": jnp.asarray(vfloat)}
+    c0, s0 = pallas_agg.pallas_reduce(
+        arrays, jnp.asarray(mask), jnp.asarray(key), kernels, num_total,
+        span)
+
+    base = -1024
+    w = packed.width_for(1000, base)
+    pc = packed.PackedColumn(
+        jnp.asarray(packed.pack_padded(vlong, w, base)), w, base, n)
+    c1, s1 = pallas_agg.pallas_reduce(
+        arrays, jnp.asarray(mask), jnp.asarray(key), kernels, num_total,
+        span, packed_cols={"vlong": pc})
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    for a, b in zip(s0, s1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_rejects_mismatched_packed_descriptor(monkeypatch):
+    """A descriptor whose rows disagree with the block falls back to the
+    dense view — correctness never depends on packing."""
+    import jax.numpy as jnp
+    from druid_tpu.engine.kernels import CountKernel, SumKernel
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             LongSumAggregator)
+
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    rng = np.random.default_rng(5)
+    n = 4096
+    key = np.sort(rng.integers(0, 50, size=n)).astype(np.int32)
+    mask = np.ones(n, bool)
+    vlong = rng.integers(0, 100, size=n).astype(np.int32)
+    ks = SumKernel(LongSumAggregator("ls", "vlong"), ValueType.LONG)
+    ks.chunk_rows = 1 << 20
+    kernels = [CountKernel(CountAggregator("n")), ks]
+    arrays = {"vlong": jnp.asarray(vlong)}
+    wrong = packed.PackedColumn(
+        jnp.asarray(packed.pack_padded(vlong[:2048], 8, 0)), 8, 0, 2048)
+    c0, s0 = pallas_agg.pallas_reduce(
+        arrays, jnp.asarray(mask), jnp.asarray(key), kernels, 64, 64)
+    c1, s1 = pallas_agg.pallas_reduce(
+        arrays, jnp.asarray(mask), jnp.asarray(key), kernels, 64, 64,
+        packed_cols={"vlong": wrong})
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    np.testing.assert_array_equal(np.asarray(s0[1]), np.asarray(s1[1]))
+
+
+def test_projection_pallas_path_parity_with_packing(fresh_pool, monkeypatch):
+    """Executor-level: force the projection/pallas strategy (interpret
+    mode) and assert packed staging keeps exact parity through the fused
+    kernel — the full compressed-domain path from pool to kernel."""
+    from druid_tpu.engine import grouping
+    monkeypatch.setattr(pallas_agg, "_FORCE_INTERPRET", True)
+    monkeypatch.setattr(grouping, "PROJECTION_MIN_ROWS", 1)
+    monkeypatch.setattr(grouping, "FORCE_STRATEGY", "projection")
+    segs = _segments(2, rows=3000)
+    q = {
+        "queryType": "groupBy", "dataSource": "pk",
+        "intervals": [str(IV)], "granularity": "all",
+        "dimensions": ["dimB"],          # bigger group space
+        # no double aggs: the projection force needs blocked-eligible
+        # kernels, and the point here is the pallas packed-input path
+        "aggregations": [
+            {"type": "count", "name": "n"},
+            {"type": "longSum", "name": "ls", "fieldName": "metLong"},
+            {"type": "longMin", "name": "lm", "fieldName": "metLong"},
+            {"type": "floatSum", "name": "fs", "fieldName": "metFloat"},
+        ],
+    }
+    dec, pk = _run_both(q, segs)
+    assert dec == pk
+
+
+# ---------------------------------------------------------------------------
+# effective pool capacity (the ≥3x acceptance bar)
+# ---------------------------------------------------------------------------
+
+def test_pool_holds_3x_more_segments_at_fixed_budget(fresh_pool):
+    """The acceptance bar on the bench's small-segment H2D-bound shape:
+    narrow dims + small-range long metrics dominate the staged bytes. At a
+    byte budget sized for N decoded segment stagings, packed staging must
+    keep ≥ 3N segments resident."""
+    n_segments, rows = 12, 2048
+    schema = (ColumnSpec("dimA", "string", cardinality=12),
+              ColumnSpec("dimB", "string", cardinality=12),
+              ColumnSpec("dimC", "string", cardinality=12),
+              ColumnSpec("dimD", "string", cardinality=12),
+              ColumnSpec("dimE", "string", cardinality=12),
+              ColumnSpec("m1", "long", low=0, high=15),
+              ColumnSpec("m2", "long", low=0, high=200),
+              ColumnSpec("m3", "long", low=0, high=200))
+    segs = DataGenerator(schema, seed=9).segments(
+        n_segments, rows, IV, datasource="pk")
+    dvals = {d: segs[0].dims[d].dictionary.values[:6]
+             for d in ("dimC", "dimD", "dimE")}
+    q = {"queryType": "groupBy", "dataSource": "pk",
+         "intervals": [str(IV)], "granularity": "all",
+         "dimensions": ["dimA", "dimB"],
+         "filter": {"type": "and", "fields": [
+             {"type": "in", "dimension": d, "values": list(v)}
+             for d, v in dvals.items()]},
+         "aggregations": [{"type": "count", "name": "n"},
+                          {"type": "longSum", "name": "s1",
+                           "fieldName": "m1"},
+                          {"type": "longSum", "name": "s2",
+                           "fieldName": "m2"},
+                          {"type": "longMin", "name": "s3",
+                           "fieldName": "m3"}]}
+    ex = QueryExecutor(segs)
+    prev = packed.set_enabled(False)
+    try:
+        dec_rows = ex.run_json(q)
+        decoded_per_seg = fresh_pool.snapshot().resident_bytes / n_segments
+        fresh_pool.clear()
+        packed.set_enabled(True)
+        pk_rows = ex.run_json(q)
+        s_pk = fresh_pool.snapshot()
+        assert dec_rows == pk_rows                  # parity rides along
+        packed_per_seg = s_pk.resident_bytes / n_segments
+        multiplier = decoded_per_seg / packed_per_seg
+        assert multiplier >= 3.0, (
+            f"packed staging only {multiplier:.2f}x "
+            f"({decoded_per_seg:.0f}B -> {packed_per_seg:.0f}B per segment)")
+        assert s_pk.packed_ratio >= 3.0
+        # the budget itself now holds >= 3x the segments: sized for ~4
+        # decoded stagings, every packed staging stays resident at once
+        budget = int(decoded_per_seg * 4)
+        fresh_pool.clear()
+        fresh_pool.configure(budget)
+        ex.run_json(q)
+        s = fresh_pool.snapshot()
+        assert s.entries >= n_segments
+        assert s.resident_bytes <= budget
+    finally:
+        packed.set_enabled(prev)

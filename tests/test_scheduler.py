@@ -515,10 +515,11 @@ def test_http_flood_yields_429_with_retry_after(node, sched_segments):
 
 
 def test_non_fusable_requests_bypass_scheduler(sched_segments):
-    """Work the node cannot fuse (per-segment metrics here; mesh/cached
-    likewise) must run on the request thread, not serialize on the single
-    dispatcher thread — DataNodeServer routes it straight to run_partials
-    and the scheduler never sees it."""
+    """Work the node cannot fuse (per-segment metrics here; mesh likewise)
+    must run on the request thread, not serialize on the single dispatcher
+    thread — DataNodeServer routes it straight to run_partials and the
+    scheduler never sees it. (Segment-cache queries, by contrast, DO fuse
+    — see the scheduler × segment-cache section below.)"""
     n = DataNode("bypass-node",
                  emitter=ServiceEmitter("druid/historical", "t",
                                         InMemoryEmitter()),
@@ -767,3 +768,169 @@ def test_broker_fails_fast_with_clear_shed_error(sched_segments,
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler × segment cache (PR 7 follow-on: cache-hit partials resolve
+# inside the batched wave instead of routing per-query in a flush)
+# ---------------------------------------------------------------------------
+
+def _cached_node(sched_segments, name="cache-node"):
+    from druid_tpu.cluster.cache import CacheConfig, LruCache
+    n = DataNode(name, cache=LruCache(max_entries=256),
+                 cache_config=CacheConfig())
+    for s in sched_segments:
+        n.load_segment(s)
+    return n
+
+
+def _parts_equal(a, b):
+    assert len(a.partials) == len(b.partials)
+    for pa, pb in zip(a.partials, b.partials):
+        assert np.array_equal(pa.counts, pb.counts)
+        assert set(pa.states) == set(pb.states)
+        for k in pa.states:
+            sa, sb = pa.states[k], pb.states[k]
+            if isinstance(sa, dict):
+                for kk in sa:
+                    assert np.array_equal(np.asarray(sa[kk]),
+                                          np.asarray(sb[kk]))
+            else:
+                assert np.array_equal(np.asarray(sa), np.asarray(sb))
+
+
+def test_cached_query_is_fusable(node, sched_segments):
+    """The composition gate: segment-cache-active queries now fuse — a hot
+    datasource's cached queries must not serialize per-query in a flush."""
+    n = _cached_node(sched_segments)
+    q = _groupby("cache-fusable")
+    assert n._segment_cache_active(q)
+    assert n.fusable(q)
+
+
+def test_fused_cache_population_identical_to_serial(sched_segments):
+    """One run_partials_group flush over a cold cache must produce the
+    SAME results and the SAME per-segment cache entries the serial
+    run_partials path produces."""
+    sids = [str(s.id) for s in sched_segments]
+    q = _groupby("cache-pop")
+
+    serial_node = _cached_node(sched_segments, "serial-cache-node")
+    ap_serial, served_serial = serial_node.run_partials(q, sids)
+
+    fused_node = _cached_node(sched_segments, "fused-cache-node")
+    out = fused_node.run_partials_group([(q, sids, None)])
+    assert not isinstance(out[0], BaseException)
+    ap_fused, served_fused = out[0]
+    assert served_fused == served_serial
+    _parts_equal(ap_fused, ap_serial)
+    assert _finish(q, ap_fused) == _finish(q, ap_serial)
+
+    # entry-for-entry cache identity (counts + every kernel state)
+    from druid_tpu.cluster.cache import query_cache_key
+    qkey = query_cache_key(q)
+    for sid in sids:
+        es = serial_node.cache.get("segment", f"{sid}|{qkey}")
+        ef = fused_node.cache.get("segment", f"{sid}|{qkey}")
+        assert es is not None and ef is not None
+        _parts_equal(ef, es)
+
+
+def test_fully_cached_query_resolves_without_any_compute(sched_segments,
+                                                         monkeypatch):
+    """All-hit queries resolve inline during the flush: the fused wave is
+    never entered for them (no device work, no dispatcher serialization)."""
+    sids = [str(s.id) for s in sched_segments]
+    q = _groupby("cache-hot")
+    n = _cached_node(sched_segments)
+    first = n.run_partials_group([(q, sids, None)])[0]
+    assert not isinstance(first, BaseException)
+
+    calls = []
+    real = engines.make_aggregate_partials_multi
+
+    def counting(items, on_batch=None):
+        calls.append(len(items))
+        return real(items, on_batch=on_batch)
+
+    monkeypatch.setattr(engines, "make_aggregate_partials_multi", counting)
+    second = n.run_partials_group([(q, sids, None)])[0]
+    assert calls == [], "an all-hit query must not enter the fused wave"
+    assert not isinstance(second, BaseException)
+    _parts_equal(second[0], first[0])
+    assert second[1] == first[1]
+
+
+def test_partial_hits_fuse_only_the_miss_set(sched_segments, monkeypatch):
+    """A query with some cached segments sends ONLY its misses into the
+    fused wave; results concatenate hits + computed exactly like the
+    serial cached path, and the misses get cached."""
+    from druid_tpu.cluster.cache import query_cache_key
+    sids = [str(s.id) for s in sched_segments]
+    q = _groupby("cache-mix")
+    n = _cached_node(sched_segments)
+    warm = sids[:3]
+    n.run_partials(q, warm)                      # pre-cache 3 segments
+    qkey = query_cache_key(q)
+    assert all(n.cache.get("segment", f"{sid}|{qkey}") for sid in warm)
+
+    submitted = []
+    real = engines.make_aggregate_partials_multi
+
+    def spying(items, on_batch=None):
+        submitted.extend(len(segs) for _, segs, _ in items)
+        return real(items, on_batch=on_batch)
+
+    monkeypatch.setattr(engines, "make_aggregate_partials_multi", spying)
+    mate = _timeseries("cache-mate")
+    out = n.run_partials_group([(q, sids, None), (mate, sids, None)])
+    assert submitted == [len(sids) - 3, len(sids)], \
+        "cached query must submit only its miss set"
+    assert not isinstance(out[0], BaseException)
+    assert not isinstance(out[1], BaseException)
+
+    # every miss is now cached, and the result matches the serial path
+    assert all(n.cache.get("segment", f"{sid}|{qkey}") for sid in sids)
+    serial_node = _cached_node(sched_segments, "mix-serial-node")
+    ap_serial, _ = serial_node.run_partials(q, sids)
+    assert _finish(q, out[0][0]) == _finish(q, ap_serial)
+    assert _finish(mate, out[1][0]) == _finish(
+        mate, serial_node.run_partials(mate, sids)[0])
+
+
+def test_cached_queries_fuse_through_the_scheduler(sched_segments):
+    """End to end through DataNodeScheduler.submit: concurrent cache-active
+    queries ride the flush (hits inline, misses fused) and return exactly
+    the serial results."""
+    sids = [str(s.id) for s in sched_segments]
+    n = _cached_node(sched_segments)
+    plain = _cached_node(sched_segments, "plain-node")
+    queries = [_groupby(f"sc{i}") for i in range(4)]
+    serial = [_finish(q, plain.run_partials(q, sids)[0]) for q in queries]
+
+    sched = DataNodeScheduler(
+        n, SchedulerConfig(batch_window_ms=40.0, lane_depths={})).start()
+    try:
+        for wave in range(2):                    # cold wave, then hot wave
+            results = [None] * len(queries)
+            errors = []
+
+            def client(i):
+                try:
+                    results[i] = sched.submit(queries[i], sids)
+                except Exception as e:           # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(queries))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert errors == []
+            for q, expect, got in zip(queries, serial, results):
+                ap, served = got
+                assert served == {str(s.id) for s in sched_segments}
+                assert _finish(q, ap) == expect
+    finally:
+        sched.stop()

@@ -781,3 +781,123 @@ def test_shard_spec_defaulted_params_tolerated():
         return f(xs, t0s)
     """
     assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+# ---- pallas-accum-dtype: index-map i64 regression (BENCH_r04) -------------
+
+def test_untyped_index_map_constant_flagged():
+    """REGRESSION for the BENCH_r04 on-TPU break: the offending kernel
+    shape — a BlockSpec index_map returning a bare Python int — promotes
+    that constant to i64 under the repo-global x64 flag, and Mosaic fails
+    to legalize the lowered `func.return (i32, i64)`. The rule must flag
+    exactly this shape so the break dies at lint time, not on the chip."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(num_total):
+        BLK, W = plan_window(span)
+        R = BLK // 128
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((R, 128), lambda i: (i, 0))],
+        )
+    """
+    hits = check_source(textwrap.dedent(src), PALLAS, cfg())
+    matches = [f for f in hits if f.rule == "pallas-accum-dtype"]
+    assert matches, "the BENCH_r04 index-map shape must be flagged"
+    assert any("i64" in f.message and "func.return" in f.message
+               for f in matches)
+
+
+def test_typed_index_map_constants_ok():
+    """The fixed shape (constants built typed inside the lambda) passes."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(num_total):
+        BLK, W = plan_window(span)
+        R = BLK // 128
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((R, 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    assert "pallas-accum-dtype" not in rules_hit(src, PALLAS)
+
+
+def test_index_map_i64_check_only_in_pallas_modules():
+    src = """
+    from jax.experimental import pallas as pl
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    """
+    assert "pallas-accum-dtype" not in rules_hit(src, ENGINE)
+
+
+# ---- vmem-budget over the packed-input spec shapes ------------------------
+
+def test_concatenated_and_comprehension_specs_budgeted():
+    """The packed-input kernel builds in_specs as `[dense] * n + [packed
+    for Rw in packed_rws]` — the vmem rule must see BOTH sides: dense
+    multiplicity through len(dense_fields), packed through a synthesized
+    len(packed_rws), and the comprehension variable Rw through
+    SYMBOL_BOUNDS. Within budget here; no multiplicity complaint."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(span, num_total, dense_fields, packed_rws):
+        BLK, W = plan_window(span)
+        R = BLK // 128
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=([pl.BlockSpec((R, 128),
+                                    lambda i: (i, jnp.int32(0)))]
+                      * (1 + len(dense_fields))
+                      + [pl.BlockSpec((Rw, 128),
+                                      lambda i: (i, jnp.int32(0)))
+                         for Rw in packed_rws]),
+        )
+    """
+    hits = check_source(textwrap.dedent(src), PALLAS, cfg())
+    assert not [f for f in hits if f.rule in ("vmem-budget",
+                                              "pallas-tile-shape")], hits
+
+
+def test_comprehension_specs_count_toward_budget():
+    """A comprehension's tiles participate in the worst-case sum: an
+    oversized per-entry tile over a bounded iterable must blow the cap."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(packed_rws):
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((32768 * 64, 128),
+                                   lambda i: (i, jnp.int32(0)))
+                      for Rw in packed_rws],
+        )
+    """
+    assert "vmem-budget" in rules_hit(src, PALLAS)
+
+
+def test_opaque_comprehension_multiplicity_flagged():
+    """Iterating anything but a bare name cannot be bounded — the rule
+    must complain rather than silently under-count."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(things):
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, jnp.int32(0)))
+                      for t in things if t],
+        )
+    """
+    hits = check_source(textwrap.dedent(src), PALLAS, cfg())
+    assert any(f.rule == "vmem-budget" and "multiplicity" in f.message
+               for f in hits)

@@ -211,6 +211,12 @@ class SymEval:
             v = self.contracts.get(node.id)   # bare contract-constant name
             if isinstance(v, int) and not isinstance(v, bool):
                 return Sym.exact(v)
+            # declared symbol bound: names the function env cannot see
+            # (comprehension targets, opaque planning results) resolve
+            # through SYMBOL_BOUNDS exactly like parameters do
+            b = self.bounds.get(node.id)
+            if b:
+                return Sym(b[0], b[1], b[2])
             return None
         if isinstance(node, ast.Attribute):
             # contracts.X / any <alias>.X whose terminal names a contract int
@@ -366,7 +372,11 @@ def _index_map(call: ast.Call) -> Optional[ast.AST]:
 def _spec_entries(node: ast.AST) -> List[Tuple[ast.Call, Optional[ast.AST]]]:
     """Flatten an in_specs/out_specs expression to (BlockSpec call,
     multiplicity expr or None) pairs. Handles `[spec, ...]`,
-    `[spec] * expr`, and a bare spec."""
+    `[spec] * expr`, list concatenation (`A + B`), a comprehension over a
+    named iterable (multiplicity = a synthesized `len(<name>)`, resolved
+    via SYMBOL_BOUNDS), and a bare spec."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _spec_entries(node.left) + _spec_entries(node.right)
     if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
         lst, mult = node.left, node.right
         if not isinstance(lst, (ast.List, ast.Tuple)):
@@ -374,6 +384,28 @@ def _spec_entries(node: ast.AST) -> List[Tuple[ast.Call, Optional[ast.AST]]]:
         if isinstance(lst, (ast.List, ast.Tuple)):
             return [(c, mult) for c, _ in _spec_entries(lst)]
         return []
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        entries = _spec_entries(node.elt)
+        if not entries:
+            return []
+        mult: ast.AST
+        if len(node.generators) == 1 \
+                and isinstance(node.generators[0].iter, ast.Name) \
+                and not node.generators[0].ifs:
+            # multiplicity = len(<iterable>) — SymEval resolves it through
+            # the declared SYMBOL_BOUNDS ("len(packed_rws)" style keys)
+            mult = ast.copy_location(
+                ast.Call(func=ast.Name(id="len", ctx=ast.Load()),
+                         args=[node.generators[0].iter], keywords=[]),
+                node)
+        else:
+            # filtered / nested / opaque iteration: force the vmem rule's
+            # "multiplicity not statically bounded" finding rather than
+            # silently under-counting
+            mult = ast.copy_location(
+                ast.Name(id="__unbounded_spec_multiplicity__",
+                         ctx=ast.Load()), node)
+        return [(c, mult) for c, _ in entries]
     if isinstance(node, (ast.List, ast.Tuple)):
         out = []
         for el in node.elts:
@@ -601,6 +633,31 @@ def check_pallas_accum_dtype(ctx: ModuleContext) -> Iterable[Finding]:
                           f"{getattr(fn, 'name', '<kernel>')}() — Mosaic "
                           f"cannot lower 64-bit element types; widen "
                           f"outside the kernel (lo/hi limbs inside)")
+
+    # BENCH_r04 regression class: a BlockSpec index_map returning a BARE
+    # Python int promotes to i64 under the repo-global x64 flag, and Mosaic
+    # fails to legalize the lowered index map's mixed `func.return
+    # (i32, i64)` — an on-TPU-only compile failure the CPU interpreter
+    # never sees. Constants in index maps must be built typed inside the
+    # lambda (jnp.int32(0)).
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal(node.func) == "BlockSpec"):
+            continue
+        imap = _index_map(node)
+        if not isinstance(imap, ast.Lambda):
+            continue
+        rets = imap.body.elts if isinstance(imap.body, ast.Tuple) \
+            else [imap.body]
+        for r in rets:
+            if isinstance(r, ast.Constant) and isinstance(r.value, int) \
+                    and not isinstance(r.value, bool):
+                yield ctx.finding(
+                    r, f"untyped int constant {r.value} in a BlockSpec "
+                       f"index_map — promotes to i64 under x64 and Mosaic "
+                       f"fails to legalize the (i32, i64) func.return "
+                       f"(the BENCH_r04 on-TPU break); build it typed "
+                       f"inside the lambda: jnp.int32({r.value})")
 
 
 # ---- vmem-budget ----------------------------------------------------------
