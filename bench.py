@@ -3,6 +3,7 @@ batched-vs-per-segment dispatch-amortization comparison.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 "per_segment_rate", "batched_rate", "batch_speedup", "packed_rate",
+"filter_host_rate", "filter_device_rate", "filter_cache_hit_rate",
 "decoded_rate", "pack_ratio", "untraced_rate", "traced_rate",
 "trace_overhead"} — packed_* compare compressed-domain vs decoded staging
 on the cold-miss H2D path; traced_* track qtrace span overhead across
@@ -300,6 +301,78 @@ def _bench_packed(iters: int):
     }
 
 
+def _bench_filter(iters: int):
+    """Selective-filter comparison (filter passes ~5% of 16×4096 rows,
+    groupBy on a different dim): the device-bitmap filter path
+    (engine/filters.py — resident packed words + in-program bit test) vs
+    the LUT/column path, COLD (pool cleared before every timed iter, so
+    each run pays full staging: the device path ships 1 bit/row of filter
+    state instead of a 4-byte/row id column), plus the WARM
+    filter_cache_hit_rate (resident filter results skipping the algebra)."""
+    from druid_tpu.data.devicepool import device_pool
+    from druid_tpu.engine import filters as filters_mod
+    from druid_tpu.engine.executor import QueryExecutor
+    from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+    from druid_tpu.query.filters import InFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_BATCH_SEGMENTS", 16))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_BATCH_ROWS", 4096))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
+    query = GroupByQuery.of(
+        "bench", [headline_interval()], [DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
+        granularity="all",
+        # uniform dimA: k of 100 values ≈ k% selectivity; dimA is
+        # filter-ONLY, so the device path never stages its id column
+        filter=InFilter("dimA", dimA_vals[: max(len(dimA_vals) // 20, 1)]))
+    executor = QueryExecutor(segments)
+    pool = device_pool()
+
+    rates = {}
+    for label, on in (("host", False), ("device", True)):
+        prev = filters_mod.set_device_bitmap_enabled(on)
+        try:
+            t = time.time()
+            executor.run(query)
+            log(f"filter-bench warmup {label}: {time.time() - t:.2f}s")
+            times = []
+            for _ in range(max(iters, 3)):
+                pool.clear()             # cold: full staging every iter
+                t = time.time()
+                executor.run(query)
+                times.append(time.time() - t)
+        finally:
+            filters_mod.set_device_bitmap_enabled(prev)
+        rates[label] = total_rows / min(times)
+        log(f"filter-bench {label}: best {min(times) * 1e3:.1f}ms over "
+            f"{len(times)} cold iters -> {rates[label] / 1e6:.1f}M rows/s")
+
+    # warm: resident filter results — two uncleared device-mode runs, hit
+    # rate over the second run's probes
+    prev = filters_mod.set_device_bitmap_enabled(True)
+    try:
+        executor.run(query)
+        s0 = filters_mod.filter_bitmap_stats().snapshot()
+        executor.run(query)
+        s1 = filters_mod.filter_bitmap_stats().snapshot()
+    finally:
+        filters_mod.set_device_bitmap_enabled(prev)
+    d_hits = s1["hits"] - s0["hits"]
+    probes = d_hits + (s1["misses"] - s0["misses"])
+    hit_rate = d_hits / probes if probes else 0.0
+    log(f"filter-bench warm cache hit rate: {hit_rate:.3f} "
+        f"({d_hits}/{probes} probes)")
+    return {
+        "filter_host_rate": round(rates["host"], 0),
+        "filter_device_rate": round(rates["device"], 0),
+        "filter_speedup": round(rates["device"] / rates["host"], 2),
+        "filter_cache_hit_rate": round(hit_rate, 3),
+    }
+
+
 def _bench_tracing(iters: int):
     """qtrace overhead in one number pair: the batch-comparison query at
     many small segments (the worst case for per-dispatch span overhead —
@@ -563,6 +636,11 @@ def main():
         log(f"packed-bench failed: {type(e).__name__}: {e}")
         packed_cmp = {"packed_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        filt = _bench_filter(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"filter-bench failed: {type(e).__name__}: {e}")
+        filt = {"filter_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         traced = _bench_tracing(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"trace-bench failed: {type(e).__name__}: {e}")
@@ -590,6 +668,7 @@ def main():
     }
     out.update(batch)
     out.update(packed_cmp)
+    out.update(filt)
     out.update(traced)
     out.update(sched)
     out.update(soak)

@@ -358,6 +358,9 @@ class Broker:
         pending: Dict[str, SegmentDescriptor] = {d.id: d for d in segments}
         tried: Dict[str, Set[str]] = {d.id: set() for d in segments}
         seg_errors: Dict[str, BaseException] = {}
+        # 429 sheds per segment: ONE other replica gets a chance to absorb
+        # a shed segment set before the capacity error surfaces
+        capacity_attempts: Dict[str, int] = {}
         gathered = []
         for _ in range(self.max_retries + 1):
             if not pending:
@@ -414,14 +417,22 @@ class Broker:
                         return server, sids, ap, served
                     except (QueryInterruptedError, QueryTimeoutError):
                         raise  # cancel/deadline: abort the whole scatter
-                    except QueryCapacityError:
-                        # the node shed the query and the client's one
-                        # Retry-After retry was shed again: the cluster is
-                        # saturated — fail fast with the clear capacity
-                        # error (429 at the resource layer) instead of
-                        # hammering other replicas with work the tier
-                        # cannot absorb
-                        raise
+                    except QueryCapacityError as e:
+                        # the node shed the query (and the client's one
+                        # Retry-After retry was shed again): ONE other
+                        # replica of the segment set gets a lane-aware try
+                        # — the query context (lane, priority) is resent
+                        # unchanged and each round carries only the
+                        # REMAINING timeout budget. A second shed, or no
+                        # untried replica, surfaces the capacity error:
+                        # one saturated node is not a saturated tier, but
+                        # two are — don't hammer the rest
+                        self.view.note_capacity_shed(server)
+                        for sid in sids:
+                            seg_errors[sid] = e
+                            capacity_attempts[sid] = \
+                                capacity_attempts.get(sid, 0) + 1
+                        return server, sids, None, set()
                     except ConnectionError:
                         # unreachable server: plain failover; exhausting
                         # replicas is a MissingSegmentsError
@@ -450,6 +461,11 @@ class Broker:
                     gathered.append(result)
                 for sid in served:
                     pending.pop(sid, None)
+            for sid, shed in capacity_attempts.items():
+                if sid in pending and shed > 1:
+                    # the one-other-replica retry was shed too: the tier
+                    # is saturated — surface the 429 now
+                    raise seg_errors[sid]
         if pending:
             errs = [seg_errors[sid] for sid in pending if sid in seg_errors]
             if errs:

@@ -288,9 +288,10 @@ class DataNodeServer:
         self.emitter = emitter
         from druid_tpu.data.devicepool import DevicePoolMonitor
         from druid_tpu.engine.batching import BatchMetricsMonitor
+        from druid_tpu.engine.filters import FilterBitmapMonitor
         from druid_tpu.utils.emitter import MonitorScheduler
         monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
-                    self._query_counts]
+                    FilterBitmapMonitor(), self._query_counts]
         if self._scheduler_config is not None:
             self.scheduler = DataNodeScheduler(
                 node, self._scheduler_config, emitter=emitter)

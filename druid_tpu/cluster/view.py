@@ -483,9 +483,24 @@ class InventoryView:
         self._replicas: Dict[str, ReplicaSet] = {}   # segment id → replicas
         self._probe_failures: Dict[str, int] = {}    # consecutive ping fails
         self._connections: Dict[str, int] = {}       # in-flight per server
+        self._capacity_sheds: Dict[str, int] = {}    # cumulative 429s seen
         self._announce_seq = 0                       # monotonic, under lock
         self._lock = threading.RLock()
         self._listeners: List[Callable[[str, str, str], None]] = []
+
+    # ---- capacity-shed accounting (broker lane-aware retry) ------------
+    def note_capacity_shed(self, server: str) -> None:
+        """A data node answered 429 for a query wave. The broker records it
+        here before retrying the segment set on ONE other replica, so
+        operators can see per-server shed pressure alongside connection
+        counts."""
+        with self._lock:
+            self._capacity_sheds[server] = \
+                self._capacity_sheds.get(server, 0) + 1
+
+    def capacity_sheds(self, server: str) -> int:
+        with self._lock:
+            return self._capacity_sheds.get(server, 0)
 
     # ---- in-flight accounting (ConnectionCount strategy input) ---------
     def connection_started(self, server: str) -> None:

@@ -67,13 +67,19 @@ class Bitmap:
         return self
 
     # ---- algebra ------------------------------------------------------
-    def __and__(self, other: "Bitmap") -> "Bitmap":
+    def __and__(self, other) -> "AnyBitmap":
+        if isinstance(other, SparseBitmap):
+            return bitmap_and(other, self)
         return Bitmap(self.words & other.words, self.n_rows)
 
-    def __or__(self, other: "Bitmap") -> "Bitmap":
+    def __or__(self, other) -> "AnyBitmap":
+        if isinstance(other, SparseBitmap):
+            return bitmap_or(other, self)
         return Bitmap(self.words | other.words, self.n_rows)
 
-    def __xor__(self, other: "Bitmap") -> "Bitmap":
+    def __xor__(self, other) -> "AnyBitmap":
+        if isinstance(other, SparseBitmap):
+            return bitmap_xor(other, self)
         return Bitmap(self.words ^ other.words, self.n_rows)
 
     def __invert__(self) -> "Bitmap":
@@ -104,6 +110,12 @@ class Bitmap:
     def to_indices(self) -> np.ndarray:
         return np.flatnonzero(self.to_bool())
 
+    def test_ids(self, ids: np.ndarray) -> np.ndarray:
+        """Membership of each row id — a word probe per id, no unpack
+        (np.packbits stores row r at bit 7 - r%8 of byte r//8)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        return ((self.words[ids >> 3] >> (7 - (ids & 7))) & 1).astype(bool)
+
     def cardinality(self) -> int:
         return int(np.unpackbits(self.words, count=self.n_rows).sum())
 
@@ -121,8 +133,11 @@ class Bitmap:
 class SparseBitmap:
     """Row-id-list bitmap for low-density values: memory scales with the
     matching rows, not the segment rows (the capability ImmutableConciseSet
-    :79 / RoaringBitmap provide in the reference). Duck-types Bitmap —
-    algebra and `.words` densify transiently on demand."""
+    :79 / RoaringBitmap provide in the reference). Duck-types Bitmap.
+    Algebra against another sparse operand stays sparse (sorted-id set
+    ops); against a dense operand it probes the dense words at its own ids
+    — the operand that is sparse is NEVER densified. Only complement
+    (`~`), whose result is inherently dense, materializes words."""
 
     __slots__ = ("ids", "n_rows")
 
@@ -152,15 +167,18 @@ class SparseBitmap:
         return int(self.ids.nbytes)
 
     def __and__(self, other):
-        return self._dense() & other
+        return bitmap_and(self, other)
 
     def __or__(self, other):
-        return self._dense() | other
+        return bitmap_or(self, other)
 
     def __xor__(self, other):
-        return self._dense() ^ other
+        return bitmap_xor(self, other)
 
     def __invert__(self):
+        # the complement of a sparse set is dense by definition — this is
+        # the one NECESSARY densification (callers wanting only the
+        # cardinality use n_rows - cardinality(), no materialization)
         return ~self._dense()
 
     def __eq__(self, other):
@@ -178,6 +196,109 @@ AnyBitmap = Union[Bitmap, SparseBitmap]
 SPARSE_DENSITY_DIVISOR = 32
 #: default budget for LRU-cached materialized per-value bitmaps per index
 BITMAP_CACHE_BUDGET = 16 << 20
+
+
+# ---------------------------------------------------------------------------
+# Representation-aware algebra (the Roaring container-combine capability):
+# sparse×sparse stays sparse via sorted-id set ops, sparse×dense probes the
+# dense words at the sparse ids — a SparseBitmap operand is never densified.
+# ---------------------------------------------------------------------------
+
+def bitmap_and(a: AnyBitmap, b: AnyBitmap) -> AnyBitmap:
+    if isinstance(a, SparseBitmap) and isinstance(b, SparseBitmap):
+        return SparseBitmap(np.intersect1d(a.ids, b.ids, assume_unique=True),
+                            a.n_rows)
+    if isinstance(b, SparseBitmap):
+        a, b = b, a
+    if isinstance(a, SparseBitmap):
+        return SparseBitmap(a.ids[b.test_ids(a.ids)], a.n_rows)
+    return a & b
+
+
+def bitmap_or(a: AnyBitmap, b: AnyBitmap) -> AnyBitmap:
+    if isinstance(a, SparseBitmap) and isinstance(b, SparseBitmap):
+        return SparseBitmap(np.union1d(a.ids, b.ids), a.n_rows)
+    if isinstance(b, SparseBitmap):
+        a, b = b, a
+    if isinstance(a, SparseBitmap):
+        # the union is at least as dense as the dense operand: fold the
+        # sparse ids into a copy of its words (per-id bit set, no unpack)
+        words = b.words.copy()
+        ids = a.ids.astype(np.int64)
+        np.bitwise_or.at(words, ids >> 3,
+                         (1 << (7 - (ids & 7))).astype(np.uint8))
+        return Bitmap(words, a.n_rows)
+    return a | b
+
+
+def bitmap_xor(a: AnyBitmap, b: AnyBitmap) -> AnyBitmap:
+    if isinstance(a, SparseBitmap) and isinstance(b, SparseBitmap):
+        return SparseBitmap(np.setxor1d(a.ids, b.ids), a.n_rows)
+    if isinstance(b, SparseBitmap):
+        a, b = b, a
+    if isinstance(a, SparseBitmap):
+        words = b.words.copy()
+        ids = a.ids.astype(np.int64)
+        np.bitwise_xor.at(words, ids >> 3,
+                          (1 << (7 - (ids & 7))).astype(np.uint8))
+        return Bitmap(words, a.n_rows)._trim()
+    return a ^ b
+
+
+def sparse_if_small(bm: AnyBitmap) -> AnyBitmap:
+    """Demote a dense result to the id-list representation when that is
+    the smaller container (the Roaring array/bitmap container cutover)."""
+    if isinstance(bm, SparseBitmap):
+        return bm
+    if bm.cardinality() < bm.n_rows // SPARSE_DENSITY_DIVISOR:
+        return SparseBitmap(bm.to_indices().astype(np.int32), bm.n_rows)
+    return bm
+
+
+# ---------------------------------------------------------------------------
+# Device representation: packed uint32 words (LSB-first — row r lives at bit
+# r % 32 of word r // 32) for the device-side bitmap algebra
+# (engine/filters.py). Density-adaptive shipping: a sparse bitmap ships its
+# sorted id list (scattered into words ON DEVICE), a dense one ships the
+# packed words directly — the host-decided Roaring container split.
+# ---------------------------------------------------------------------------
+
+#: bits per device bitmap word; checked against the engine contract on
+#: first use (lazy — importing engine.contracts here at module time would
+#: cycle through the engine package, the data/packed.py discipline)
+WORD_BITS = 32
+
+
+def _word_bits() -> int:
+    from druid_tpu.engine.contracts import FILTER_WORD_BITS
+    assert FILTER_WORD_BITS == WORD_BITS, \
+        "data/bitmap.WORD_BITS must match contracts.FILTER_WORD_BITS"
+    return WORD_BITS
+
+
+def to_words32(bm: AnyBitmap, padded_rows: int) -> np.ndarray:
+    """Packed uint32 row words over [0, padded_rows); rows past n_rows are
+    0. padded_rows must be a multiple of 32 (any device row alignment is)."""
+    assert padded_rows % _word_bits() == 0 and padded_rows >= bm.n_rows
+    mask = np.zeros(padded_rows, dtype=bool)
+    mask[: bm.n_rows] = bm.to_bool()
+    return np.packbits(mask, bitorder="little").view(np.uint32)
+
+
+def device_repr(bm: AnyBitmap, padded_rows: int):
+    """("sparse", int32 ids padded to a pow2 rung with `padded_rows` as the
+    out-of-range sentinel) when the id list is the smaller transfer, else
+    ("dense", uint32 words). The rung quantization bounds distinct device
+    shapes (compile keys) exactly like the batching row ladder."""
+    m = bm.cardinality()
+    rung = 8
+    while rung < m:
+        rung <<= 1
+    if rung * 4 < padded_rows // 8:
+        ids = np.full(rung, padded_rows, dtype=np.int32)
+        ids[:m] = np.sort(bm.to_indices())[:m]
+        return "sparse", ids
+    return "dense", to_words32(bm, padded_rows)
 
 
 class BitmapIndex:
@@ -252,19 +373,26 @@ class BitmapIndex:
             self._cache_put(value_id, b)
             return b
 
-    def union_of(self, value_ids: np.ndarray) -> Bitmap:
+    def union_of(self, value_ids: np.ndarray) -> AnyBitmap:
         """Union over many values straight from the sorted row order — no
         per-value bitmaps are materialized (an OR / IN / regex over
-        thousands of values touches each row id exactly once)."""
+        thousands of values touches each row id exactly once). A
+        low-density result stays a SparseBitmap (id list), so downstream
+        algebra and selectivity estimation never pay words for it."""
+        import functools
         valid = [int(v) for v in value_ids if 0 <= v < self.cardinality]
         if not valid:
-            return Bitmap.empty(self.n_rows)
+            return SparseBitmap(np.zeros(0, dtype=np.int32), self.n_rows)
         if self._ids is None:       # subclass without a backing id column
-            return Bitmap.union([self.bitmap(v) for v in valid], self.n_rows)
+            return sparse_if_small(functools.reduce(
+                bitmap_or, [self.bitmap(v) for v in valid]))
         with self._lock:
             order, bounds = self._sorted()
             parts = [order[bounds[v]:bounds[v + 1]] for v in valid]
-        return Bitmap.from_indices(np.concatenate(parts), self.n_rows)
+        ids = np.concatenate(parts)
+        if ids.size < self.n_rows // SPARSE_DENSITY_DIVISOR:
+            return SparseBitmap(np.sort(ids).astype(np.int32), self.n_rows)
+        return Bitmap.from_indices(ids, self.n_rows)
 
     def size_bytes(self) -> int:
         n = 0 if self._order is None else int(self._order.nbytes)

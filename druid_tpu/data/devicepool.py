@@ -202,6 +202,14 @@ class DeviceSegmentPool:
             return self._purge_locked(owner)
 
     # ---- cache surface --------------------------------------------------
+    def peek(self, owner: int, key: Tuple) -> bool:
+        """Residency probe WITHOUT touching LRU order or hit/miss stats —
+        callers keeping their own cache metrics (the filter-bitmap cache's
+        query/filter/* counters) ask this before get_or_build so the pool's
+        segment/devicePool/* accounting is not double-counted."""
+        with self._lock:
+            return ((owner,) + tuple(key)) in self._entries
+
     def get_or_build(self, owner: int, key: Tuple, build: Callable[[], object]):
         """LRU get; on miss, `build()` runs OUTSIDE the lock (staging does
         device_put) — a concurrent duplicate build wastes work but cannot

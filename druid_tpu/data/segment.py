@@ -350,6 +350,11 @@ class Segment:
         shape)."""
         return self._pool.get_or_build(self._pool_owner, ("aux",) + key, fn)
 
+    def device_contains(self, key: Tuple) -> bool:
+        """Residency probe for a device_cached entry (no stats/LRU touch) —
+        the filter-bitmap cache's own hit/miss accounting."""
+        return self._pool.peek(self._pool_owner, ("aux",) + key)
+
     def column_minmax(self, name: str) -> Tuple[int, int]:
         """Cached (min, max) of a numeric column (0, 0 when empty)."""
         def _compute():

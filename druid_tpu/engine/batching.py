@@ -42,6 +42,7 @@ import numpy as np
 
 from druid_tpu.data import packed
 from druid_tpu.data.segment import DEFAULT_ROW_ALIGN, Segment
+from druid_tpu.engine import filters as filters_mod
 from druid_tpu.engine import grouping
 from druid_tpu.engine.contracts import (BATCH_MAX_SEGMENT_ROWS,
                                         BATCH_MAX_SEGMENTS,
@@ -274,10 +275,13 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
                  intervals=tuple(intervals), granularity=granularity)
     if segment.n_rows > BATCH_MAX_SEGMENT_ROWS:
         return plan
-    if any(d.host_ids is not None for d in kds):
-        # numeric/expression dims derive per-segment host id columns with
-        # per-segment padded device copies — stageable, but their query-time
-        # dictionaries make plan constants segment-local; keep per-segment
+    if any(d.host_ids is not None and d.ids_key is None for d in kds):
+        # a derived id column with no stable cache identity cannot stage
+        # through the pool — keep per-segment. Numeric/expression dims DO
+        # carry ids_key, and their query-time dictionaries unify across
+        # the query's segments (engines.unify_query_dims), so their plan
+        # constants (cardinality, remap) are no longer segment-local —
+        # the host-mask-era exclusion is gone.
         return plan
     spec, filter_node, kernels = gplan.spec, gplan.filter_node, gplan.kernels
     if spec.key_mode != "dense" or spec.bucket_mode not in ("all", "uniform"):
@@ -295,16 +299,23 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
         # constant-false: the per-segment path skips the device entirely —
         # batching it would only waste a stacked slot
         return plan
-    needed, columns = needed_columns(segment, kds, aggs, flt, virtual_columns)
-    for c in columns:
-        m = segment.metrics.get(c)
-        if m is not None and np.asarray(m.values).ndim != 1:
-            return plan              # complex (2-D) metrics: per-segment
+    needed, columns = needed_columns(segment, kds, aggs, flt, virtual_columns,
+                                     filter_node=filter_node)
+    # complex (2-D) metric columns — HLL registers, sketch states — stack
+    # like any other column now that the mask is in-program; their width is
+    # a compile-shape dimension, so it joins the digest below
+    col_shapes = tuple(sorted(
+        (c, np.asarray(segment.metrics[c].values).shape[1:])
+        for c in columns if c in segment.metrics
+        and np.asarray(segment.metrics[c].values).ndim > 1))
     col_dtypes: Dict[str, np.dtype] = {
         "__time_offset": np.dtype(np.int32), "__valid": np.dtype(bool)}
     for c in columns:
         col_dtypes[c] = np.dtype(np.int32) if c in segment.dims \
             else np.dtype(segment.staged_dtype(c))
+    for d in kds:
+        if d.host_ids is not None:
+            col_dtypes[d.column] = np.dtype(np.int32)
     plan.eligible = True
     plan.f_aux = filter_node.aux_arrays() if filter_node else []
     plan.k_aux = [a for k in kernels for a in k.aux_arrays()]
@@ -325,7 +336,7 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     # this changes nothing). Interval VALUES stay out — relative bounds
     # are per-segment mapped args (iv_rel), only their COUNT is shape
     # (already in the structure sig).
-    plan.digest = (sig, plan.rung, columns,
+    plan.digest = (sig, plan.rung, columns, col_shapes,
                    tuple(sorted((c, str(d)) for c, d in col_dtypes.items())),
                    str(granularity), spec.num_buckets)
     return plan
@@ -435,6 +446,23 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
               for p in chunk]
     assert all(b.padded_rows == R for b in blocks), \
         "ladder rung must equal the staged row count"
+    # per-segment derived inputs ride the mapped arrays, not aux: query-time
+    # dictionary id columns (unified id spaces — engines.unify_query_dims)
+    # and resident filter-bitmap words (engine/filters.py device-bitmap
+    # path; each plan stages ITS OWN words, so chunk-mates from different
+    # queries may carry entirely different bitmap filters under one shared
+    # program structure)
+    bmp_per_slot = filters_mod.stage_device_bitmaps_multi(
+        [(p.segment, p.filter_node) for p in chunk], R)
+    arrs_per_slot = []
+    for p, b, bmp in zip(chunk, blocks, bmp_per_slot):
+        arrs = dict(b.arrays)
+        for d in p.kds:
+            if d.host_ids is not None:
+                arrs[d.column] = grouping._pad_device_cached(
+                    p.segment, d.ids_key, d.host_ids, R, 0)
+        arrs.update(bmp)
+        arrs_per_slot.append(arrs)
 
     clip_lo, clip_hi = -(2**31) + 1, 2**31 - 1
     iv_rel = np.zeros((K, max(len(ref.intervals), 1), 2), dtype=np.int32)
@@ -473,7 +501,7 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
                     compile=compiled), \
             trace_span_when(compiled, "engine/compile", kind="batched",
                             strategy=strategy):
-        outs = fn(tuple(b.arrays for b in blocks), time0s, iv_rel,
+        outs = fn(tuple(arrs_per_slot), time0s, iv_rel,
                   bucket_off, aux)
 
     out: List[SegmentPartial] = []

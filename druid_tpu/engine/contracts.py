@@ -94,6 +94,22 @@ PACK_WORD_BITS = 32
 #: (the same design rule as SumKernel.chunk_rows pow2 quantization).
 PACK_WIDTHS = (4, 8, 16)
 
+# ---- device filter bitmaps (engine/filters.py device-bitmap algebra) ------
+
+#: bits per device filter-bitmap word (uint32, LSB-first: row r is bit
+#: r % 32 of word r // 32 — data/bitmap.py to_words32). Every padded row
+#: count is a multiple of BATCH_ROW_ALIGN = 1024, so word arrays always
+#: reshape cleanly into (rows/32,) and the in-program bit-test expansion
+#: is a pure broadcast shift, no gather.
+FILTER_WORD_BITS = 32
+
+#: worst-case bitmap-word rows per pallas-class block: a BLK_SMALL_W-row
+#: block covers BLK_SMALL_W / FILTER_WORD_BITS = 64 word rows. The word
+#: expansion runs in XLA before any pallas call today; this bound exists so
+#: the vmem-budget rule can size a bitmap-word tile if one is ever declared
+#: (tests/test_tracecheck.py pins the worst case).
+FILTER_WORDS_PER_BLOCK = BLK_SMALL_W // FILTER_WORD_BITS
+
 # ---- device segment pool --------------------------------------------------
 
 #: default HBM byte budget for the process-wide device segment pool
@@ -164,4 +180,8 @@ SYMBOL_BOUNDS = {
     "Rw": (1, 8, 1),
     "len(dense_fields)": (0, MAX_PALLAS_FIELDS, 1),
     "len(packed_rws)": (0, MAX_PALLAS_FIELDS, 1),
+    # device filter-bitmap words (engine/filters.py): word rows per block,
+    # bounded by FILTER_WORDS_PER_BLOCK — covers the bitmap words' worst-
+    # case tile should a kernel ever stream them in.
+    "Rw32": (1, FILTER_WORDS_PER_BLOCK, 1),
 }

@@ -365,6 +365,56 @@ def split_partials_by_segment(ap: AggregatePartials,
     return _split_by_segment(ap, segs, segments)
 
 
+def unify_query_dims(segs: Sequence[Segment], kds_per_seg,
+                     vals_per_seg) -> None:
+    """Unify per-segment QUERY-TIME dictionaries (numeric/expression
+    dimension handlers: KeyDim.host_ids) into ONE id space across the
+    query's segments, in place. Each segment's local ids remap host-side
+    into the sorted union of every segment's values (cached per (segment,
+    union digest)), so plan constants — cardinality, decode list — stop
+    being segment-local and shape-compatible segments batch
+    (engine/batching.py; the host-mask era excluded these). Results are
+    unchanged: ids decode to exactly the same values, the space is merely
+    shared."""
+    import hashlib
+    if len(segs) < 2 or not kds_per_seg or not kds_per_seg[0]:
+        return
+    for j in range(len(kds_per_seg[0])):
+        col = [kds[j] for kds in kds_per_seg]
+        if not all(kd.host_ids is not None and kd.remap is None
+                   and kd.ids_key is not None for kd in col):
+            continue
+        lists = [vals[j] for vals in vals_per_seg]
+        if all(l == lists[0] for l in lists[1:]):
+            continue                  # already one id space
+        try:
+            union = sorted(set().union(*map(set, lists)))
+        except TypeError:
+            continue                  # unorderable mixed types: per-segment
+        udig = hashlib.sha1(repr(union).encode()).hexdigest()[:16]
+        index = {v: i for i, v in enumerate(union)}
+        for s, kds, vals in zip(segs, kds_per_seg, vals_per_seg):
+            kd = kds[j]
+            # ONE resident remapped id column per (segment, dim), replaced
+            # when the union digest changes: a rolling segment set would
+            # otherwise grow a fresh n_rows×4B aux entry per distinct
+            # window this segment ever appeared in (the aux cache has no
+            # eviction). Repeated dashboards over a stable set still hit.
+            slot = s.aux_cached(("unidim",) + tuple(kd.ids_key), dict)
+            new_ids = slot.get(udig)
+            if new_ids is None:
+                remap = np.asarray([index[v] for v in vals[j]],
+                                   dtype=np.int32)
+                new_ids = remap[kd.host_ids]
+                slot.clear()
+                slot[udig] = new_ids
+            kds[j] = KeyDim(kd.column, max(len(union), 1), None,
+                            host_ids=new_ids,
+                            ids_key=("unidim",) + tuple(kd.ids_key)
+                            + (udig,))
+            vals[j] = list(union)
+
+
 def _keydims_for_query(query, segs: Sequence[Segment]):
     """Per-segment KeyDims + decode value lists for an aggregate query —
     the one derivation every partial-producing path (single-query, multi-
@@ -373,8 +423,10 @@ def _keydims_for_query(query, segs: Sequence[Segment]):
         return [[] for _ in segs], [[] for _ in segs]
     if isinstance(query, TopNQuery):
         keydims = [_keydim_for(s, query.dimension) for s in segs]
-        return [[kd] for kd, _ in keydims], \
-            [[values] for _, values in keydims]
+        kds_per_seg = [[kd] for kd, _ in keydims]
+        vals_per_seg = [[values] for _, values in keydims]
+        unify_query_dims(segs, kds_per_seg, vals_per_seg)
+        return kds_per_seg, vals_per_seg
     if isinstance(query, GroupByQuery):
         kds_per_seg, vals_per_seg = [], []
         for s in segs:
@@ -385,6 +437,7 @@ def _keydims_for_query(query, segs: Sequence[Segment]):
                 vals.append(v)
             kds_per_seg.append(kds)
             vals_per_seg.append(vals)
+        unify_query_dims(segs, kds_per_seg, vals_per_seg)
         return kds_per_seg, vals_per_seg
     raise TypeError(f"not an aggregate query: {type(query).__name__}")
 

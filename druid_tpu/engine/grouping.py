@@ -50,6 +50,7 @@ import numpy as np
 
 from druid_tpu.data import packed as packed_mod
 from druid_tpu.data.segment import DeviceBlock, Segment
+from druid_tpu.engine import filters as filters_mod
 from druid_tpu.engine.filters import (ConstNode, FilterNode, plan_filter,
                                       simplify_node)
 from druid_tpu.obs.trace import span as trace_span
@@ -967,19 +968,29 @@ def keydims_equal(a: Sequence[KeyDim], b: Sequence[KeyDim]) -> bool:
     return True
 
 
+_NO_NODE = object()   # "caller did not plan the filter" sentinel
+
+
 def needed_columns(segment: Segment, kds: Sequence[KeyDim],
                    aggs: Sequence[AggregatorSpec], flt,
-                   virtual_columns: Sequence):
+                   virtual_columns: Sequence, filter_node=_NO_NODE):
     """Returns (all referenced real-column names, the subset present in
-    `segment` — i.e. the columns to stage)."""
+    `segment` — i.e. the columns to stage). When the PLANNED `filter_node`
+    is passed (None counts: the filter simplified away), filter needs come
+    from its required_device_columns() — subtrees compiled to device
+    bitmaps (filters.DeviceBitmapNode) consume no staged columns, so
+    filter-only dimensions stop staging."""
     from druid_tpu.utils.expression import parse_expression
     vc_names = {v.name for v in virtual_columns}
     needed = set()
     for d in kds:
         if d.column is not None:
             needed.add(d.column)
-    if flt is not None:
-        needed |= flt.required_columns()
+    if filter_node is _NO_NODE:
+        if flt is not None:
+            needed |= flt.required_columns()
+    elif filter_node is not None:
+        needed |= filter_node.required_device_columns()
     for a in aggs:
         needed |= a.required_columns()
     for v in virtual_columns:
@@ -1054,8 +1065,10 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
 
     vc_names = {v.name for v in virtual_columns}
     base_needed = set(extra_columns)
-    if flt is not None:
-        base_needed |= flt.required_columns()
+    if filter_node is not None:
+        # the PLANNED tree's column needs, not the raw filter's: subtrees
+        # compiled to device bitmaps read resident words, not columns
+        base_needed |= filter_node.required_device_columns()
     for a in aggs:
         base_needed |= a.required_columns()
     for v in virtual_columns:
@@ -1111,6 +1124,26 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                           for d in spec.dims))
         spec.host_keys_cache = perm_key
         needed = base_needed  # key prefused: dim columns stay host-side
+        if filters_mod.collect_bitmap_nodes(filter_node):
+            # the projection stages a PERMUTED row layout; resident bitmap
+            # words are in original row order, so the bit test would
+            # misalign — re-plan the filter on the column path (LUT
+            # gathers permute with the staged columns). Projection-grade
+            # segments are scatter-bound anyway; the bitmap win is noise
+            # there.
+            filter_node = simplify_node(plan_filter(
+                flt, segment, virtual_columns, device_bitmap=False))
+            if isinstance(filter_node, ConstNode) and not filter_node.value:
+                return SegmentPartial(
+                    segment=segment, spec=spec,
+                    counts=np.zeros(spec.num_total, dtype=np.int64),
+                    states={k.name: k.empty_state(spec.num_total)
+                            for k in kernels},
+                    kernels=kernels)
+            if filter_node is not None:
+                needed = base_needed | {
+                    c for c in filter_node.required_device_columns()
+                    if c in segment.dims or c in segment.metrics}
 
     # pack descriptor of the staged column set: must be derived IDENTICALLY
     # to device_block's own planning (pure fn of column stats), and joins
@@ -1135,6 +1168,10 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
         arrays["__bucket"] = _pad_device_cached(
             segment, spec.host_bucket_cache, spec.host_bucket_ids,
             block.padded_rows, -1)
+    # resident filter-bitmap words (engine/filters.py device-bitmap path):
+    # cached per (segment, filter structure, aux digest) in the same pool
+    arrays.update(filters_mod.stage_device_bitmaps(segment, filter_node,
+                                                   block.padded_rows))
 
     aux = _assemble_aux(spec, segment, intervals, filter_node, kernels,
                         vc_plans, vc_luts)

@@ -831,7 +831,10 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
                                tf if tf in segment.metrics else None)
     if isinstance(spec, A.FilteredAggregator):
         child = make_kernel(spec.delegate, segment)
-        node = plan_filter(spec.filter, segment)
+        # column-path planning (device_bitmap=False): a filtered agg's
+        # filter aux rides the kernel aux stream, which batching compares
+        # by value — resident bitmap words have no aux representation
+        node = plan_filter(spec.filter, segment, device_bitmap=False)
         return FilteredKernel(spec, child, node)
     if isinstance(spec, A.HyperUniqueAggregator):
         return HllKernel(spec, (spec.field,), segment, spec.log2m, by_row=False)

@@ -129,6 +129,23 @@ METRICS = {
         "site": "server/scheduler.py (SchedulerMetricsMonitor)",
         "help": "per-dispatch events lost to the bounded event queue "
                 "(the crossBatch series undercounts by this many)"},
+    # ---- device filter-bitmap cache (engine/filters.py) ----------------
+    "query/filter/deviceBitmapHits": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/filters.py (FilterBitmapMonitor)",
+        "help": "filter-result device bitmaps served from resident pool "
+                "words since the last tick (no leaf staging, no algebra "
+                "dispatch)"},
+    "query/filter/deviceBitmapMisses": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/filters.py (FilterBitmapMonitor)",
+        "help": "filter-result device bitmaps built cold since the last "
+                "tick"},
+    "query/filter/bytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "engine/filters.py (FilterBitmapMonitor)",
+        "help": "device filter-bitmap bytes materialized on cold misses "
+                "since the last tick (1 bit per padded row per filter)"},
     # ---- batched execution (engine/batching.py) ------------------------
     "query/batch/segments": {
         "unit": "count", "dims": (),

@@ -113,14 +113,16 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
 
     # plan filter + kernels + virtual columns per segment; constants must
     # agree across segments
-    filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns))
+    filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns,
+                                            device_bitmap=False))
     kernels = [make_kernel(a, segments[0]) for a in aggs]
     vc_plans, vc_luts = plan_virtual_columns(segments[0], virtual_columns)
     f_sig = filter_node.signature() if filter_node else "none"
     f_aux = filter_node.aux_arrays() if filter_node else []
     k_aux = [a for k in kernels for a in k.aux_arrays()]
     for s in segments[1:]:
-        fn_s = simplify_node(plan_filter(flt, s, virtual_columns))
+        fn_s = simplify_node(plan_filter(flt, s, virtual_columns,
+                                         device_bitmap=False))
         if (fn_s.signature() if fn_s else "none") != f_sig:
             return None
         if not _aux_equal(fn_s.aux_arrays() if fn_s else [], f_aux):

@@ -217,7 +217,7 @@ class HyperUniqueAggregator(AggregatorSpec):
 
     def to_json(self):
         return {"type": "hyperUnique", "name": self.name, "fieldName": self.field,
-                "round": self.round}
+                "log2m": self.log2m, "round": self.round}
 
 
 @dataclass(frozen=True)
@@ -244,7 +244,7 @@ class CardinalityAggregator(AggregatorSpec):
     def to_json(self):
         return {"type": "cardinality", "name": self.name,
                 "fields": list(self.fields), "byRow": self.by_row,
-                "round": self.round}
+                "log2m": self.log2m, "round": self.round}
 
 
 _SIMPLE = {
@@ -259,10 +259,11 @@ _SIMPLE = {
     "floatMin": lambda j: FloatMinAggregator(j["name"], j["fieldName"]),
     "floatMax": lambda j: FloatMaxAggregator(j["name"], j["fieldName"]),
     "hyperUnique": lambda j: HyperUniqueAggregator(
-        j["name"], j["fieldName"], round=j.get("round", False)),
+        j["name"], j["fieldName"], log2m=j.get("log2m", 11),
+        round=j.get("round", False)),
     "cardinality": lambda j: CardinalityAggregator(
         j["name"], tuple(j["fields"]), j.get("byRow", False),
-        round=j.get("round", False)),
+        log2m=j.get("log2m", 11), round=j.get("round", False)),
 }
 
 

@@ -423,6 +423,77 @@ def test_broker_reports_node_error_when_replicas_exhausted(segments):
         broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
 
 
+class _SheddingNode(DataNode):
+    """Answers every partials request with a 429-style capacity shed (the
+    admission-control path, stubbed — reachable, saturated)."""
+
+    def __init__(self, name, sheds=10**9):
+        super().__init__(name)
+        self.sheds = sheds
+        self.shed_calls = 0
+
+    def run_partials(self, query, segment_ids, check=None):
+        from druid_tpu.server.querymanager import QueryCapacityError
+        if self.sheds > 0:
+            self.sheds -= 1
+            self.shed_calls += 1
+            raise QueryCapacityError("stub shed", retry_after_s=0.01)
+        return super().run_partials(query, segment_ids, check)
+
+
+def test_broker_lane_aware_retry_on_429(segments):
+    """A data-node 429 fails over ONCE to another replica of the segment
+    set (same lane/context, remaining budget) before surfacing — a
+    saturated node is not a saturated tier."""
+    view = InventoryView()
+    shedding = _SheddingNode("shedding")
+    good = DataNode("good")
+    for n in (shedding, good):
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(n.name, descriptor_for(s))
+    broker = Broker(view, seed=3)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS,
+                           context={"lane": "interactive"})
+    # run until the random replica pick hits the shedding node at least
+    # once — every run must still produce the exact serial result
+    hit_shed = False
+    for _ in range(6):
+        assert broker.run(q) == _local(segments, q)
+        hit_shed = hit_shed or shedding.shed_calls > 0
+        shedding.sheds = 10**9
+    assert hit_shed
+    assert view.capacity_sheds("shedding") > 0
+
+
+def test_broker_surfaces_429_when_other_replica_sheds_too(segments):
+    from druid_tpu.server.querymanager import QueryCapacityError
+    view = InventoryView()
+    for name in ("shed1", "shed2"):
+        n = _SheddingNode(name)
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(name, descriptor_for(s))
+    broker = Broker(view)
+    with pytest.raises(QueryCapacityError):
+        broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+
+
+def test_broker_surfaces_429_with_no_other_replica(segments):
+    from druid_tpu.server.querymanager import QueryCapacityError
+    view = InventoryView()
+    n = _SheddingNode("only")
+    view.register(n)
+    for s in segments:
+        n.load_segment(s)
+        view.announce("only", descriptor_for(s))
+    broker = Broker(view)
+    with pytest.raises(QueryCapacityError):
+        broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+
+
 def test_liveness_failure_triggers_rereplication(coordinated, segments):
     """Kill one of two replicas: the coordinator's liveness probe removes
     the dead server and the SAME cycle restores replication on a live node

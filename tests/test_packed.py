@@ -422,6 +422,13 @@ def test_pool_holds_3x_more_segments_at_fixed_budget(fresh_pool):
                           {"type": "longMin", "name": "s3",
                            "fieldName": "m3"}]}
     ex = QueryExecutor(segs)
+    # pin the COLUMN filter path: this test measures the packed-staging
+    # multiplier over staged filter columns; the device-bitmap filter path
+    # (engine/filters.py) would stop staging dimC/D/E entirely (1 bit/row
+    # resident instead of packed ids — a separate, larger win measured by
+    # tests/test_filter_bitmap.py)
+    from druid_tpu.engine import filters as _filters
+    prev_bmp = _filters.set_device_bitmap_enabled(False)
     prev = packed.set_enabled(False)
     try:
         dec_rows = ex.run_json(q)
@@ -448,3 +455,4 @@ def test_pool_holds_3x_more_segments_at_fixed_budget(fresh_pool):
         assert s.resident_bytes <= budget
     finally:
         packed.set_enabled(prev)
+        _filters.set_device_bitmap_enabled(prev_bmp)

@@ -607,6 +607,87 @@ class View:
     assert ("View._lock", "ReplicaSet._lock") in edges
 
 
+def test_closure_rebinding_same_identity_keeps_type():
+    """A closed-over local reassigned AFTER capture — to the SAME class —
+    keeps its identity: the closure's call still resolves and the lock
+    edge lands in the order graph (the PR 5 binder rider)."""
+    src = '''\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            pass
+
+class Driver:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def start(self):
+        worker = Worker()
+
+        def tick():
+            with self._lock:
+                worker.step()
+
+        self._t = threading.Thread(target=tick)
+        worker = Worker()       # rebound after capture, same class
+        self._t.start()
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("Driver._lock", "Worker._lock") in edges
+
+
+def test_closure_rebinding_conflicting_identity_degrades():
+    """Rebinding to a DIFFERENT class must still drop the binding — typing
+    the capture as either class would fabricate (or miss) edges, so the
+    conservative unknown wins and no Worker/Other edge appears."""
+    src = '''\
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            pass
+
+class Other:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def step(self):
+        with self._lock:
+            pass
+
+class Driver:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def start(self):
+        worker = Worker()
+
+        def tick():
+            with self._lock:
+                worker.step()
+
+        self._t = threading.Thread(target=tick)
+        worker = Other()        # conflicting rebinding: identity unknown
+        self._t.start()
+'''
+    prog = analyze_sources({"druid_tpu/m.py": src}, cfg())
+    edges = {(a.split("::")[-1], b.split("::")[-1])
+             for a, b in prog.order_edges}
+    assert ("Driver._lock", "Worker._lock") not in edges
+    assert ("Driver._lock", "Other._lock") not in edges
+
+
 def test_iteration_element_typing_items_and_list():
     """`for k, rs in d.items()` binds the SECOND target; plain iteration
     binds elements for List (sequence) annotations but NOT for Dict

@@ -302,6 +302,47 @@ def test_unbounded_multiplicity_flagged():
                for f in hits)
 
 
+def test_filter_bitmap_word_tiles_budgeted():
+    """Device filter-bitmap words (engine/filters.py): the worst-case word
+    tile is (Rw32, 128) with Rw32 ≤ contracts.FILTER_WORDS_PER_BLOCK —
+    SYMBOL_BOUNDS covers it, so a kernel streaming bitmap words stays
+    under the vmem budget without per-site annotations."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(span, Rw32):
+        BLK, W = plan_window(span)
+        R = BLK // 128
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0))),
+                      pl.BlockSpec((Rw32, 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    hits = check_source(textwrap.dedent(src), PALLAS, cfg())
+    assert not [f for f in hits if f.rule in ("vmem-budget",
+                                              "pallas-tile-shape")], hits
+
+
+def test_filter_bitmap_word_tiles_oversize_flagged():
+    """...and an unboundedly-scaled word tile still blows the cap — the
+    bound is a ceiling, not a waiver."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(Rw32):
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((Rw32 * 65536, 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    assert "vmem-budget" in rules_hit(src, PALLAS)
+
+
 # ---- x64-dtype ------------------------------------------------------------
 
 def test_x64_in_traced_fn_flagged():

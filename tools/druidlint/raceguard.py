@@ -943,12 +943,22 @@ def _local_frame(prog: Program, mod: ModuleInfo, fi: FuncInfo,
     def bind(name: str, got: Optional[Tuple]) -> None:
         if name in assigned_twice:
             return
+        norm = None
+        if got is not None:
+            norm = got[:2] if got[0] == "instance" else got
         if name in frame and name not in params:
+            if norm is not None and frame[name] == norm:
+                # REBINDING to the same identity (`worker = Worker()` ...
+                # `worker = Worker()`): the name's class is still known, so
+                # closures that captured it keep resolving — dropping it
+                # here silently lost their order edges. Only a CONFLICTING
+                # or unresolvable rebinding degrades to unknown.
+                return
             del frame[name]
             assigned_twice.add(name)
             return
-        if got is not None:
-            frame[name] = got[:2] if got[0] == "instance" else got
+        if norm is not None:
+            frame[name] = norm
         elif name in params:
             del frame[name]           # reassigned param: binding unknown
             assigned_twice.add(name)
