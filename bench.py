@@ -4,10 +4,13 @@ batched-vs-per-segment dispatch-amortization comparison.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 "per_segment_rate", "batched_rate", "batch_speedup", "packed_rate",
 "filter_host_rate", "filter_device_rate", "filter_cache_hit_rate",
-"decoded_rate", "pack_ratio", "untraced_rate", "traced_rate",
-"trace_overhead"} — packed_* compare compressed-domain vs decoded staging
-on the cold-miss H2D path; traced_* track qtrace span overhead across
-BENCH_r* runs.
+"decoded_rate", "pack_ratio", "fused_rate", "staged_rate",
+"dispatch_count_fused", "dispatch_count_staged", "donated_tick_rate",
+"untraced_rate", "traced_rate", "trace_overhead"} — packed_* compare
+compressed-domain vs decoded staging on the cold-miss H2D path; fused_*
+compare the one-dispatch megakernel path vs the staged fill-wave path on
+cold queries (dispatch_count_fused must be exactly 1); traced_* track
+qtrace span overhead across BENCH_r* runs.
 
 Config mirrors BASELINE.json: TPC-H-style GroupBy (2 dims, 3 aggs, numeric
 bound filter) + TopN (1 dim, metric-ordered) over synthetic segments.
@@ -373,6 +376,112 @@ def _bench_filter(iters: int):
     }
 
 
+def _bench_fused(iters: int):
+    """Megakernel comparison: a bitmap-eligible filter on a filter-only
+    dim, groupBy on another dim, per-segment execution (batching off) —
+    the shape where the staged path pays a bitmap fill dispatch PLUS the
+    aggregation dispatch per cold segment and the fused path
+    (engine/megakernel.py) pays exactly one program per segment. The pool
+    is cleared before every timed iteration so each run is a true cold
+    query (full staging both modes; the delta is the fill-dispatch work),
+    and rounds INTERLEAVE the modes so machine-load drift cancels.
+    dispatch_count_* come from a dedicated single-segment cold run per
+    mode via the obs dispatch counter — the megakernel's one-dispatch
+    contract as a recorded number. donated_tick_rate is the WARM
+    repeated-execution rate through the fused path (the scheduler-tick
+    shape whose partial buffers donate in place on accelerator
+    backends)."""
+    from druid_tpu.data.devicepool import device_pool
+    from druid_tpu.engine import batching, megakernel
+    from druid_tpu.engine.executor import QueryExecutor
+    from druid_tpu.obs import dispatch as dispatch_mod
+    from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+    from druid_tpu.query.filters import InFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+
+    # many SMALL segments: per-query fixed cost amortizes over 2N staged
+    # dispatches vs N fused ones, so the fused margin is structural
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_FUSED_SEGMENTS", 8))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_FUSED_ROWS", 2048))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    dimA_vals = list(segments[0].dims["dimA"].dictionary.values)
+    query = GroupByQuery.of(
+        "bench", [headline_interval()], [DefaultDimensionSpec("dimB")],
+        [CountAggregator("rows"), LongSumAggregator("lsum", "metLong")],
+        granularity="all",
+        filter=InFilter("dimA", dimA_vals[: max(len(dimA_vals) // 20, 1)]))
+    executor = QueryExecutor(segments)
+    single = QueryExecutor(segments[:1])
+    pool = device_pool()
+
+    modes = (("staged", False), ("fused", True))
+    dispatches = {}
+    pb = batching.set_enabled(False)     # per-segment: the megaize path
+    try:
+        for label, on in modes:
+            prev = megakernel.set_enabled(on)
+            try:
+                t = time.time()
+                executor.run(query)      # warm: compile both programs
+                log(f"fused-bench warmup {label}: {time.time() - t:.2f}s")
+                single.run(query)
+                pool.clear()             # dedicated cold dispatch count:
+                d0 = dispatch_mod.count()    # ONE segment, ONE cold query
+                single.run(query)
+                dispatches[label] = dispatch_mod.count() - d0
+            finally:
+                megakernel.set_enabled(prev)
+        times = {label: [] for label, _ in modes}
+        for _ in range(max(iters, 5)):
+            for label, on in modes:
+                prev = megakernel.set_enabled(on)
+                try:
+                    pool.clear()         # cold: full staging every iter
+                    t = time.time()
+                    executor.run(query)
+                    times[label].append(time.time() - t)
+                finally:
+                    megakernel.set_enabled(prev)
+    finally:
+        batching.set_enabled(pb)
+    rates = {label: total_rows / min(ts) for label, ts in times.items()}
+    for label, _ in modes:
+        log(f"fused-bench {label}: best {min(times[label]) * 1e3:.1f}ms "
+            f"over {len(times[label])} cold iters "
+            f"(single-segment cold = {dispatches[label]} dispatch(es)) "
+            f"-> {rates[label] / 1e6:.1f}M rows/s")
+
+    # warm repeated execution through the fused path — the scheduler-tick
+    # shape; on accelerator backends the partial grids donate in place.
+    # Batching stays OFF here too: the batched path never megaizes, so
+    # re-enabling it would time the wrong code path.
+    prev = megakernel.set_enabled(True)
+    pb = batching.set_enabled(False)
+    d0 = megakernel.stats().snapshot()["donatedBytes"]
+    try:
+        executor.run(query)
+        ticks = max(iters, 3)
+        t0 = time.time()
+        for _ in range(ticks):
+            executor.run(query)
+        tick_rate = total_rows * ticks / (time.time() - t0)
+    finally:
+        batching.set_enabled(pb)
+        megakernel.set_enabled(prev)
+    d_donated = megakernel.stats().snapshot()["donatedBytes"] - d0
+    log(f"fused-bench donated ticks: {ticks} warm run(s) "
+        f"-> {tick_rate / 1e6:.1f}M rows/s (donated {d_donated}B)")
+    return {
+        "fused_rate": round(rates["fused"], 0),
+        "staged_rate": round(rates["staged"], 0),
+        "fused_speedup": round(rates["fused"] / rates["staged"], 2),
+        "dispatch_count_fused": dispatches["fused"],
+        "dispatch_count_staged": dispatches["staged"],
+        "donated_tick_rate": round(tick_rate, 0),
+    }
+
+
 def _bench_tracing(iters: int):
     """qtrace overhead in one number pair: the batch-comparison query at
     many small segments (the worst case for per-dispatch span overhead —
@@ -641,6 +750,11 @@ def main():
         log(f"filter-bench failed: {type(e).__name__}: {e}")
         filt = {"filter_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        fused = _bench_fused(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"fused-bench failed: {type(e).__name__}: {e}")
+        fused = {"fused_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         traced = _bench_tracing(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"trace-bench failed: {type(e).__name__}: {e}")
@@ -669,6 +783,7 @@ def main():
     out.update(batch)
     out.update(packed_cmp)
     out.update(filt)
+    out.update(fused)
     out.update(traced)
     out.update(sched)
     out.update(soak)

@@ -289,9 +289,12 @@ class DataNodeServer:
         from druid_tpu.data.devicepool import DevicePoolMonitor
         from druid_tpu.engine.batching import BatchMetricsMonitor
         from druid_tpu.engine.filters import FilterBitmapMonitor
+        from druid_tpu.engine.megakernel import MegakernelMonitor
+        from druid_tpu.obs.dispatch import DispatchMonitor
         from druid_tpu.utils.emitter import MonitorScheduler
         monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
-                    FilterBitmapMonitor(), self._query_counts]
+                    FilterBitmapMonitor(), MegakernelMonitor(),
+                    DispatchMonitor(), self._query_counts]
         if self._scheduler_config is not None:
             self.scheduler = DataNodeScheduler(
                 node, self._scheduler_config, emitter=emitter)

@@ -256,6 +256,26 @@ class DeviceSegmentPool:
                 self._evict_to(budget, keep=full_key)
         return value
 
+    def take(self, owner: int, key: Tuple):
+        """Remove and return an entry's value (None when absent). The
+        megakernel's donated-carry handoff: the previous execution's
+        partial buffers pop out so they can be DONATED back into the next
+        program — on accelerator backends donation invalidates the
+        buffers, so they must leave the pool before the call. Stats-free
+        like peek(): carry probes are handoff mechanics, not staging-cache
+        outcomes, and must not skew segment/devicePool hit/miss series.
+        Never counts as an eviction either."""
+        full_key = (owner,) + tuple(key)
+        with self._lock:
+            self._drain_dead_locked()
+            entry = self._entries.pop(full_key, None)
+            if entry is None:
+                return None
+            self._owner_keys.get(owner, set()).discard(full_key)
+            self._resident -= entry[1]
+            self._logical -= entry[2]
+            return entry[0]
+
     def _evict_to(self, budget: int, keep: Optional[Tuple]) -> None:
         """Caller holds the lock. `keep` (the just-inserted entry) survives
         even when it alone exceeds the budget — the query running right now

@@ -355,6 +355,12 @@ class Segment:
         the filter-bitmap cache's own hit/miss accounting."""
         return self._pool.peek(self._pool_owner, ("aux",) + key)
 
+    def device_take(self, key: Tuple):
+        """Pop a device_cached entry (None when absent) — the megakernel's
+        donated-carry handoff (the buffers must leave the pool before
+        donation invalidates them)."""
+        return self._pool.take(self._pool_owner, ("aux",) + key)
+
     def column_minmax(self, name: str) -> Tuple[int, int]:
         """Cached (min, max) of a numeric column (0, 0 when empty)."""
         def _compute():

@@ -300,7 +300,8 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
         # batching it would only waste a stacked slot
         return plan
     needed, columns = needed_columns(segment, kds, aggs, flt, virtual_columns,
-                                     filter_node=filter_node)
+                                     filter_node=filter_node,
+                                     kernels=gplan.kernels)
     # complex (2-D) metric columns — HLL registers, sketch states — stack
     # like any other column now that the mask is in-program; their width is
     # a compile-shape dimension, so it joins the digest below
@@ -449,11 +450,11 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
     # per-segment derived inputs ride the mapped arrays, not aux: query-time
     # dictionary id columns (unified id spaces — engines.unify_query_dims)
     # and resident filter-bitmap words (engine/filters.py device-bitmap
-    # path; each plan stages ITS OWN words, so chunk-mates from different
-    # queries may carry entirely different bitmap filters under one shared
-    # program structure)
+    # path; each plan stages ITS OWN words — query filter AND filtered
+    # aggregators — so chunk-mates from different queries may carry
+    # entirely different bitmap filters under one shared program structure)
     bmp_per_slot = filters_mod.stage_device_bitmaps_multi(
-        [(p.segment, p.filter_node) for p in chunk], R)
+        [(p.segment, p.filter_node, p.kernels) for p in chunk], R)
     arrs_per_slot = []
     for p, b, bmp in zip(chunk, blocks, bmp_per_slot):
         arrs = dict(b.arrays)
@@ -497,12 +498,16 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
         else:
             _JIT_CACHE.move_to_end(sig)
 
+    from druid_tpu.obs import dispatch as dispatch_mod
     with trace_span("engine/batch/dispatch", segments=K, rows=R,
                     compile=compiled), \
             trace_span_when(compiled, "engine/compile", kind="batched",
                             strategy=strategy):
         outs = fn(tuple(arrs_per_slot), time0s, iv_rel,
                   bucket_off, aux)
+    # successful dispatches only (grouping's discipline): a failed batch
+    # falls back per-segment and must not double-bill the scoreboard
+    dispatch_mod.record("batched")
 
     out: List[SegmentPartial] = []
     for p, (counts, states) in zip(chunk, outs):

@@ -94,6 +94,28 @@ PACK_WORD_BITS = 32
 #: (the same design rule as SumKernel.chunk_rows pow2 quantization).
 PACK_WIDTHS = (4, 8, 16)
 
+# ---- megakernel mask words (engine/megakernel.py) -------------------------
+
+#: bits per row of the megakernel's fused row-mask words: the width-1
+#: instance of the data/packed.py tile-planar layout (word[q, l] packs tile
+#: rows q*32+s at lane l, bit s), so the host packer (pack_padded) and the
+#: in-kernel sub-lane unpack share one canonical encoding with the packed
+#: value columns.
+MEGA_MASK_WIDTH = 1
+
+#: mask rows per 32-bit word (PACK_WORD_BITS // MEGA_MASK_WIDTH).
+MEGA_MASK_VPW = PACK_WORD_BITS // MEGA_MASK_WIDTH
+
+#: rows covered by ONE 128-lane word row of the mask view. Mask word arrays
+#: pad to a multiple of this so (rows/32,) words reshape cleanly into
+#: (rows/4096, 128) tiles; every pallas block (BLK ∈ {1024, 2048} rows,
+#: R = BLK/128 ∈ {8, 16} tile rows) then sits inside ONE word row because
+#: MEGA_MASK_VPW % R == 0 — the in-kernel unpack is a pure sub-lane shift
+#: at bit base (block % (MEGA_MASK_VPW / R)) · R, no gather, (1, 128) of
+#: word VMEM per block instead of an (R, 128) int32 row mask (the 32x mask
+#: VMEM cut).
+MEGA_MASK_ROW_ALIGN = MEGA_MASK_VPW * LANE
+
 # ---- device filter bitmaps (engine/filters.py device-bitmap algebra) ------
 
 #: bits per device filter-bitmap word (uint32, LSB-first: row r is bit

@@ -17,6 +17,10 @@ epoch millis ints; the HTTP layer renders ISO strings).
 from __future__ import annotations
 
 import json
+import os
+import threading
+import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -365,6 +369,60 @@ def split_partials_by_segment(ap: AggregatePartials,
     return _split_by_segment(ap, segs, segments)
 
 
+#: TTL for cached union-remap id columns: a rolling ingest window retires
+#: segments' union digests, and the per-(segment, dim) aux slot would pin
+#: its last n_rows×4B remap forever (the aux cache has no eviction). The
+#: sweeper below clears any slot idle past this, so stale remaps stop
+#: pinning host memory while hot dashboards (re-touched every query) never
+#: expire. Override via DRUID_TPU_UNIDIM_TTL_S; <= 0 disables expiry.
+_UNIDIM_TTL_S = float(os.environ.get("DRUID_TPU_UNIDIM_TTL_S", "900"))
+_UNIDIM_LOCK = threading.Lock()
+
+
+class _UnidimSlot(dict):
+    """Weakref-able remap slot ({union digest: remapped ids}) with a
+    last-touch stamp; the registry holds weak references only, so a
+    collected segment's slots vanish without bookkeeping. Identity
+    hash/eq: dict is unhashable and content-equality would collide
+    distinct (empty) slots inside the WeakSet registry."""
+    __slots__ = ("__weakref__", "touched")
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):
+        return self is other
+
+    def __ne__(self, other):
+        return self is not other
+
+
+_UNIDIM_SLOTS: "weakref.WeakSet[_UnidimSlot]" = weakref.WeakSet()
+
+
+def set_unidim_ttl(seconds: float) -> float:
+    """Set the union-remap TTL; returns the previous value (test hook)."""
+    global _UNIDIM_TTL_S
+    with _UNIDIM_LOCK:
+        prev = _UNIDIM_TTL_S
+        _UNIDIM_TTL_S = float(seconds)
+        return prev
+
+
+def _sweep_unidim(now: float) -> int:
+    """Clear every union-remap slot idle past the TTL; returns the number
+    of slots cleared. Runs at each unify_query_dims entry — eviction needs
+    no background thread because the only growth source is this path."""
+    cleared = 0
+    with _UNIDIM_LOCK:
+        ttl = _UNIDIM_TTL_S
+        if ttl <= 0:
+            return 0
+        for slot in list(_UNIDIM_SLOTS):
+            if slot and now - getattr(slot, "touched", now) > ttl:
+                slot.clear()
+                cleared += 1
+    return cleared
+
+
 def unify_query_dims(segs: Sequence[Segment], kds_per_seg,
                      vals_per_seg) -> None:
     """Unify per-segment QUERY-TIME dictionaries (numeric/expression
@@ -379,6 +437,8 @@ def unify_query_dims(segs: Sequence[Segment], kds_per_seg,
     import hashlib
     if len(segs) < 2 or not kds_per_seg or not kds_per_seg[0]:
         return
+    now = time.monotonic()
+    _sweep_unidim(now)
     for j in range(len(kds_per_seg[0])):
         col = [kds[j] for kds in kds_per_seg]
         if not all(kd.host_ids is not None and kd.remap is None
@@ -396,11 +456,16 @@ def unify_query_dims(segs: Sequence[Segment], kds_per_seg,
         for s, kds, vals in zip(segs, kds_per_seg, vals_per_seg):
             kd = kds[j]
             # ONE resident remapped id column per (segment, dim), replaced
-            # when the union digest changes: a rolling segment set would
-            # otherwise grow a fresh n_rows×4B aux entry per distinct
-            # window this segment ever appeared in (the aux cache has no
-            # eviction). Repeated dashboards over a stable set still hit.
-            slot = s.aux_cached(("unidim",) + tuple(kd.ids_key), dict)
+            # when the union digest changes, and TTL-swept when idle
+            # (_sweep_unidim): a rolling segment set would otherwise grow
+            # a fresh n_rows×4B aux entry per distinct window this segment
+            # ever appeared in AND pin the last one forever. Repeated
+            # dashboards over a stable set still hit.
+            slot = s.aux_cached(("unidim",) + tuple(kd.ids_key),
+                                _UnidimSlot)
+            with _UNIDIM_LOCK:
+                _UNIDIM_SLOTS.add(slot)
+            slot.touched = now
             new_ids = slot.get(udig)
             if new_ids is None:
                 remap = np.asarray([index[v] for v in vals[j]],

@@ -462,6 +462,48 @@ def collect_bitmap_nodes(node: Optional[FilterNode]
     return out
 
 
+def assign_bitmap_slots(filter_node: Optional[FilterNode],
+                        kernels: Sequence = ()) -> int:
+    """Globally unique bitmap slots across ONE execution's trees: the query
+    filter first, then every filtered-aggregator tree in kernel order.
+    plan_filter slots each tree from 0, so a filtered aggregator's words
+    would collide with the query filter's under the same `__fbmpN` name —
+    this pass (called once per plan, grouping.plan_grouped_aggregate) makes
+    the staged-array namespace collision-free. Returns the slot count."""
+    slot = 0
+    for node in collect_bitmap_nodes(filter_node):
+        node.slot = slot
+        slot += 1
+    for k in kernels:
+        for tree in k.filter_trees():
+            for node in collect_bitmap_nodes(tree):
+                node.slot = slot
+                slot += 1
+    return slot
+
+
+def perm_digest(perm_key) -> Optional[str]:
+    """Stable digest of a row-permutation identity (the projection cache
+    key) for pool keys; None = original row order."""
+    if perm_key is None:
+        return None
+    return hashlib.sha1(repr(perm_key).encode()).hexdigest()[:16]
+
+
+def bitmap_pool_key(node: "DeviceBitmapNode", padded_rows: int,
+                    perm_dig: Optional[str] = None) -> Tuple:
+    """THE pool key for a filter's combined resident words: (structure
+    signature, aux digest, padded rows, permutation digest). Shared by the
+    staging wave below and the megakernel's residency probe
+    (engine/megakernel.megaize), so the two paths cannot key-drift. The
+    permutation digest (engine/grouping.py projection layouts) keys
+    PERMUTED-row-order words separately from original-order words — the
+    permuted path hits its own cache instead of re-planning onto the
+    column path."""
+    return ("fbmp", node.structure_sig(), node.digest(), padded_rows,
+            perm_dig)
+
+
 # ---------------------------------------------------------------------------
 # String predicate → dictionary LUT
 # ---------------------------------------------------------------------------
@@ -549,9 +591,10 @@ def plan_filter(flt: Optional[F.DimFilter], segment: Segment,
                 virtual_columns: Sequence = (),
                 device_bitmap: Optional[bool] = None) -> Optional[FilterNode]:
     """device_bitmap: compile bitmap-eligible subtrees to DeviceBitmapNodes
-    (None → the process default). The sharded mesh path and filtered
-    aggregators pass False — their aux/stacking disciplines expect
-    column-based nodes."""
+    (None → the process default). The sharded mesh path passes False —
+    its host-stacking discipline has no word slots. Filtered aggregators
+    follow the process default (kernels.make_kernel), riding resident
+    words / the fused megakernel like the query filter."""
     if flt is None:
         return None
     flt = flt.optimize()
@@ -860,6 +903,30 @@ _FBMP_JIT_CACHE_CAP = 64
 _FBMP_JIT_CACHE_LOCK = threading.Lock()
 
 
+def combine_structure_words(structure, leaf_words, const_words):
+    """THE word-domain algebra evaluator: AND/OR/NOT/XOR over whatever
+    `leaf_words(index)` / `const_words(bool)` return. Shared by the fill
+    program below AND the megakernel's inline path
+    (engine/megakernel.MegaBitmapNode.words_traced), so the staged and
+    fused paths cannot drift — their bit-parity contract is structural."""
+    def ev(node):
+        op = node[0]
+        if op == "leaf":
+            return leaf_words(node[1])
+        if op == "const":
+            return const_words(node[1])
+        if op == "not":
+            return ~ev(node[1])
+        kids = [ev(c) for c in node[1]]
+        out = kids[0]
+        for k in kids[1:]:
+            out = (out & k) if op == "and" else \
+                (out | k) if op == "or" else (out ^ k)
+        return out
+
+    return ev(structure)
+
+
 def _eval_structure(structure, kinds: Tuple, leaves: Tuple, Rw: int):
     """Traced word-wise bitmap algebra: leaves arrive as device arrays
     (dense uint32 words, or sparse int32 id lists scattered into words
@@ -875,23 +942,11 @@ def _eval_structure(structure, kinds: Tuple, leaves: Tuple, Rw: int):
         bit = jnp.uint32(1) << (ids & 31).astype(jnp.uint32)
         return jnp.zeros((Rw,), jnp.uint32).at[ids >> 5].add(bit, mode="drop")
 
-    def ev(node):
-        op = node[0]
-        if op == "leaf":
-            return leaf_words(node[1])
-        if op == "const":
-            fill = np.uint32(0xFFFFFFFF) if node[1] else np.uint32(0)
-            return jnp.full((Rw,), fill, jnp.uint32)
-        if op == "not":
-            return ~ev(node[1])
-        kids = [ev(c) for c in node[1]]
-        out = kids[0]
-        for k in kids[1:]:
-            out = (out & k) if op == "and" else \
-                (out | k) if op == "or" else (out ^ k)
-        return out
+    def const_words(value):
+        fill = np.uint32(0xFFFFFFFF) if value else np.uint32(0)
+        return jnp.full((Rw,), fill, jnp.uint32)
 
-    return ev(structure)
+    return combine_structure_words(structure, leaf_words, const_words)
 
 
 def _build_fill_fn(structure, kinds: Tuple, Rw: int):
@@ -920,34 +975,64 @@ def _leaf_digest(lut: np.ndarray) -> str:
     return hashlib.sha1(lut.tobytes()).hexdigest()[:16]
 
 
+def _permuted_bitmap(segment: Segment, bm: AnyBitmap,
+                     perm: np.ndarray, perm_key) -> AnyBitmap:
+    """Reorder a row bitmap into a permuted (projection) row layout. Sparse
+    bitmaps stay sparse: ids map through the cached inverse permutation."""
+    if isinstance(bm, SparseBitmap):
+        inv = segment.aux_cached(
+            ("perm_inv", perm_digest(perm_key)),
+            lambda: np.argsort(perm, kind="stable").astype(np.int32))
+        return SparseBitmap(np.sort(inv[bm.ids]), bm.n_rows)
+    return Bitmap.from_bool(bm.to_bool()[perm])
+
+
 def _leaf_arrays(segment: Segment, node: DeviceBitmapNode,
-                 padded_rows: int) -> Tuple[Tuple, Tuple]:
+                 padded_rows: int, perm: Optional[np.ndarray] = None,
+                 perm_key=None) -> Tuple[Tuple, Tuple]:
     """(kinds, device leaf payloads) for one node: leaf bitmaps come from
-    the host index and ship density-adaptively, pool-resident per leaf."""
+    the host index and ship density-adaptively, pool-resident per leaf.
+    `perm` reorders rows into a projection layout before packing; the
+    permutation digest keys those entries separately."""
     import jax
 
+    pdg = perm_digest(perm_key)
     kinds: List[Tuple] = []
     arrays = []
     for dim, lut in node.leaves:
         col = segment.dims[dim]
         bm = col.bitmap_index().union_of(np.flatnonzero(lut))
+        if perm is not None:
+            bm = _permuted_bitmap(segment, bm, perm, perm_key)
         kind, payload = device_repr(bm, padded_rows)
         kinds.append((kind, payload.shape[0]))
         lkey = ("fbmpleaf", dim, _leaf_digest(lut), padded_rows, kind,
-                payload.shape[0])
+                payload.shape[0], pdg)
         arrays.append(segment.device_cached(
             lkey, lambda p=payload: jax.device_put(p)))
     return tuple(kinds), tuple(arrays)
 
 
-def stage_device_bitmaps_multi(items: Sequence[Tuple[Segment,
-                                                     Optional[FilterNode]]],
+def _item_nodes(filter_node: Optional[FilterNode],
+                kernels: Sequence) -> List[DeviceBitmapNode]:
+    """One item's stageable nodes: the query filter's plus every filtered
+    aggregator's (kernels plan bitmap words too — AggKernel.filter_trees)."""
+    nodes = collect_bitmap_nodes(filter_node)
+    for k in kernels:
+        for tree in k.filter_trees():
+            nodes.extend(collect_bitmap_nodes(tree))
+    return nodes
+
+
+def stage_device_bitmaps_multi(items: Sequence[Tuple],
                                padded_rows: int) -> List[Dict[str, object]]:
     """Resident filter-bitmap words for a whole staging wave: one
-    {node.col: uint32 words [padded_rows/32]} dict per (segment,
-    filter_node) item, to merge into each slot's arrays. Results live in
-    the byte-budgeted device pool keyed (filter structural signature, aux
-    digest, padded rows) per segment — warm probes skip leaf
+    {node.col: uint32 words [padded_rows/32]} dict per item, to merge into
+    each slot's arrays. Items are (segment, filter_node) or (segment,
+    filter_node, kernels) — filtered aggregators' trees stage exactly like
+    the query filter's. Results live in the byte-budgeted device pool
+    keyed (filter structural signature, aux digest, padded rows,
+    permutation digest) per segment — warm probes skip leaf
     materialization AND the algebra (query/filter/deviceBitmapHits); ALL
     of the wave's cold misses fill in a single batched dispatch."""
     out: List[Dict[str, object]] = [{} for _ in items]
@@ -956,9 +1041,11 @@ def stage_device_bitmaps_multi(items: Sequence[Tuple[Segment,
     # the same dashboard query — build ONCE and fan out (the duplicates
     # count as hits: they are served without leaf work or algebra)
     wave_dups: Dict[Tuple, List[Tuple[int, str]]] = {}
-    for i, (segment, filter_node) in enumerate(items):
-        for node in collect_bitmap_nodes(filter_node):
-            key = ("fbmp", node.structure_sig(), node.digest(), padded_rows)
+    for i, item in enumerate(items):
+        segment, filter_node = item[0], item[1]
+        kernels = item[2] if len(item) > 2 else ()
+        for node in _item_nodes(filter_node, kernels):
+            key = bitmap_pool_key(node, padded_rows)
             wkey = (id(segment), key)
             if wkey in wave_dups:
                 _FBMP_STATS.record(True)
@@ -978,6 +1065,7 @@ def stage_device_bitmaps_multi(items: Sequence[Tuple[Segment,
     if not pending:
         return out
 
+    from druid_tpu.obs import dispatch as dispatch_mod
     Rw = padded_rows // 32
     kinds_per, leaves_per = [], []
     for _, segment, node, _ in pending:
@@ -996,6 +1084,7 @@ def stage_device_bitmaps_multi(items: Sequence[Tuple[Segment,
         else:
             _FBMP_JIT_CACHE.move_to_end(jkey)
     words_per = fn(tuple(leaves_per))
+    dispatch_mod.record("filterFill")    # successful dispatches only
     for (i, segment, node, key), words in zip(pending, words_per):
         resident = segment.device_cached(key, lambda w=words: w)
         out[i][node.col] = resident
@@ -1005,10 +1094,14 @@ def stage_device_bitmaps_multi(items: Sequence[Tuple[Segment,
 
 
 def _fill_single(segment: Segment, node: DeviceBitmapNode,
-                 padded_rows: int):
+                 padded_rows: int, perm: Optional[np.ndarray] = None,
+                 perm_key=None):
     """One (segment, filter) fill — the pool-miss build path when a probe
-    said hit but the entry was evicted before device_cached re-read it."""
-    kinds, arrays = _leaf_arrays(segment, node, padded_rows)
+    said hit but the entry was evicted before device_cached re-read it,
+    and the permuted-layout (projection) staging path."""
+    from druid_tpu.obs import dispatch as dispatch_mod
+    kinds, arrays = _leaf_arrays(segment, node, padded_rows, perm=perm,
+                                 perm_key=perm_key)
     key = (node.structure, kinds, padded_rows // 32)
     with _FBMP_JIT_CACHE_LOCK:
         fn = _FBMP_JIT_CACHE.get(key)
@@ -1019,15 +1112,34 @@ def _fill_single(segment: Segment, node: DeviceBitmapNode,
                 _FBMP_JIT_CACHE.popitem(last=False)
         else:
             _FBMP_JIT_CACHE.move_to_end(key)
-    return fn(arrays)
+    words = fn(arrays)
+    dispatch_mod.record("filterFill")    # successful dispatches only
+    return words
 
 
 def stage_device_bitmaps(segment: Segment,
                          filter_node: Optional[FilterNode],
-                         padded_rows: int) -> Dict[str, object]:
-    """Single-segment convenience over stage_device_bitmaps_multi."""
-    return stage_device_bitmaps_multi([(segment, filter_node)],
-                                      padded_rows)[0]
+                         padded_rows: int, kernels: Sequence = (),
+                         perm: Optional[np.ndarray] = None,
+                         perm_key=None) -> Dict[str, object]:
+    """Single-segment staging. Without a permutation this is the wave path
+    for one item; with one (the projection layout), every node stages
+    PERMUTED words under its own (key, permutation digest) pool entries —
+    the projection path hits its cache instead of falling back to the
+    column path."""
+    if perm is None:
+        return stage_device_bitmaps_multi(
+            [(segment, filter_node, kernels)], padded_rows)[0]
+    pdg = perm_digest(perm_key)
+    out: Dict[str, object] = {}
+    for node in _item_nodes(filter_node, kernels):
+        key = bitmap_pool_key(node, padded_rows, pdg)
+        hit = segment.device_contains(key)
+        _FBMP_STATS.record(hit, 0 if hit else padded_rows // 8)
+        out[node.col] = segment.device_cached(
+            key, lambda s=segment, n=node: _fill_single(
+                s, n, padded_rows, perm=perm, perm_key=perm_key))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -51,8 +51,10 @@ import numpy as np
 from druid_tpu.data import packed as packed_mod
 from druid_tpu.data.segment import DeviceBlock, Segment
 from druid_tpu.engine import filters as filters_mod
+from druid_tpu.engine import megakernel
 from druid_tpu.engine.filters import (ConstNode, FilterNode, plan_filter,
                                       simplify_node)
+from druid_tpu.obs import dispatch as dispatch_mod
 from druid_tpu.obs.trace import span as trace_span
 from druid_tpu.obs.trace import span_when as trace_span_when
 from druid_tpu.engine.kernels import AggKernel, make_kernel
@@ -398,6 +400,20 @@ def fuse_filter_update(arrays: Dict, mask, key, it,
             mask = mask & (ids >= 0)
         card = next(it)
         key = key * card + jnp.maximum(ids, 0)
+
+    if strategy == "megakernel":
+        # the fused one-dispatch variant (engine/megakernel.py): top-level
+        # AND-conjunct mega nodes stay in the WORD domain all the way into
+        # the pallas kernel; only the residual (row-domain) part of the
+        # tree expands here. Masked rows read the key sentinel in-kernel,
+        # so results are bit-identical to the staged pallas path.
+        mega_nodes, residual = megakernel.split_for_kernel(filter_node)
+        if residual is not None:
+            mask = mask & residual.build(arrays, it)
+        key = jnp.clip(key, 0, num_total - 1).astype(jnp.int32)
+        return megakernel.mega_reduce(arrays, mask, key, mega_nodes,
+                                      kernels, num_total, window,
+                                      packed_cols=packed_cols)
 
     if filter_node is not None:
         mask = mask & filter_node.build(arrays, it)
@@ -751,7 +767,15 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
                      vc_plans: Tuple = ()):
     """Build the traced program. Structure-only closure: every segment-specific
     constant arrives via `aux` (device arrays), so one jitted callable serves
-    every segment with the same structure."""
+    every segment with the same structure.
+
+    The "megakernel" strategy's callable takes a third `carries` argument —
+    the previous execution's raw accumulator grids, donated
+    (donate_argnums) when the backend supports donation so repeated/
+    standing executions reuse the same HBM buffers (the kernel
+    re-initializes them at grid step 0, so donated reuse is bit-identical
+    to fresh zeros). `keep_unused` holds the carries in the signature:
+    they exist purely as donatable buffers, never as data."""
     import jax
     import jax.numpy as jnp
 
@@ -761,7 +785,7 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
     dim_cols = tuple(d.column for d in spec.dims)
     has_remap = tuple(d.remap is not None for d in spec.dims)
 
-    def fn(arrays: Dict[str, object], aux: Tuple):
+    def fn(arrays: Dict[str, object], aux: Tuple, carries: Tuple = ()):
         it = iter(aux)
         # decode bit-packed columns at the program top: HBM keeps the words,
         # XLA fuses the shift/mask decode into every consumer; the pallas
@@ -814,6 +838,10 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
                                   window=spec.window,
                                   packed_cols=packed_cols or None)
 
+    if spec.strategy == "megakernel":
+        if megakernel.donation_enabled():
+            return jax.jit(fn, keep_unused=True, donate_argnums=(2,))
+        return jax.jit(fn, keep_unused=True)
     return jax.jit(fn)
 
 
@@ -973,13 +1001,16 @@ _NO_NODE = object()   # "caller did not plan the filter" sentinel
 
 def needed_columns(segment: Segment, kds: Sequence[KeyDim],
                    aggs: Sequence[AggregatorSpec], flt,
-                   virtual_columns: Sequence, filter_node=_NO_NODE):
+                   virtual_columns: Sequence, filter_node=_NO_NODE,
+                   kernels: Optional[Sequence[AggKernel]] = None):
     """Returns (all referenced real-column names, the subset present in
     `segment` — i.e. the columns to stage). When the PLANNED `filter_node`
     is passed (None counts: the filter simplified away), filter needs come
     from its required_device_columns() — subtrees compiled to device
     bitmaps (filters.DeviceBitmapNode) consume no staged columns, so
-    filter-only dimensions stop staging."""
+    filter-only dimensions stop staging. When the PLANNED `kernels` ride
+    along, filtered aggregators likewise contribute their planned needs
+    (bitmap-compiled aggregator filters read words, not columns)."""
     from druid_tpu.utils.expression import parse_expression
     vc_names = {v.name for v in virtual_columns}
     needed = set()
@@ -991,8 +1022,10 @@ def needed_columns(segment: Segment, kds: Sequence[KeyDim],
             needed |= flt.required_columns()
     elif filter_node is not None:
         needed |= filter_node.required_device_columns()
-    for a in aggs:
-        needed |= a.required_columns()
+    for i, a in enumerate(aggs):
+        kc = kernels[i].required_device_columns() \
+            if kernels is not None else None
+        needed |= a.required_columns() if kc is None else kc
     for v in virtual_columns:
         needed |= parse_expression(v.expression).required_columns()
     needed -= vc_names
@@ -1027,11 +1060,16 @@ def plan_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                            virtual_columns: Sequence = ()) -> GroupPlan:
     """Host-side planning for one segment (no staging, no device work)."""
     vc_plans, vc_luts = plan_virtual_columns(segment, virtual_columns)
+    filter_node = simplify_node(plan_filter(flt, segment, virtual_columns))
+    kernels = [make_kernel(a, segment) for a in aggs]
+    # globally unique bitmap slots across the query filter AND the
+    # filtered-aggregator trees — their staged word arrays share one
+    # `__fbmpN` namespace in the arrays dict
+    filters_mod.assign_bitmap_slots(filter_node, kernels)
     return GroupPlan(
         spec=make_group_spec(segment, intervals, granularity, dims),
-        filter_node=simplify_node(plan_filter(flt, segment,
-                                              virtual_columns)),
-        kernels=[make_kernel(a, segment) for a in aggs],
+        filter_node=filter_node,
+        kernels=kernels,
         vc_plans=vc_plans, vc_luts=vc_luts)
 
 
@@ -1069,8 +1107,11 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
         # the PLANNED tree's column needs, not the raw filter's: subtrees
         # compiled to device bitmaps read resident words, not columns
         base_needed |= filter_node.required_device_columns()
-    for a in aggs:
-        base_needed |= a.required_columns()
+    for a, k in zip(aggs, kernels):
+        # the PLANNED kernel's needs where narrower: a filtered agg whose
+        # filter compiled to bitmap words reads words, not filter columns
+        kc = k.required_device_columns()
+        base_needed |= a.required_columns() if kc is None else kc
     for v in virtual_columns:
         base_needed |= parse_expression(v.expression).required_columns()
     base_needed -= vc_names
@@ -1124,26 +1165,24 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                           for d in spec.dims))
         spec.host_keys_cache = perm_key
         needed = base_needed  # key prefused: dim columns stay host-side
-        if filters_mod.collect_bitmap_nodes(filter_node):
-            # the projection stages a PERMUTED row layout; resident bitmap
-            # words are in original row order, so the bit test would
-            # misalign — re-plan the filter on the column path (LUT
-            # gathers permute with the staged columns). Projection-grade
-            # segments are scatter-bound anyway; the bitmap win is noise
-            # there.
-            filter_node = simplify_node(plan_filter(
-                flt, segment, virtual_columns, device_bitmap=False))
-            if isinstance(filter_node, ConstNode) and not filter_node.value:
-                return SegmentPartial(
-                    segment=segment, spec=spec,
-                    counts=np.zeros(spec.num_total, dtype=np.int64),
-                    states={k.name: k.empty_state(spec.num_total)
-                            for k in kernels},
-                    kernels=kernels)
-            if filter_node is not None:
-                needed = base_needed | {
-                    c for c in filter_node.required_device_columns()
-                    if c in segment.dims or c in segment.metrics}
+        # bitmap subtrees STAY on the words path: the projection's permuted
+        # row layout stages its own words under a permutation-digest pool
+        # key (filters.bitmap_pool_key), so the bit test aligns with the
+        # permuted columns instead of forcing a column-path re-plan
+
+    # megakernel conversion (engine/megakernel.py): bitmap subtrees whose
+    # combined words are not already resident fuse INLINE — per-leaf words
+    # stay resident, the algebra evaluates inside the ONE aggregation
+    # program, and the separate fill dispatch disappears. Resident subtrees
+    # keep the cached bit-test path (also one dispatch). Opt-out:
+    # DRUID_TPU_MEGAKERNEL=0.
+    pdg = filters_mod.perm_digest(perm_key)
+    if megakernel.enabled():
+        filter_node = megakernel.megaize(filter_node, segment, padded_rows,
+                                         pdg)
+        megakernel.megaize_kernels(kernels, segment, padded_rows, pdg)
+    else:
+        megakernel.record_disabled_fallback(filter_node, kernels)
 
     # pack descriptor of the staged column set: must be derived IDENTICALLY
     # to device_block's own planning (pure fn of column stats), and joins
@@ -1169,15 +1208,37 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
             segment, spec.host_bucket_cache, spec.host_bucket_ids,
             block.padded_rows, -1)
     # resident filter-bitmap words (engine/filters.py device-bitmap path):
-    # cached per (segment, filter structure, aux digest) in the same pool
-    arrays.update(filters_mod.stage_device_bitmaps(segment, filter_node,
-                                                   block.padded_rows))
+    # cached per (segment, filter structure, aux digest, permutation
+    # digest) in the same pool; filtered-aggregator trees stage alongside
+    # the query filter's, and the projection path stages PERMUTED words
+    arrays.update(filters_mod.stage_device_bitmaps(
+        segment, filter_node, block.padded_rows, kernels=kernels,
+        perm=perm, perm_key=perm_key))
+    # per-leaf mask words for inline-fused (mega) subtrees
+    arrays.update(megakernel.stage_mega_leaves(
+        segment, filter_node, kernels, block.padded_rows,
+        perm=perm, perm_key=perm_key))
+
+    # the fused pallas variant: when the projection strategy landed on the
+    # pallas kernel AND the tree carries top-level AND-conjunct mega nodes,
+    # the mask rides into the kernel as words (the 32x mask-VMEM cut) and
+    # the partial grids become donatable carries
+    if spec.strategy == "pallas" \
+            and megakernel.split_for_kernel(filter_node)[0]:
+        spec.strategy = "megakernel"
 
     aux = _assemble_aux(spec, segment, intervals, filter_node, kernels,
                         vc_plans, vc_luts)
     while True:
         sig = _structure_sig(spec, len(intervals), filter_node, kernels,
                              vc_plans, packs)
+        if spec.strategy == "megakernel":
+            # donation changes the jit construction (donate_argnums) and
+            # the carry handoff changes the carries treedef (empty vs full
+            # tuple), so both key the program cache; carry buffers key off
+            # the same sig
+            sig += f"|mk={int(megakernel.donation_enabled())}" \
+                f"{int(megakernel.carry_enabled())}"
         with _JIT_CACHE_LOCK:
             fn = _JIT_CACHE.get(sig)
             # the builder-idiom miss IS the compile event: jit tracing +
@@ -1199,15 +1260,52 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                     trace_span_when(compiled, "engine/compile",
                                     kind="segment",
                                     strategy=spec.strategy):
-                counts, states = fn(arrays, aux)
+                if spec.strategy == "megakernel" \
+                        and megakernel.carry_enabled():
+                    # donated-carry handoff: the previous execution's raw
+                    # accumulator grids pop out of the pool and ride back
+                    # in as the donated third argument; the new grids park
+                    # under the same key for the next tick. Content is
+                    # never read (the kernel re-inits at step 0) — the
+                    # carry is purely the reusable HBM allocation, so
+                    # repeated scheduler-tick execution has zero per-tick
+                    # pool growth. A carry popped before a failed call is
+                    # deliberately dropped: donation may have invalidated
+                    # its buffers mid-flight, so the next tick rebuilds
+                    # fresh zeros.
+                    cdefs = megakernel.carry_defs(
+                        kernels, col_dtypes, spec.num_total, spec.window)
+                    carried = segment.device_take(("megacarry", sig))
+                    donated = carried is not None \
+                        and len(carried) == len(cdefs) \
+                        and megakernel.donation_enabled()
+                    if carried is None or len(carried) != len(cdefs):
+                        carried = megakernel.fresh_carries(cdefs)
+                    counts, states, raw = fn(arrays, aux, tuple(carried))
+                    segment.device_cached(("megacarry", sig),
+                                          lambda: raw)
+                    if donated:
+                        megakernel.stats().record_donated(
+                            sum(int(getattr(a, "nbytes", 0))
+                                for a in carried))
+                elif spec.strategy == "megakernel":
+                    # no donation support: parking grids in the budgeted
+                    # pool would only evict useful entries — run carryless
+                    counts, states, _raw = fn(arrays, aux, ())
+                else:
+                    counts, states = fn(arrays, aux)
+            # count the SUCCESSFUL program only (a Mosaic-failure retry
+            # must not double-bill the query's dispatch scoreboard)
+            dispatch_mod.record("segment")
             break
         except Exception as e:
-            if spec.strategy != "pallas":
+            if spec.strategy not in ("pallas", "megakernel"):
                 raise
             # Mosaic compile failure: latch pallas off for the process and
             # retry on the XLA windowed/mixed path — a kernel bug must not
             # fail user queries (reference queries never depend on which
-            # engine strategy runs)
+            # engine strategy runs). A megakernel tree keeps working: its
+            # mega nodes expand to row masks in XLA (MegaBitmapNode.build).
             from druid_tpu.engine import pallas_agg
             pallas_agg.mark_broken(e)
             logging.getLogger(__name__).warning(

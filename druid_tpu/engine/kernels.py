@@ -86,6 +86,18 @@ class AggKernel:
     def aux_arrays(self) -> List[np.ndarray]:
         return []
 
+    def filter_trees(self) -> List[FilterNode]:
+        """Planned filter trees this kernel owns (FilteredKernel chains) —
+        the walk bitmap-word staging and slot assignment use."""
+        return []
+
+    def required_device_columns(self) -> Optional[set]:
+        """Staged columns update() actually reads, when narrower than the
+        spec's required_columns (None = use the spec's). FilteredKernel
+        overrides: a filter subtree compiled to device bitmap words reads
+        resident words, not columns, so filter-only columns stop staging."""
+        return None
+
     def update(self, cols: Dict, mask, keys, num: int, aux: Iterator):
         """Traced: per-group partial state (device pytree)."""
         raise NotImplementedError
@@ -630,6 +642,15 @@ class FilteredKernel(AggKernel):
     def aux_arrays(self):
         return self.filter_node.aux_arrays() + self.child.aux_arrays()
 
+    def filter_trees(self):
+        return [self.filter_node] + self.child.filter_trees()
+
+    def required_device_columns(self):
+        child = self.child.required_device_columns()
+        if child is None:
+            child = set(self.spec.delegate.required_columns())
+        return child | self.filter_node.required_device_columns()
+
     def update(self, cols, mask, keys, num, aux):
         fmask = self.filter_node.build(cols, aux)
         return self.child.update(cols, mask & fmask, keys, num, aux)
@@ -800,7 +821,13 @@ def register_kernel(spec_cls: type, factory) -> None:
     _EXTENSION_KERNELS[spec_cls] = factory
 
 
-def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
+def make_kernel(spec: A.AggregatorSpec, segment: Segment,
+                device_bitmap: Optional[bool] = None) -> AggKernel:
+    """`device_bitmap`: how a FILTERED aggregator's filter plans — None
+    follows the process default (filters.device_bitmap_enabled), so
+    filtered aggregators ride resident bitmap words / the fused megakernel
+    instead of forcing decoded filter columns; the sharded mesh path
+    passes False (its host-stacking discipline has no word slots)."""
     factory = _EXTENSION_KERNELS.get(type(spec))
     if factory is not None:
         return factory(spec, segment)
@@ -830,11 +857,16 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment) -> AggKernel:
                                isinstance(spec, A.LastAggregator),
                                tf if tf in segment.metrics else None)
     if isinstance(spec, A.FilteredAggregator):
-        child = make_kernel(spec.delegate, segment)
-        # column-path planning (device_bitmap=False): a filtered agg's
-        # filter aux rides the kernel aux stream, which batching compares
-        # by value — resident bitmap words have no aux representation
-        node = plan_filter(spec.filter, segment, device_bitmap=False)
+        child = make_kernel(spec.delegate, segment,
+                            device_bitmap=device_bitmap)
+        # bitmap-eligible subtrees compile to DeviceBitmapNodes (process
+        # default): the words ride the staged-arrays dict under globally
+        # assigned slots (filters.assign_bitmap_slots) and contribute no
+        # kernel aux, so batching's value-compare still holds — the
+        # filtered agg rides the fused/batched programs instead of forcing
+        # its filter columns to stage decoded
+        node = plan_filter(spec.filter, segment,
+                           device_bitmap=device_bitmap)
         return FilteredKernel(spec, child, node)
     if isinstance(spec, A.HyperUniqueAggregator):
         return HllKernel(spec, (spec.field,), segment, spec.log2m, by_row=False)

@@ -1,0 +1,730 @@
+"""One-dispatch megakernel: fused bitmap filter + packed decode + aggregation.
+
+PRs 9-10 made value columns resident as bit-packed words and filter
+bitmaps resident as packed words — but a COLD query still paid up to three
+device dispatches: the bitmap-algebra fill wave (engine/filters.py
+`_eval_structure`), then the aggregation program (packed decode at the
+program top + reduce). This module closes ROADMAP item 4: the whole query
+becomes ONE device program, following the decompress-inside-the-operator
+design of *GPU Acceleration of SQL Analytics on Compressed Data* and the
+accelerator-serving framing of *Tailwind* (PAPERS.md).
+
+The fused path, per bitmap-eligible filter subtree:
+
+  * `megaize` replaces each planned DeviceBitmapNode whose COMBINED words
+    are not already pool-resident with a MegaBitmapNode: its per-leaf row
+    bitmaps stage as resident words (1 bit/row, the width-1 instance of
+    the data/packed.py tile-planar layout) and the AND/OR/NOT/XOR word
+    algebra evaluates INLINE in the one traced program — no fill dispatch,
+    no combined-words materialization in HBM. Hot dashboards whose
+    combined words ARE resident keep the cached bit-test path (also one
+    dispatch); the megakernel is the one-shot/cold-query story.
+  * On the pallas (sorted-projection) strategy, `mega_reduce` runs the
+    fused aggregation kernel: packed value columns arrive AS WORDS and
+    unpack per VMEM tile (engine/pallas_agg.py discipline), and the row
+    mask arrives AS WORDS too — the interval/validity mask packs to words
+    in-program, ANDs with the filter word algebra, and the kernel performs
+    a Mosaic-safe sub-lane unpack per block ((1, 128) of word VMEM instead
+    of an (R, 128) int32 row mask — ~32x less mask VMEM traffic). No
+    decoded column and no row-width mask ever hits HBM.
+  * Per-group partial buffers DONATE across executions (`donate_argnums`,
+    the pjit plumbing of SNIPPETS.md [1]/[2]): the raw accumulator grids
+    of one run park in the device pool and are handed back — donated — to
+    the next run of the same (segment, program) pair, so standing/repeated
+    queries driven by the scheduler's flush loop (PR 7) update partials in
+    place with zero per-tick HBM churn. The kernel re-initializes the
+    grids at grid step 0, so donated reuse is bit-identical to fresh
+    zero buffers (the donation-aliasing parity contract).
+
+Parity discipline (PR 9): the fused path is bit-identical to the staged
+path — the mask BITS are exactly the staged algebra's, and the kernel's
+block/accumulation order is pallas_agg's, so counts/int sums match
+bitwise and float sums reduce in the same order.
+
+Opt-out: `DRUID_TPU_MEGAKERNEL=0` (or set_enabled(False)) keeps the
+staged fill-wave + resident-combined-words path everywhere.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from druid_tpu.engine import pallas_agg
+from druid_tpu.engine.contracts import (BLK_SMALL_W, MEGA_MASK_ROW_ALIGN,
+                                        MEGA_MASK_VPW, MEGA_MASK_WIDTH)
+from druid_tpu.engine.filters import (AndNode, DeviceBitmapNode, FilterNode,
+                                      NotNode, OrNode, _leaf_digest,
+                                      bitmap_pool_key, collect_bitmap_nodes,
+                                      perm_digest)
+from druid_tpu.utils.emitter import Monitor
+
+#: process default; opt-out via DRUID_TPU_MEGAKERNEL=0 or set_enabled(False)
+_ENABLED = os.environ.get("DRUID_TPU_MEGAKERNEL", "1").lower() \
+    not in ("0", "false", "no")
+#: tests force donation on (CPU ignores donation silently) or off
+_FORCE_DONATE: Optional[bool] = None
+#: tests force the carry take/park handoff without real donation (CPU)
+_FORCE_CARRY: Optional[bool] = None
+_STATE_LOCK = threading.Lock()
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the process-wide megakernel default; returns the previous value
+    (bench/test toggle, the batching/packed.set_enabled discipline)."""
+    global _ENABLED
+    with _STATE_LOCK:
+        prev = _ENABLED
+        _ENABLED = bool(on)
+        return prev
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_force_donate(on: Optional[bool]) -> Optional[bool]:
+    """Override donation support detection (None = autodetect). Forcing
+    donation ON where the backend does not support it (CPU) is undefined
+    behavior — this hook exists for accelerator-run experiments only."""
+    global _FORCE_DONATE
+    with _STATE_LOCK:
+        prev = _FORCE_DONATE
+        _FORCE_DONATE = on
+        return prev
+
+
+def donation_enabled() -> bool:
+    """Whether the fused program donates its carry buffers. Autodetect is
+    backend-based: CPU ignores donation and warns per call, so only
+    accelerator backends donate by default."""
+    if _FORCE_DONATE is not None:
+        return _FORCE_DONATE
+    try:
+        import jax
+        return jax.default_backend() in ("tpu", "gpu")
+    except Exception:  # druidlint: disable=swallowed-exception
+        # availability probe: no backend means no donation, never an error
+        return False
+
+
+def set_force_carry(on: Optional[bool]) -> Optional[bool]:
+    """Override carry_enabled detection (None = follow donation). Lets CPU
+    tests exercise the take/park handoff and its fresh-vs-carried parity
+    without real donation."""
+    global _FORCE_CARRY
+    with _STATE_LOCK:
+        prev = _FORCE_CARRY
+        _FORCE_CARRY = on
+        return prev
+
+
+def carry_enabled() -> bool:
+    """Whether executions pool-park their raw grids and ride them back as
+    carries. Without donation the parked grids would only consume pool
+    budget (the buffers are never aliased into outputs), so the handoff
+    follows donation support by default."""
+    if _FORCE_CARRY is not None:
+        return _FORCE_CARRY
+    return donation_enabled()
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Stats (query/megakernel/* metrics)
+# ---------------------------------------------------------------------------
+
+class MegaStats:
+    """hits = bitmap subtrees fused inline; fallbacks = bitmap subtrees
+    that did NOT fuse (megakernel disabled, or resident combined words
+    already serve them); donated_bytes = carry-buffer bytes handed back
+    donated across executions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.fallbacks = 0
+        self.donated_bytes = 0
+
+    def record_hit(self, n: int = 1) -> None:
+        with self._lock:
+            self.hits += n
+
+    def record_fallback(self, n: int = 1) -> None:
+        with self._lock:
+            self.fallbacks += n
+
+    def record_donated(self, nbytes: int) -> None:
+        with self._lock:
+            self.donated_bytes += nbytes
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "fallbacks": self.fallbacks,
+                    "donatedBytes": self.donated_bytes}
+
+
+_STATS = MegaStats()
+
+
+def stats() -> MegaStats:
+    return _STATS
+
+
+class MegakernelMonitor(Monitor):
+    """Emits query/megakernel/{hits,fallbacks,donatedBytes} per tick
+    (deltas over the tick window, the FilterBitmapMonitor discipline)."""
+
+    def __init__(self, source: Optional[MegaStats] = None):
+        self.source = source or _STATS
+        self._last = self.source.snapshot()
+
+    def do_monitor(self, emitter):
+        s = self.source.snapshot()
+        last, self._last = self._last, s
+        emitter.metric("query/megakernel/hits", s["hits"] - last["hits"])
+        emitter.metric("query/megakernel/fallbacks",
+                       s["fallbacks"] - last["fallbacks"])
+        emitter.metric("query/megakernel/donatedBytes",
+                       s["donatedBytes"] - last["donatedBytes"])
+
+
+# ---------------------------------------------------------------------------
+# The fused filter node
+# ---------------------------------------------------------------------------
+
+class MegaBitmapNode(FilterNode):
+    """A bitmap-eligible subtree fused INTO the aggregation program.
+
+    Unlike DeviceBitmapNode (whose combined words are built by a separate
+    fill dispatch and cached), this node's LEAVES are the resident device
+    data — one word array per leaf, 1 bit/row in the width-1 tile-planar
+    packed layout — and the word algebra traces inline. The algebra
+    STRUCTURE is therefore program structure and joins the signature
+    (exactly like the fill-program jit cache keyed on it)."""
+
+    def __init__(self, structure, leaves: List[Tuple[str, np.ndarray]],
+                 slot: int):
+        self.structure = structure
+        self.leaves = leaves
+        self.slot = slot
+
+    @classmethod
+    def from_bitmap(cls, node: DeviceBitmapNode) -> "MegaBitmapNode":
+        return cls(node.structure, list(node.leaves), node.slot)
+
+    # same rendering/digest as the staged node — the pool-key contract is
+    # shared, only the residency story differs
+    structure_sig = DeviceBitmapNode.structure_sig
+    digest = DeviceBitmapNode.digest
+
+    def leaf_col(self, j: int) -> str:
+        return f"__fleaf{self.slot}_{j}"
+
+    def signature(self) -> str:
+        return f"mega({self.slot}:{self.structure_sig()})"
+
+    def required_device_columns(self):
+        return set()
+
+    def words_traced(self, cols: Dict):
+        """Traced: the combined mask words (int32 [staged_rows/32]) — the
+        one-shot word algebra, inline in the program instead of the
+        separate fill dispatch. The algebra is
+        filters.combine_structure_words — the SAME evaluator the staged
+        fill program uses, so the two paths cannot drift."""
+        import jax.numpy as jnp
+
+        from druid_tpu.engine.filters import combine_structure_words
+
+        def leaf_words(i):
+            return cols[self.leaf_col(i)]
+
+        def const_words(value):
+            ref = cols[self.leaf_col(0)]
+            fill = jnp.int32(-1) if value else jnp.int32(0)
+            return jnp.full(ref.shape, fill, jnp.int32)
+
+        return combine_structure_words(self.structure, leaf_words,
+                                       const_words)
+
+    def build(self, cols, aux):
+        # XLA fallback (non-pallas strategies): combine words, then expand
+        # to row bools — still inside the ONE traced program; XLA fuses the
+        # expand into the mask consumers
+        w = self.words_traced(cols)
+        return expand_mask_words(w, cols["__valid"].shape[0])
+
+
+def collect_mega_nodes(node: Optional[FilterNode]) -> List[MegaBitmapNode]:
+    """Every MegaBitmapNode in a planned tree, deterministic DFS order."""
+    out: List[MegaBitmapNode] = []
+
+    def walk(n):
+        if isinstance(n, MegaBitmapNode):
+            out.append(n)
+        elif isinstance(n, (AndNode, OrNode)):
+            for c in n.children:
+                walk(c)
+        elif isinstance(n, NotNode):
+            walk(n.child)
+    if node is not None:
+        walk(node)
+    return out
+
+
+def split_for_kernel(node: Optional[FilterNode]
+                     ) -> Tuple[List[MegaBitmapNode], Optional[FilterNode]]:
+    """(top-level AND-conjunct mega nodes, residual row-domain tree).
+
+    Only mega nodes that are the root or direct AND conjuncts can combine
+    in the WORD domain with the program's base mask; any other placement
+    (under OR/NOT, or mixed deeper) stays in the residual tree and expands
+    to row bools via MegaBitmapNode.build — still one dispatch, just
+    without the in-kernel word-mask saving. The residual preserves child
+    order, so its aux-consumption order matches the full tree's (mega
+    nodes contribute no aux)."""
+    if node is None:
+        return [], None
+    if isinstance(node, MegaBitmapNode):
+        return [node], None
+    if isinstance(node, AndNode):
+        megas = [c for c in node.children if isinstance(c, MegaBitmapNode)]
+        rest = [c for c in node.children
+                if not isinstance(c, MegaBitmapNode)]
+        if not megas:
+            return [], node
+        residual = None if not rest else \
+            rest[0] if len(rest) == 1 else AndNode(rest)
+        return megas, residual
+    return [], node
+
+
+# ---------------------------------------------------------------------------
+# Planner hooks: megaize a planned tree / a kernel set
+# ---------------------------------------------------------------------------
+
+def megaize(filter_node: Optional[FilterNode], segment, padded_rows: int,
+            perm_dig: Optional[str] = None) -> Optional[FilterNode]:
+    """Rebuild a planned tree with every DeviceBitmapNode whose combined
+    words are NOT already pool-resident replaced by a MegaBitmapNode (the
+    one-shot inline path). Resident combined words — created by batched
+    waves or staged-mode runs; the mega path itself never materializes
+    them — keep the cached bit-test path instead of being re-derived.
+    A purely per-segment hot query therefore re-runs the inline word
+    algebra each time: a few word-wide VPU ops in-program, cheaper than
+    the fill dispatch it replaces either way."""
+    if filter_node is None or not collect_bitmap_nodes(filter_node):
+        return filter_node
+
+    def rebuild(n):
+        if isinstance(n, DeviceBitmapNode):
+            key = bitmap_pool_key(n, padded_rows, perm_dig)
+            if segment.device_contains(key):
+                _STATS.record_fallback()
+                return n
+            _STATS.record_hit()
+            return MegaBitmapNode.from_bitmap(n)
+        if isinstance(n, AndNode):
+            return AndNode([rebuild(c) for c in n.children])
+        if isinstance(n, OrNode):
+            return OrNode([rebuild(c) for c in n.children])
+        if isinstance(n, NotNode):
+            return NotNode(rebuild(n.child))
+        return n
+
+    return rebuild(filter_node)
+
+
+def megaize_kernels(kernels: Sequence, segment, padded_rows: int,
+                    perm_dig: Optional[str] = None) -> None:
+    """In-place megaize of every filtered-aggregator tree (kernels are
+    single-use per execution — grouping.GroupPlan contract)."""
+    from druid_tpu.engine.kernels import FilteredKernel
+    for k in kernels:
+        while isinstance(k, FilteredKernel):
+            k.filter_node = megaize(k.filter_node, segment, padded_rows,
+                                    perm_dig)
+            k = k.child
+
+
+def record_disabled_fallback(filter_node: Optional[FilterNode],
+                             kernels: Sequence = ()) -> None:
+    """Stats-only: bitmap subtrees that stay on the staged path because the
+    megakernel is disabled."""
+    n = len(collect_bitmap_nodes(filter_node))
+    for k in kernels:
+        for tree in k.filter_trees():
+            n += len(collect_bitmap_nodes(tree))
+    if n:
+        _STATS.record_fallback(n)
+
+
+# ---------------------------------------------------------------------------
+# Mask-word packing (host + traced) and leaf staging
+# ---------------------------------------------------------------------------
+
+_LANE = 128
+
+
+def staged_mask_rows(padded_rows: int) -> int:
+    """Row count mask/leaf word arrays are sized for: covers every pallas
+    row padding (n2 = round_up(max(rows, BLK), BLK) for BLK ≤ BLK_SMALL_W)
+    rounded to whole 128-lane word rows."""
+    return _round_up(max(padded_rows, BLK_SMALL_W), MEGA_MASK_ROW_ALIGN)
+
+
+def expand_mask_words(words, rows: int):
+    """Traced: width-1 tile-planar words → bool rows (the width-1 instance
+    of data/packed.unpack_device; exact, so fused and staged masks carry
+    identical bits)."""
+    import jax.numpy as jnp
+    w2 = words.reshape(-1, _LANE)
+    sh = jnp.arange(MEGA_MASK_VPW, dtype=jnp.int32)
+    bits = (w2[:, None, :] >> sh[None, :, None]) & jnp.int32(1)
+    return bits.reshape(-1)[:rows].astype(bool)
+
+
+def pack_mask_words_traced(mask):
+    """Traced: bool rows (length a multiple of MEGA_MASK_ROW_ALIGN) →
+    width-1 tile-planar int32 words. Disjoint bit positions, so the OR
+    fold is exact; XLA fuses the row-mask computation into this pack, so
+    no row-width mask materializes."""
+    import jax.numpy as jnp
+    m3 = mask.astype(jnp.int32).reshape(-1, MEGA_MASK_VPW, _LANE)
+    words = m3[:, 0, :]
+    for s in range(1, MEGA_MASK_VPW):
+        words = words | (m3[:, s, :] << jnp.int32(s))
+    return words.reshape(-1)
+
+
+def stage_mega_leaves(segment, filter_node: Optional[FilterNode],
+                      kernels: Sequence, padded_rows: int,
+                      perm: Optional[np.ndarray] = None,
+                      perm_key=None) -> Dict[str, object]:
+    """Resident per-leaf mask words for every MegaBitmapNode in the query
+    filter and the filtered-aggregator trees: {leaf col: int32 words}.
+    Pool-cached per (dim, lut digest, staged rows, permutation digest) —
+    the projection (permuted-layout) path stages PERMUTED words under its
+    own digest, so original-order and permuted layouts never mix."""
+    from druid_tpu.data import packed as packed_mod
+
+    nodes = collect_mega_nodes(filter_node)
+    for k in kernels:
+        for tree in k.filter_trees():
+            nodes.extend(collect_mega_nodes(tree))
+    if not nodes:
+        return {}
+    n_w = staged_mask_rows(padded_rows)
+    pdg = perm_digest(perm_key)
+    out: Dict[str, object] = {}
+    for node in nodes:
+        for j, (dim, lut) in enumerate(node.leaves):
+            key = ("megaleaf", dim, _leaf_digest(lut), n_w, pdg)
+
+            def _build(dim=dim, lut=lut):
+                import jax
+                col = segment.dims[dim]
+                bm = col.bitmap_index().union_of(np.flatnonzero(lut))
+                b = bm.to_bool()
+                if perm is not None:
+                    b = b[perm]
+                padded = np.zeros(n_w, dtype=bool)
+                padded[: b.shape[0]] = b
+                return jax.device_put(
+                    packed_mod.pack_padded(padded, MEGA_MASK_WIDTH, 0))
+
+            out[node.leaf_col(j)] = segment.device_cached(key, _build)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Donated carry buffers
+# ---------------------------------------------------------------------------
+
+def carry_defs(kernels: Sequence, col_dtypes: Dict, num_total: int,
+               span: int) -> List[Tuple[Tuple[int, int], object]]:
+    """[(shape, np dtype)] of the fused program's raw accumulator grids —
+    the donated-carry allocation spec. MUST equal mega_reduce's out_shapes
+    (both derive from pallas_agg.build_out_defs + plan_window)."""
+    ops = [k.pallas_op(col_dtypes) for k in kernels]
+    _, W = pallas_agg.plan_window(span)
+    G2 = _round_up(num_total, 128) + W
+    return [((G2 // 128, 128), dt)
+            for _, dt in pallas_agg.build_out_defs(ops)]
+
+
+def fresh_carries(defs: Sequence[Tuple[Tuple[int, int], object]]) -> Tuple:
+    """Zero host carries (the cold-tick donation placeholders). Content is
+    never read — the kernel re-initializes every grid at step 0 — so zeros
+    vs a prior tick's partials are bit-identical by construction."""
+    return tuple(np.zeros(shape, dtype=dt) for shape, dt in defs)
+
+
+# ---------------------------------------------------------------------------
+# The fused pallas program (strategy "megakernel")
+# ---------------------------------------------------------------------------
+
+def mega_reduce(arrays: Dict, mask, key, mega_nodes: Sequence[MegaBitmapNode],
+                kernels: Sequence, num_total: int, span: int,
+                packed_cols: Optional[Dict] = None):
+    """Traced: (counts, per-kernel states, raw accumulator grids).
+
+    pallas_agg.pallas_reduce's contract plus the fused-mask inputs: the
+    base row mask packs to words in-program, ANDs with each mega node's
+    inline word algebra, and the kernel unpacks ONE (1, 128) word tile per
+    block (sub-lane shifts at bit base (block % (32/R))·R) instead of
+    receiving a row mask — masked rows read the key sentinel exactly as
+    the staged kernel's keyx fold does, so results are bit-identical. The
+    raw grids ride back so the caller can park them as donated carries."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    col_dtypes = {c: a.dtype for c, a in arrays.items()}
+    ops = [k.pallas_op(col_dtypes) for k in kernels]
+    assert all(o is not None for o in ops), \
+        "megakernel strategy selected but a kernel has no pallas op"
+
+    BLK, W = pallas_agg.plan_window(span)
+    assert BLK, f"span {span} too wide for the pallas window"
+    R = BLK // 128
+    Wr = W // 128
+    BPW = MEGA_MASK_VPW // R            # blocks per mask word row
+    SENTINEL = jnp.int32(2**31 - 1)     # host-side key padding only
+
+    n = mask.shape[0]
+    n2 = _round_up(max(n, BLK), BLK)
+    n2m = _round_up(n2, MEGA_MASK_ROW_ALIGN)
+    G2 = _round_up(num_total, 128) + W
+    nblk = n2 // BLK
+
+    def pad_rows(a, fill):
+        if n2 == n:
+            return a
+        return jnp.concatenate([a, jnp.full((n2 - n,), fill, a.dtype)])
+
+    # the fused mask: base row mask (validity ∧ intervals ∧ residual
+    # filter) packs to words in-program; each top-level mega conjunct ANDs
+    # in the word domain. Padding rows pack as 0 bits — masked.
+    maskp = mask
+    if n2m != n:
+        maskp = jnp.concatenate(
+            [mask, jnp.zeros((n2m - n,), jnp.bool_)])
+    mwords = pack_mask_words_traced(maskp)
+    need_w = n2m // 32
+    for node in mega_nodes:
+        w = node.words_traced(arrays)
+        if w.shape[0] > need_w:
+            w = w[:need_w]
+        elif w.shape[0] < need_w:
+            # staged arrays cover staged_mask_rows(padded) ≥ n2m by
+            # construction; zero-fill is the safe (masked) default anyway
+            w = jnp.concatenate(
+                [w, jnp.zeros((need_w - w.shape[0],), w.dtype)])
+        mwords = mwords & w
+    mwords2 = mwords.reshape(n2m // MEGA_MASK_ROW_ALIGN, 128)
+
+    # keys stage RAW (no mask fold): the kernel sentinels masked rows from
+    # the word bits, reproducing the staged keyx = where(mask, key,
+    # SENTINEL) exactly
+    keyx = pad_rows(key.astype(jnp.int32), SENTINEL).reshape(n2 // 128, 128)
+
+    uniq_fields = pallas_agg.op_fields(ops)
+    pcs = {}
+    if packed_cols:
+        for f in uniq_fields:
+            pc = packed_cols.get(f)
+            if pc is not None and R % pc.vpw == 0 and pc.rows == n:
+                pcs[f] = pc
+    dense_fields = [f for f in uniq_fields if f not in pcs]
+    packed_fields = [f for f in uniq_fields if f in pcs]
+    field_ix = {f: i for i, f in enumerate(dense_fields + packed_fields)}
+    vals2 = [pad_rows(arrays[f], np.array(0, arrays[f].dtype))
+             .reshape(n2 // 128, 128) for f in dense_fields]
+    packed_desc = []
+    packed_rws = []
+    for f in packed_fields:
+        pc = pcs[f]
+        words = pc.words
+        pad_w = n2 // pc.vpw - words.shape[0]
+        if pad_w:
+            words = jnp.concatenate(
+                [words, jnp.zeros((pad_w,), words.dtype)])
+        vals2.append(words.reshape(n2 // pc.vpw // 128, 128))
+        packed_desc.append((pc.width, pc.vpw, pc.base))
+        packed_rws.append(R // pc.vpw)
+
+    K = None
+    for op in ops:
+        if op[0] == "sum_i32":
+            k_op = max(op[2] // BLK, 1)
+            K = k_op if K is None else min(K, k_op)
+
+    out_defs = pallas_agg.build_out_defs(ops)
+    slot_ix = {name: j for j, (name, _) in enumerate(out_defs)}
+    assert len(out_defs) == pallas_agg.op_slots(ops), \
+        "out_defs drifted from op_slots — update pallas_agg.build_out_defs"
+
+    def kernel(key_ref, mw_ref, *refs):
+        vrefs = refs[:len(uniq_fields)]
+        orefs = refs[len(uniq_fields):]
+        i = pl.program_id(0)
+
+        @pl.when(i == jnp.int32(0))
+        def _init():
+            for j, (name, dt) in enumerate(out_defs):
+                if name.startswith("m"):
+                    op = ops[int(name[1:])]
+                    if op[0] == "min_i32":
+                        ident = jnp.int32(2**31 - 1)
+                    elif op[0] == "max_i32":
+                        ident = jnp.int32(-(2**31))
+                    elif op[0] == "min_f32":
+                        ident = jnp.float32(jnp.inf)
+                    else:
+                        ident = jnp.float32(-jnp.inf)
+                    orefs[j][:, :] = jnp.full((G2 // 128, 128), ident)
+                else:
+                    orefs[j][:, :] = jnp.zeros((G2 // 128, 128), dt)
+
+        # sub-lane mask unpack: this block's R tile rows live in ONE word
+        # row at bit base (i % BPW)·R — a (1, 128) word tile expands to the
+        # (R, 128) bit tile with shifts along the sublane axis, no gather
+        wt = mw_ref[:, :]                          # (1, 128) int32
+        bit0 = (i % jnp.int32(BPW)) * jnp.int32(R)
+        sh = bit0 + jax.lax.broadcasted_iota(jnp.int32, (1, R, 128), 1)
+        mbit = ((wt[:, None, :] >> sh) & jnp.int32(1)).reshape(R, 128)
+
+        kb = key_ref[:, :]                         # (R, 128) int32
+        # the key sentinel is built INSIDE the kernel: a closure-captured
+        # jnp scalar is rejected as a captured tracer (the BENCH_r04
+        # constant-capture class)
+        kb = jnp.where(mbit > jnp.int32(0), kb, jnp.int32(2**31 - 1))
+        base = jnp.min(kb)
+        c128 = jnp.int32(128)
+        abase = (base // c128) * c128
+        abase = jnp.maximum(jnp.minimum(abase, jnp.int32(G2 - W)),
+                            jnp.int32(0))
+        local = kb - abase
+        r0 = abase // c128
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, 128, 128), 2)
+
+        vals_t = [vrefs[j][:, :] for j in range(len(dense_fields))]
+        for j, (wd, vpw, vbase) in enumerate(packed_desc):
+            pwt = vrefs[len(dense_fields) + j][:, :]
+            psh = jnp.int32(wd) * jax.lax.broadcasted_iota(
+                jnp.int32, (R // vpw, vpw, 128), 1)
+            pv = (pwt[:, None, :] >> psh) & jnp.int32((1 << wd) - 1)
+            if vbase:
+                pv = pv + jnp.int32(vbase)
+            vals_t.append(pv.reshape(R, 128))
+
+        for wr in range(Wr):
+            match = ((local - wr * 128)[:, :, None] == lane)
+            row = r0 + wr
+            cnt = jnp.sum(match.astype(jnp.int32), axis=(0, 1),
+                          dtype=jnp.int32)
+            cref = orefs[slot_ix["count"]]
+            cref[row, :] = cref[row, :] + cnt
+            for oi, op in enumerate(ops):
+                if op[0] in ("count", "zero", "empty"):
+                    continue
+                v = vals_t[field_ix[op[1]]]
+                if op[0] == "sum_i32":
+                    part = jnp.sum(jnp.where(match, v[:, :, None],
+                                             jnp.int32(0)),
+                                   axis=(0, 1), dtype=jnp.int32)
+                    ref = orefs[slot_ix[f"lo{oi}"]]
+                    ref[row, :] = ref[row, :] + part
+                elif op[0] == "sum_f32":
+                    part = jnp.sum(jnp.where(match, v[:, :, None],
+                                             jnp.float32(0)), axis=(0, 1),
+                                   dtype=jnp.float32)
+                    ref = orefs[slot_ix[f"f{oi}"]]
+                    ref[row, :] = ref[row, :] + part
+                else:
+                    kind = op[0]
+                    if kind == "min_i32":
+                        ident, red = jnp.int32(2**31 - 1), jnp.min
+                        comb = jnp.minimum
+                    elif kind == "max_i32":
+                        ident, red = jnp.int32(-(2**31)), jnp.max
+                        comb = jnp.maximum
+                    elif kind == "min_f32":
+                        ident, red = jnp.float32(jnp.inf), jnp.min
+                        comb = jnp.minimum
+                    else:
+                        ident, red = jnp.float32(-jnp.inf), jnp.max
+                        comb = jnp.maximum
+                    part = red(jnp.where(match, v[:, :, None], ident),
+                               axis=(0, 1))
+                    ref = orefs[slot_ix[f"m{oi}"]]
+                    ref[row, :] = comb(ref[row, :], part)
+
+        if K is not None:
+            @pl.when((i % jnp.int32(K)) == jnp.int32(K - 1))
+            def _flush():
+                for oi, op in enumerate(ops):
+                    if op[0] != "sum_i32":
+                        continue
+                    lo_ref = orefs[slot_ix[f"lo{oi}"]]
+                    hi_ref = orefs[slot_ix[f"hi{oi}"]]
+                    lo = lo_ref[:, :]
+                    hi_ref[:, :] = hi_ref[:, :] + (lo >> 16)
+                    lo_ref[:, :] = lo & 0xFFFF
+
+    out_shapes = [jax.ShapeDtypeStruct((G2 // 128, 128), dt)
+                  for _, dt in out_defs]
+    # index-map constants built typed inside the lambdas (the BENCH_r04
+    # Mosaic (i32, i64) func.return class; tracecheck guards it). The mask
+    # word tile's index map OVERLAPS deliberately: BPW consecutive blocks
+    # read the same (1, 128) word row at different bit bases.
+    grid_spec = pl.GridSpec(
+        grid=(nblk,),
+        in_specs=([pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)),
+                                memory_space=pltpu.VMEM)]
+                  + [pl.BlockSpec((1, 128),
+                                  lambda i: (i // BPW, jnp.int32(0)),
+                                  memory_space=pltpu.VMEM)]
+                  + [pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)),
+                                  memory_space=pltpu.VMEM)]
+                  * len(dense_fields)
+                  + [pl.BlockSpec((Rw, 128), lambda i: (i, jnp.int32(0)),
+                                  memory_space=pltpu.VMEM)
+                     for Rw in packed_rws]),
+        out_specs=[pl.BlockSpec((G2 // 128, 128),
+                                lambda i: (jnp.int32(0), jnp.int32(0)),
+                                memory_space=pltpu.VMEM)] * len(out_defs),
+    )
+    outs = pl.pallas_call(
+        kernel, out_shape=out_shapes, grid_spec=grid_spec,
+        interpret=pallas_agg._interpret(),
+    )(keyx, mwords2, *vals2)
+    flat = [o.reshape(-1)[:num_total] for o in outs]
+
+    counts = flat[slot_ix["count"]]
+    states = []
+    for oi, (k, op) in enumerate(zip(kernels, ops)):
+        if op[0] == "count":
+            states.append(counts)
+        elif op[0] == "sum_i32":
+            lo = flat[slot_ix[f"lo{oi}"]].astype(jnp.int64)
+            hi = flat[slot_ix[f"hi{oi}"]].astype(jnp.int64)
+            states.append((hi << 16) + lo)
+        elif op[0] == "sum_f32":
+            states.append(flat[slot_ix[f"f{oi}"]])
+        elif op[0] in ("min_i32", "max_i32", "min_f32", "max_f32"):
+            states.append(flat[slot_ix[f"m{oi}"]])
+        elif op[0] in ("zero", "empty"):
+            states.append(jnp.asarray(
+                np.broadcast_to(k.empty_state(1), (num_total,)).copy()))
+        else:  # pragma: no cover
+            raise AssertionError(f"unknown pallas op {op}")
+    return counts, tuple(states), tuple(outs)

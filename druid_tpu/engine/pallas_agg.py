@@ -116,6 +116,30 @@ def op_slots(ops: Sequence) -> int:
                    for op in ops)
 
 
+def build_out_defs(ops: Sequence) -> list:
+    """Authoritative output-slot layout for a plan's ops: [(name, np
+    dtype)], the counts grid leading. Shared by pallas_reduce, the fused
+    megakernel (engine/megakernel.py), and its donated-carry allocator, so
+    the three cannot drift; op_slots() (which usable() sized the plan with)
+    must agree — asserted at every consumer."""
+    out_defs = [("count", np.int32)]
+    for i, op in enumerate(ops):
+        if op[0] == "count":
+            pass                       # shares the leading counts grid
+        elif op[0] == "sum_i32":
+            out_defs.append((f"lo{i}", np.int32))
+            out_defs.append((f"hi{i}", np.int32))
+        elif op[0] == "sum_f32":
+            out_defs.append((f"f{i}", np.float32))
+        elif op[0] in ("min_i32", "max_i32"):
+            out_defs.append((f"m{i}", np.int32))
+        elif op[0] in ("min_f32", "max_f32"):
+            out_defs.append((f"m{i}", np.float32))
+        elif op[0] in ("zero", "empty"):
+            pass
+    return out_defs
+
+
 def usable(kernels: Sequence, col_dtypes: Dict, span: int,
            num_total: int) -> bool:
     if not backend_ok():
@@ -222,22 +246,9 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
             k_op = max(op[2] // BLK, 1)
             K = k_op if K is None else min(K, k_op)
 
-    # per-op output slots: (op index, slot kind)
-    out_defs = [("count", jnp.int32)]
-    for i, op in enumerate(ops):
-        if op[0] == "count":
-            pass                       # shares the leading counts grid
-        elif op[0] == "sum_i32":
-            out_defs.append((f"lo{i}", jnp.int32))
-            out_defs.append((f"hi{i}", jnp.int32))
-        elif op[0] == "sum_f32":
-            out_defs.append((f"f{i}", jnp.float32))
-        elif op[0] in ("min_i32", "max_i32"):
-            out_defs.append((f"m{i}", jnp.int32))
-        elif op[0] in ("min_f32", "max_f32"):
-            out_defs.append((f"m{i}", jnp.float32))
-        elif op[0] in ("zero", "empty"):
-            pass
+    # per-op output slots: (op index, slot kind) — the shared builder, so
+    # the megakernel's carry allocator sees exactly this layout
+    out_defs = build_out_defs(ops)
     slot_ix = {name: j for j, (name, _) in enumerate(out_defs)}
     # the builder above is authoritative; op_slots() (which usable() sized
     # the plan with) must agree, so a new op kind cannot drift between them

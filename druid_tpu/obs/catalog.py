@@ -129,6 +129,32 @@ METRICS = {
         "site": "server/scheduler.py (SchedulerMetricsMonitor)",
         "help": "per-dispatch events lost to the bounded event queue "
                 "(the crossBatch series undercounts by this many)"},
+    # ---- device dispatches (obs/dispatch.py) ---------------------------
+    "query/dispatch/count": {
+        "unit": "count/period", "dims": (),
+        "site": "obs/dispatch.py (DispatchMonitor)",
+        "help": "device-callable invocations on the query path since the "
+                "last tick (per-segment, batched, sharded, and "
+                "bitmap-fill programs; the megakernel's one-dispatch "
+                "contract is asserted on deltas of this counter)"},
+    # ---- fused megakernel (engine/megakernel.py) -----------------------
+    "query/megakernel/hits": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/megakernel.py (MegakernelMonitor)",
+        "help": "bitmap filter subtrees fused inline into the one-dispatch "
+                "megakernel program since the last tick"},
+    "query/megakernel/fallbacks": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/megakernel.py (MegakernelMonitor)",
+        "help": "bitmap filter subtrees that stayed on the staged "
+                "fill-wave path since the last tick (megakernel disabled, "
+                "or resident combined words already serve them)"},
+    "query/megakernel/donatedBytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "engine/megakernel.py (MegakernelMonitor)",
+        "help": "per-group partial-buffer bytes handed back DONATED across "
+                "repeated executions since the last tick (standing-query "
+                "ticks update partials in place, zero per-tick HBM churn)"},
     # ---- device filter-bitmap cache (engine/filters.py) ----------------
     "query/filter/deviceBitmapHits": {
         "unit": "count/period", "dims": (),

@@ -115,7 +115,7 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     # agree across segments
     filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns,
                                             device_bitmap=False))
-    kernels = [make_kernel(a, segments[0]) for a in aggs]
+    kernels = [make_kernel(a, segments[0], device_bitmap=False) for a in aggs]
     vc_plans, vc_luts = plan_virtual_columns(segments[0], virtual_columns)
     f_sig = filter_node.signature() if filter_node else "none"
     f_aux = filter_node.aux_arrays() if filter_node else []
@@ -127,7 +127,7 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
             return None
         if not _aux_equal(fn_s.aux_arrays() if fn_s else [], f_aux):
             return None
-        ks = [make_kernel(a, s) for a in aggs]
+        ks = [make_kernel(a, s, device_bitmap=False) for a in aggs]
         if [k.signature() for k in ks] != [k.signature() for k in kernels]:
             return None
         if not _aux_equal([a for k in ks for a in k.aux_arrays()], k_aux):
@@ -238,6 +238,8 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                 _FN_CACHE.popitem(last=False)
         else:
             _FN_CACHE.move_to_end(sig)
+    from druid_tpu.obs import dispatch as dispatch_mod
+    dispatch_mod.record("sharded")
     with trace_span("engine/sharded/dispatch", segments=K, devices=n_dev,
                     compile=compiled), \
             trace_span_when(compiled, "engine/compile", kind="sharded"):

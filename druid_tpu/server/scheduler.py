@@ -438,13 +438,19 @@ class DataNodeScheduler:
         t0 = time.monotonic()
         rows = sum(it.cost_rows for it in group)
         try:
+            from druid_tpu.obs import dispatch as dispatch_mod
+            d0 = dispatch_mod.count()
             with qtrace.attach(leader), \
                     qtrace.span("sched/flush", queries=len(group),
                                 segments=sum(len(it.segment_ids)
-                                             for it in group)):
+                                             for it in group)) as fsp:
                 results = self.node.run_partials_group(
                     [(it.query, it.segment_ids, it.check) for it in group],
                     on_batch=self.stats.record_cross_batch)
+                if fsp is not None:
+                    # the flush's whole-group dispatch bill: the megakernel
+                    # + cross-query fusion story in one span attribute
+                    fsp.attrs["dispatches"] = dispatch_mod.count() - d0
         except Exception as e:
             # run_partials_group isolates per-query failures; reaching
             # here is a scheduler-level defect — fail the group, keep

@@ -74,6 +74,19 @@ def test_bench_exits_zero_with_one_json_line():
     assert out["filter_device_rate"] > 0
     assert out["filter_speedup"] > 0
     assert out["filter_cache_hit_rate"] > 0
+    # the megakernel comparison. The HARD contract is the dispatch count:
+    # a cold fused query is exactly ONE device dispatch, the staged path
+    # pays the bitmap fill wave too. The rate gate is a noise floor only:
+    # on shared-CI CPU the fill dispatch costs ~1% of a cold iteration, so
+    # strict fused ≥ staged ordering is within timing noise — the ordering
+    # is asserted on real hardware (BENCH_r*), the same discipline as the
+    # filter-bench fields above.
+    assert out["fused_rate"] > 0
+    assert out["staged_rate"] > 0
+    assert out["fused_rate"] >= 0.9 * out["staged_rate"]
+    assert out["dispatch_count_fused"] == 1
+    assert out["dispatch_count_staged"] >= 2
+    assert out["donated_tick_rate"] > 0
     # the qtrace-overhead fields tracked across BENCH_r* runs
     assert out["traced_rate"] > 0
     assert out["untraced_rate"] > 0

@@ -45,9 +45,16 @@ def fb_segments():
 
 @pytest.fixture(autouse=True)
 def _bitmap_on():
+    # this module tests the STAGED device-bitmap path (fill wave + resident
+    # combined words); the megakernel would fuse cold per-segment filters
+    # inline and skip the combined-words cache entirely — its own behavior
+    # is covered by tests/test_megakernel.py
+    from druid_tpu.engine import megakernel
     prev = filters_mod.set_device_bitmap_enabled(True)
+    prev_mega = megakernel.set_enabled(False)
     yield
     filters_mod.set_device_bitmap_enabled(prev)
+    megakernel.set_enabled(prev_mega)
 
 
 def _rand_leaf(rng, seg):

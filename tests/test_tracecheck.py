@@ -19,6 +19,7 @@ from tools.druidlint.core import LintConfig  # noqa: E402
 from tools.druidlint.tracecheck import Sym, SymEval, load_contracts  # noqa: E402
 
 PALLAS = "druid_tpu/engine/pallas_agg.py"
+MEGA = "druid_tpu/engine/megakernel.py"
 ENGINE = "druid_tpu/engine/foo.py"
 KMOD = "druid_tpu/engine/kernels.py"
 
@@ -670,6 +671,15 @@ MUTATIONS = {
         "druid_tpu/engine/mmagg.py",
         "preferred_element_type=jnp.int32)", "),",
         "preferred-element-type"),
+    "mega-mask-tile-unaligned": (
+        # the megakernel's (1, 128) mask word tile: an unaligned last dim
+        # compiles on the interpreter but fails on-chip — lint must catch
+        "druid_tpu/engine/megakernel.py", "pl.BlockSpec((1, 128),",
+        "pl.BlockSpec((1, 120),", "pallas-tile-shape"),
+    "mega-key-sentinel-dtype": (
+        # the in-kernel masked-key sentinel must stay the int32 identity
+        "druid_tpu/engine/megakernel.py", "kb, jnp.int32(2**31 - 1))",
+        "kb, jnp.float32(2**31 - 1))", "pallas-accum-dtype"),
 }
 
 
@@ -923,6 +933,98 @@ def test_comprehension_specs_count_toward_budget():
         )
     """
     assert "vmem-budget" in rules_hit(src, PALLAS)
+
+
+def test_megakernel_full_program_shape_within_budget():
+    """The megakernel's whole in/out spec shape — key tile + (1, 128) mask
+    word tile + dense value tiles + packed word tiles + the full accum
+    grids — must fit the VMEM budget with every dim statically bounded
+    (the gate that made the BENCH_r04 class unrepeatable covers the new
+    kernel too)."""
+    src = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from druid_tpu.engine.contracts import MEGA_MASK_VPW
+
+    def build(span, num_total, dense_fields, packed_rws, out_defs):
+        BLK, W = plan_window(span)
+        R = BLK // 128
+        BPW = MEGA_MASK_VPW // R
+        G2 = _round_up(num_total, 128) + W
+        out_shapes = [jax.ShapeDtypeStruct((G2 // 128, 128), int)
+                      for _ in out_defs]
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=([pl.BlockSpec((R, 128), lambda i: (i, jnp.int32(0)))]
+                      + [pl.BlockSpec((1, 128),
+                                      lambda i: (i // BPW, jnp.int32(0)))]
+                      + [pl.BlockSpec((R, 128),
+                                      lambda i: (i, jnp.int32(0)))]
+                      * len(dense_fields)
+                      + [pl.BlockSpec((Rw, 128),
+                                      lambda i: (i, jnp.int32(0)))
+                         for Rw in packed_rws]),
+            out_specs=[pl.BlockSpec((G2 // 128, 128),
+                                    lambda i: (jnp.int32(0), jnp.int32(0)))]
+            * len(out_defs),
+        ), out_shapes
+    """
+    hits = check_source(textwrap.dedent(src), MEGA, cfg())
+    assert not [f for f in hits if f.rule in ("vmem-budget",
+                                              "pallas-tile-shape",
+                                              "pallas-accum-dtype")], hits
+
+
+def test_megakernel_oversized_mask_tile_flagged():
+    """A mask word tile scaled past the budget must still blow the cap —
+    the (1, 128) tile is a measured bound, not a waiver."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(num_total):
+        G2 = _round_up(num_total, 128) + 1024
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((G2 // 128 * 64, 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    assert "vmem-budget" in rules_hit(src, MEGA)
+
+
+def test_megakernel_accum_dtype_rules_active():
+    """pallas-accum-dtype covers the megakernel module: a drifted identity
+    dtype or an untyped index-map constant fails there exactly like in
+    pallas_agg."""
+    src = """
+    import jax.numpy as jnp
+    ident = jnp.float32(-(2**31))
+    """
+    assert "pallas-accum-dtype" in rules_hit(src, MEGA)
+    src2 = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    """
+    assert "pallas-accum-dtype" in rules_hit(src2, MEGA)
+
+
+def test_megakernel_x64_banned_in_kernel_body():
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(ref, out):
+        out[:, :] = ref[:, :].astype(jnp.int64)
+
+    def run(x):
+        return pl.pallas_call(kernel, out_shape=None)(x)
+    """
+    hits = check_source(textwrap.dedent(src), MEGA, cfg())
+    assert any(f.rule == "pallas-accum-dtype" and "kernel body" in f.message
+               for f in hits)
 
 
 def test_opaque_comprehension_multiplicity_flagged():

@@ -115,7 +115,8 @@ _DEFAULT_CONFIG = {
     # blocking resource (metadata.py's lock guards its one sqlite conn)
     "lock-scope-exclude": ["druid_tpu/cluster/metadata.py"],
     # tracecheck: modules holding pallas kernels (tile/accum/vmem rules)
-    "pallas-modules": ["druid_tpu/engine/pallas_agg.py"],
+    "pallas-modules": ["druid_tpu/engine/pallas_agg.py",
+                       "druid_tpu/engine/megakernel.py"],
     # tracecheck: modules defining AggKernel subclasses (agg-contract)
     "kernel-modules": ["druid_tpu/engine/kernels.py", "druid_tpu/ext/*"],
     # tracecheck: modules whose shard_map partition specs are checked
