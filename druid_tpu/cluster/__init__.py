@@ -21,6 +21,8 @@ from druid_tpu.cluster.dataserver import DataNodeServer, RemoteDataNodeClient
 from druid_tpu.cluster.lookups import (LookupCoordinatorManager,
                                        LookupNodeSync)
 from druid_tpu.cluster.realtime import RealtimeServer
+from druid_tpu.cluster.resilience import (BrokerResilience, PartialResult,
+                                          ResiliencePolicy)
 from druid_tpu.cluster.view import DataNode, InventoryView, descriptor_for
 
 __all__ = [
@@ -36,5 +38,6 @@ __all__ = [
     "PeriodLoadRule", "IntervalLoadRule", "ForeverDropRule", "PeriodDropRule",
     "IntervalDropRule", "rule_from_json", "DataNodeServer",
     "RemoteDataNodeClient", "RealtimeServer", "LookupCoordinatorManager",
-    "LookupNodeSync",
+    "LookupNodeSync", "ResiliencePolicy", "BrokerResilience",
+    "PartialResult",
 ]

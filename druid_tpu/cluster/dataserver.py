@@ -21,6 +21,7 @@ check runs before and after it).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
 import time
@@ -253,8 +254,14 @@ class DataNodeServer:
 
             def _partials(self, payload):
                 (ap, served), spans = self._run(payload, rows_mode=False)
+                # the explicit wire half of the partial-result contract:
+                # requested-but-unserved ids (the broker degrades on them
+                # when the query allows partials)
+                missing = [s for s in (payload.get("segments") or [])
+                           if str(s) not in served]
                 self._reply_bytes(wire.dumps_partials(ap, served,
-                                                      trace=spans))
+                                                      trace=spans,
+                                                      missing=missing))
 
             def _rows(self, payload):
                 (rows, served), spans = self._run(payload, rows_mode=True)
@@ -346,12 +353,17 @@ class RemoteDataNodeClient:
     the query's context timeout; cancel() propagates the DELETE."""
 
     def __init__(self, name: str, base_url: str,
-                 connect_timeout: float = 5.0):
+                 connect_timeout: float = 5.0,
+                 jitter_seed: Optional[int] = None):
+        """jitter_seed: seeds the Retry-After jitter rng (deterministic
+        tests); None draws from entropy, which is what production wants —
+        identical seeds across a client fleet would defeat the point."""
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.connect_timeout = connect_timeout
         self.tier = "_default_tier"
         self.alive = True
+        self._retry_rng = random.Random(jitter_seed)
 
     # ---- InventoryView/DataNode surface the broker touches -------------
     def segments(self) -> List:
@@ -444,11 +456,21 @@ class RemoteDataNodeClient:
                         retry_after = 1.0
                     # a drain estimate past the cap means the retry is
                     # near-certain to shed again — fail fast instead of
-                    # sleeping the cap and reissuing a doomed request
+                    # sleeping the cap and reissuing a doomed request.
+                    # The actual sleep is decorrelated-jittered ABOVE the
+                    # server's estimate: under a 429 storm every client
+                    # hears the same Retry-After, and sleeping it exactly
+                    # re-synchronizes the whole fleet onto one retry
+                    # instant — the next shed wave
+                    from druid_tpu.cluster.resilience import \
+                        decorrelated_jitter
+                    sleep_s = decorrelated_jitter(
+                        self._retry_rng, retry_after, retry_after,
+                        self.MAX_RETRY_AFTER_SLEEP)
                     if attempt == 0 \
                             and retry_after <= self.MAX_RETRY_AFTER_SLEEP \
-                            and time.monotonic() + retry_after < deadline:
-                        time.sleep(retry_after)
+                            and time.monotonic() + sleep_s < deadline:
+                        time.sleep(sleep_s)
                         continue
                     raise QueryCapacityError(
                         f"server [{self.name}] shed the query: {detail}",

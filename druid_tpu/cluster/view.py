@@ -463,13 +463,37 @@ class ReplicaSet:
     def pick(self, rng: random.Random,
              exclude: Optional[Set[str]] = None,
              strategy: Optional[ServerSelectorStrategy] = None,
-             view: Optional["InventoryView"] = None) -> Optional[str]:
+             view: Optional["InventoryView"] = None,
+             circuits=None) -> Optional[str]:
+        """`circuits` (resilience.CircuitRegistry): selection NEVER
+        returns an excluded server, and skips open-circuit servers that
+        are still cooling down. A cooled-down open server rejoins the
+        pool as the half-open PROBE candidate (picking it routes exactly
+        one query through and tags it via begin_probe — without this, a
+        sick server could never recover while a healthy replica keeps
+        absorbing the traffic). Only when EVERY candidate is open-and-
+        uncooled does selection fall back to an open server anyway,
+        tagged as a probe: a guaranteed no-replica failure is worse than
+        one fail-fast attempt on a sick server."""
         pool = sorted(self.servers - (exclude or set()))
         if not pool:
             return None
+        probe_set: Set[str] = set()
+        if circuits is not None:
+            closed = [s for s in pool if circuits.closed(s)]
+            cooled = [s for s in pool if circuits.probe_candidate(s)]
+            if closed or cooled:
+                pool = sorted(closed + cooled)
+                probe_set = set(cooled)
+            else:
+                probe_set = set(pool)      # all-open last resort
         if strategy is None:
-            return pool[rng.randrange(len(pool))]
-        return strategy.pick(pool, view, rng)
+            chosen = pool[rng.randrange(len(pool))]
+        else:
+            chosen = strategy.pick(pool, view, rng)
+        if chosen in probe_set:
+            circuits.begin_probe(chosen)
+        return chosen
 
 
 class InventoryView:
@@ -484,6 +508,7 @@ class InventoryView:
         self._probe_failures: Dict[str, int] = {}    # consecutive ping fails
         self._connections: Dict[str, int] = {}       # in-flight per server
         self._capacity_sheds: Dict[str, int] = {}    # cumulative 429s seen
+        self._latency_ewma: Dict[str, float] = {}    # per-server ms EWMA
         self._announce_seq = 0                       # monotonic, under lock
         self._lock = threading.RLock()
         self._listeners: List[Callable[[str, str, str], None]] = []
@@ -501,6 +526,22 @@ class InventoryView:
     def capacity_sheds(self, server: str) -> int:
         with self._lock:
             return self._capacity_sheds.get(server, 0)
+
+    # ---- latency accounting (hedged-request delay input) ---------------
+    def note_latency(self, server: str, wall_ms: float,
+                     alpha: float = 0.2) -> None:
+        """Feed one broker/node response time into the server's latency
+        EWMA — the broker reports every successful scatter call here, and
+        the hedge delay derives from the estimate (resilience.
+        BrokerResilience.hedge_delay_s)."""
+        with self._lock:
+            prev = self._latency_ewma.get(server)
+            self._latency_ewma[server] = wall_ms if prev is None \
+                else alpha * wall_ms + (1.0 - alpha) * prev
+
+    def latency_ms(self, server: str) -> Optional[float]:
+        with self._lock:
+            return self._latency_ewma.get(server)
 
     # ---- in-flight accounting (ConnectionCount strategy input) ---------
     def connection_started(self, server: str) -> None:

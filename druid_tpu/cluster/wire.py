@@ -172,11 +172,16 @@ def rebuild_kernels(agg_jsons: Sequence[dict]):
 # ---------------------------------------------------------------------------
 
 def dumps_partials(ap, served: Sequence[str] = (),
-                   trace: Sequence[dict] = ()) -> bytes:
+                   trace: Sequence[dict] = (),
+                   missing: Sequence[str] = ()) -> bytes:
     """Serialize AggregatePartials (+ the served-segment-id set the node is
     acknowledging, and the node's finished trace spans — plain JSON dicts —
     so the broker can assemble one end-to-end trace per query; both ride in
-    the same payload)."""
+    the same payload). `missing` makes the partial-result contract explicit
+    on the wire: segment ids the node was ASKED for but could not serve —
+    the broker's degradation report composes from these, and a
+    broker-of-brokers tier can propagate them without re-deriving the
+    requested set."""
     tt = _TensorTable()
     partials = []
     for p in ap.partials:
@@ -193,6 +198,7 @@ def dumps_partials(ap, served: Sequence[str] = (),
         "intervals": None if ap.intervals is None
         else [[iv.start, iv.end] for iv in ap.intervals],
         "served": sorted(served),
+        "missing": sorted(str(s) for s in missing),
         "trace": list(trace),
     }
     manifest, payload = tt.manifest_and_payload()
@@ -201,8 +207,22 @@ def dumps_partials(ap, served: Sequence[str] = (),
     return MAGIC + struct.pack("<BI", VERSION, len(hj)) + hj + payload
 
 
+class PartialsPayload(tuple):
+    """The decoded partials bundle: unpacks as the 3-tuple
+    (AggregatePartials, served ids, trace spans) every existing caller
+    expects, with the explicit partial-result report as `.missing`
+    (segment ids the node was asked for but could not serve; empty on a
+    complete response or a pre-missing-field peer)."""
+
+    def __new__(cls, ap, served, spans, missing=()):
+        self = super().__new__(cls, (ap, served, spans))
+        self.missing = sorted({str(s) for s in missing})
+        return self
+
+
 def loads_partials(data: bytes):
-    """Returns (AggregatePartials, served_segment_ids, trace_spans)."""
+    """Returns a PartialsPayload — unpackable as
+    (AggregatePartials, served_segment_ids, trace_spans)."""
     from druid_tpu.engine.engines import AggregatePartials
     from druid_tpu.engine.grouping import SegmentPartial
     from druid_tpu.utils.intervals import Interval
@@ -233,4 +253,6 @@ def loads_partials(data: bytes):
         spans=[tuple(s) for s in header["spans"]],
         intervals=None if intervals is None
         else tuple(Interval(a, b) for a, b in intervals))
-    return ap, set(header["served"]), list(header.get("trace") or ())
+    return PartialsPayload(ap, set(header["served"]),
+                           list(header.get("trace") or ()),
+                           missing=header.get("missing") or ())

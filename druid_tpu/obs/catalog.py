@@ -129,6 +129,42 @@ METRICS = {
         "site": "server/scheduler.py (SchedulerMetricsMonitor)",
         "help": "per-dispatch events lost to the bounded event queue "
                 "(the crossBatch series undercounts by this many)"},
+    # ---- broker fault tolerance (cluster/resilience.py) ----------------
+    "broker/circuit/open": {
+        "unit": "count", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "per-server circuit breakers currently open or half-open "
+                "(replica selection is skipping these servers)"},
+    "broker/circuit/trips": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "circuits tripped open since the last tick (consecutive "
+                "failures/sheds/timeouts crossed the threshold)"},
+    "broker/circuit/probes": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "half-open probe queries routed through an open circuit "
+                "since the last tick"},
+    "query/hedge/issued": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "speculative straggler re-issues sent since the last "
+                "tick (hedged requests)"},
+    "query/hedge/won": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "hedged requests that claimed their segments first since "
+                "the last tick"},
+    "query/hedge/cancelled": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "in-flight rivals remote-cancelled after losing a hedge "
+                "race since the last tick"},
+    "query/partial/missingSegments": {
+        "unit": "count/period", "dims": (),
+        "site": "cluster/resilience.py (ResilienceMetricsMonitor)",
+        "help": "segments reported missing in typed partial results "
+                "(allowPartialResults degradations) since the last tick"},
     # ---- device dispatches (obs/dispatch.py) ---------------------------
     "query/dispatch/count": {
         "unit": "count/period", "dims": (),

@@ -118,8 +118,16 @@ class QueryHttpServer:
                 _qc.on_query(ok)
             lifecycle.on_result = _chained
         self._installed_on_result = lifecycle.on_result
+        monitors = [self.query_counts]
+        resilience = getattr(lifecycle.runner, "resilience", None)
+        if resilience is not None:
+            # broker-backed lifecycles surface the fault-tolerance layer
+            # (broker/circuit/*, query/hedge/*, query/partial/*)
+            from druid_tpu.cluster.resilience import \
+                ResilienceMetricsMonitor
+            monitors.append(ResilienceMetricsMonitor(resilience))
         self._monitors = MonitorScheduler(
-            scrape_emitter, [self.query_counts],
+            scrape_emitter, monitors,
             period_seconds=monitor_period_seconds)
         outer = self
 
@@ -316,13 +324,21 @@ class QueryHttpServer:
                             return
                         cols, rows = outer.sql_executor.execute(
                             payload["query"],
-                            payload.get("parameters") or ())
+                            payload.get("parameters") or (),
+                            payload.get("context") or None)
+                        # SQL surface of the partial-result contract:
+                        # the shaped rows stay typed through the executor
+                        missing = getattr(rows, "missing_segments", None)
+                        headers = None if missing is None else {
+                            "X-Druid-Response-Context": json.dumps(
+                                {"partial": True,
+                                 "missingSegments": missing})}
                         fmt = payload.get("resultFormat", "object")
                         if fmt == "array":
-                            self._reply(200, rows)
+                            self._reply(200, list(rows), headers)
                         else:
                             self._reply(200, [dict(zip(cols, r))
-                                              for r in rows])
+                                              for r in rows], headers)
                     elif self.path.rstrip("/") == "/druid/v2":
                         if payload.get("queryType") == "scan" and \
                                 "application/x-ndjson" in (
@@ -354,8 +370,25 @@ class QueryHttpServer:
                             return
                         rows = outer.lifecycle.run(query,
                                                    identity=identity)
-                        self._reply(200, rows,
-                                    {"X-Druid-ETag": etag} if etag else None)
+                        headers = {}
+                        # a degraded result (allowPartialResults) stamps
+                        # its missing-segments report on the response
+                        # context header — the contract is EXPLICIT,
+                        # exactly once, never a silent hole in the rows.
+                        # It must NOT carry the ETag: the etag names the
+                        # COMPLETE result over this segment set, and a
+                        # client caching the partial body against it
+                        # would be confirmed 304-fresh forever after the
+                        # cluster heals — the conditional-request twin of
+                        # 'partials never populate the result cache'
+                        missing = getattr(rows, "missing_segments", None)
+                        if missing is not None:
+                            headers["X-Druid-Response-Context"] = \
+                                json.dumps({"partial": True,
+                                            "missingSegments": missing})
+                        elif etag:
+                            headers["X-Druid-ETag"] = etag
+                        self._reply(200, rows, headers or None)
                     else:
                         self._reply(404, {"error": "unknown path"})
                 except Unauthorized as e:

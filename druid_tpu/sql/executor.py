@@ -191,35 +191,59 @@ class SqlExecutor:
             sel, on_where=on_in, on_other=_reject_in,
             on_subselect=lambda s: self._stub_semijoins(s, sub_plans))
 
-    def execute(self, sql: str, parameters: Sequence[object] = ()
+    def execute(self, sql: str, parameters: Sequence[object] = (),
+                context: Optional[Dict] = None
                 ) -> Tuple[List[str], List[list]]:
         """Returns (column names, rows as lists) — the SQL resource's
-        array-result format."""
+        array-result format. `context` (the SQL payload's "context"
+        object, reference SqlQuery.context) merges into the planned
+        native query's context: queryId, timeout, allowPartialResults
+        and the other data-plane flags reach the broker. Semi-join
+        INNER subqueries deliberately do NOT inherit it — a silently
+        partial inner row set would corrupt the outer result, exactly
+        the failure mode allowPartialResults must never cause."""
         stmt = parse_sql(sql, parameters)
         if stmt.explain:
             import json as _json
             planned_json = self.explain(_strip_explain(sql), parameters)
             return (["PLAN"], [[_json.dumps(planned_json, sort_keys=True)]])
         if isinstance(stmt, Union):
-            return self._execute_union(stmt)
-        return self._execute_select(stmt, 0)
+            return self._execute_union(stmt, context)
+        return self._execute_select(stmt, 0, context)
 
-    def _execute_select(self, sel: Select, depth: int
+    def _execute_select(self, sel: Select, depth: int,
+                        context: Optional[Dict] = None
                         ) -> Tuple[List[str], List[list]]:
         planned = self._plan(self._expand_select(sel, depth))
         if planned.meta_table is not None:
             return self._run_meta(planned)
-        rows = self.qe.run(planned.native)
-        return self._shape(planned, rows)
+        native = planned.native
+        if context:
+            from dataclasses import replace as _replace
+            native = _replace(native, context=tuple(sorted(
+                {**native.context_map, **dict(context)}.items())))
+        rows = self.qe.run(native)
+        cols, shaped = self._shape(planned, rows)
+        missing = getattr(rows, "missing_segments", None)
+        if missing is not None:
+            # a degraded native result (allowPartialResults) stays typed
+            # through SQL shaping: the report must reach the SQL client,
+            # never vanish into an ordinary row list
+            from druid_tpu.cluster.resilience import PartialResult
+            shaped = PartialResult(shaped, missing)
+        return cols, shaped
 
-    def _execute_union(self, un: Union) -> Tuple[List[str], List[list]]:
+    def _execute_union(self, un: Union,
+                       context: Optional[Dict] = None
+                       ) -> Tuple[List[str], List[list]]:
         """Arms execute independently and concatenate; union-level ORDER
         BY/LIMIT apply to the combined rows; column names come from the
         first arm (reference: DruidUnionRel)."""
         names: Optional[List[str]] = None
         rows: List[list] = []
+        missing: List[str] = []
         for arm in un.arms:
-            cols, arm_rows = self._execute_select(arm, 0)
+            cols, arm_rows = self._execute_select(arm, 0, context)
             if names is None:
                 names = cols
             elif len(cols) != len(names):
@@ -227,6 +251,7 @@ class SqlExecutor:
                     "UNION ALL arms must select the same number of columns "
                     f"({len(names)} vs {len(cols)})")
             rows.extend(arm_rows)
+            missing.extend(getattr(arm_rows, "missing_segments", ()))
         for oi in reversed(un.order_by):
             ix = self._union_order_index(oi, names)
             rows.sort(key=lambda r: _order_key(r[ix]),
@@ -235,6 +260,11 @@ class SqlExecutor:
             rows = rows[un.offset:
                         un.offset + un.limit if un.limit is not None
                         else None]
+        if missing:
+            # one arm degrading degrades the union — typed, with the
+            # combined report
+            from druid_tpu.cluster.resilience import PartialResult
+            rows = PartialResult(rows, missing)
         return names, rows
 
     @staticmethod
@@ -262,9 +292,10 @@ class SqlExecutor:
             _collect_tables(arm, tables, meta)
         return sorted(tables), meta[0]
 
-    def execute_dicts(self, sql: str, parameters: Sequence[object] = ()
+    def execute_dicts(self, sql: str, parameters: Sequence[object] = (),
+                      context: Optional[Dict] = None
                       ) -> List[dict]:
-        cols, rows = self.execute(sql, parameters)
+        cols, rows = self.execute(sql, parameters, context)
         return [dict(zip(cols, r)) for r in rows]
 
     # ---- result shaping (QueryMaker analog) ---------------------------

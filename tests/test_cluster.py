@@ -645,3 +645,65 @@ def test_etag_denied_identity_gets_403_not_304(cluster, segments):
         r4.read()
     finally:
         srv.stop()
+
+
+def test_replica_pick_fuzz_exclusions_and_circuits():
+    """Fuzz ReplicaSet.pick over random member/exclusion/breaker states:
+    it never returns an excluded server; it never returns a still-cooling
+    open-circuit server while a closed or cooled (probe-eligible)
+    alternative exists; a cooled pick is tagged as the half-open probe;
+    and only when ALL candidates are open-and-uncooled does it fall back
+    to an open server — likewise tagged as a probe."""
+    import random as _random
+
+    from druid_tpu.cluster.resilience import (HALF_OPEN, CircuitRegistry,
+                                              ResiliencePolicy)
+    from druid_tpu.cluster.view import ReplicaSet
+
+    rng = _random.Random(123)
+    servers_all = [f"s{i}" for i in range(6)]
+    for trial in range(400):
+        now = [0.0]
+        reg = CircuitRegistry(
+            ResiliencePolicy(circuit_failure_threshold=1,
+                             circuit_cooldown_s=5.0,
+                             circuit_cooldown_cap_s=5.0),
+            seed=trial, clock=lambda: now[0])
+        rs = ReplicaSet(descriptor=None)
+        members = set(rng.sample(servers_all, rng.randint(1, 6)))
+        rs.servers = set(members)
+        exclude = set(rng.sample(sorted(members),
+                                 rng.randint(0, len(members))))
+        cooled_open, cooling_open = set(), set()
+        for s in sorted(members):
+            r = rng.random()
+            if r < 0.3:
+                cooled_open.add(s)
+            elif r < 0.55:
+                cooling_open.add(s)
+        now[0] = 0.0
+        for s in sorted(cooled_open):
+            reg.on_failure(s)            # cooldown ends at t=5
+        now[0] = 6.0
+        for s in sorted(cooling_open):
+            reg.on_failure(s)            # cooldown ends at t=11
+        # at t=6: cooled_open are probe candidates, cooling_open are not
+        chosen = rs.pick(rng, exclude=exclude, circuits=reg)
+        candidates = members - exclude
+        if not candidates:
+            assert chosen is None
+            continue
+        assert chosen in candidates, "picked an excluded/foreign server"
+        closed_c = candidates - cooled_open - cooling_open
+        cooled_c = candidates & cooled_open
+        if closed_c or cooled_c:
+            assert chosen in closed_c | cooled_c, \
+                "picked a still-cooling open server over alternatives"
+            if chosen in cooled_c:
+                assert reg.state_of(chosen) == HALF_OPEN, \
+                    "cooled-open pick not tagged as the probe"
+        else:
+            # every candidate's circuit is open and cooling: fallback,
+            # tagged as a probe
+            assert reg.state_of(chosen) == HALF_OPEN
+            assert reg.snapshot()["probes"] >= 1

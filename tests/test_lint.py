@@ -123,6 +123,14 @@ VIOLATIONS = {
         "druid_tpu/cluster/anything.py",
         "def f(emitter):\n"
         "    emitter.metric(\"query/typo/time\", 1.0)\n"),
+    "unbounded-retry": (
+        "druid_tpu/cluster/anything.py",
+        "def fetch(self):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return self._get()\n"
+        "        except ConnectionError:\n"
+        "            continue\n"),
     # ---- tracecheck rules ----
     "pallas-tile-shape": (
         "druid_tpu/engine/pallas_agg.py",

@@ -380,6 +380,178 @@ def test_compute_under_lock_ok():
 
 # ---- suppression ----------------------------------------------------------
 
+# ---- unbounded-retry ------------------------------------------------------
+
+RETRY_MOD = "druid_tpu/cluster/client.py"
+
+
+def test_unbounded_while_retry_flagged():
+    src = """
+    def fetch(self):
+        while True:
+            try:
+                return self._get()
+            except ConnectionError:
+                continue
+    """
+    assert "unbounded-retry" in rules_hit(src, RETRY_MOD)
+
+
+def test_unbounded_fallthrough_retry_flagged():
+    """Retry by falling through (no explicit continue) is still a retry."""
+    src = """
+    import time
+    def fetch(self):
+        while True:
+            try:
+                return self._get()
+            except OSError:
+                time.sleep(0.1)
+    """
+    assert "unbounded-retry" in rules_hit(src, RETRY_MOD)
+
+
+def test_unbounded_for_over_call_retry_flagged():
+    src = """
+    def fetch(self, plan):
+        for attempt in plan():
+            try:
+                return self._get()
+            except TimeoutError:
+                continue
+    """
+    assert "unbounded-retry" in rules_hit(src, RETRY_MOD)
+
+
+def test_bounded_range_retry_ok():
+    src = """
+    def fetch(self):
+        for _ in range(self.max_retries + 1):
+            try:
+                return self._get()
+            except ConnectionError:
+                continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_bounded_literal_tuple_retry_ok():
+    """The client's `for attempt in (0, 1)` idiom."""
+    src = """
+    def fetch(self):
+        for attempt in (0, 1):
+            try:
+                return self._get()
+            except ConnectionError:
+                if attempt:
+                    raise
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_deadline_consult_bounds_while_retry():
+    src = """
+    def fetch(self, deadline):
+        while True:
+            deadline.check()
+            try:
+                return self._get()
+            except ConnectionError:
+                continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_condition_bounded_while_retry_ok():
+    src = """
+    def fetch(self):
+        attempt = 0
+        while attempt < self.max_retries:
+            attempt += 1
+            try:
+                return self._get()
+            except ConnectionError:
+                continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_handler_that_always_raises_is_not_a_retry():
+    src = """
+    def fetch(self):
+        while True:
+            try:
+                self._step()
+            except ConnectionError:
+                raise RuntimeError("fatal")
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_nested_bounded_loop_does_not_shield_outer():
+    """The retrying handler belongs to the INNER loop it sits in — a
+    bounded inner loop must not excuse an unbounded outer, and vice
+    versa the outer must not claim the inner's handler."""
+    src = """
+    def fetch(self):
+        while True:
+            for _ in range(2):
+                try:
+                    self._step()
+                except ConnectionError:
+                    continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_broad_except_is_not_this_rules_business():
+    src = """
+    def sync_all(self):
+        while True:
+            try:
+                self._sync()
+            except Exception:
+                self.log.exception("sync failed")
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
+def test_unbounded_retry_outside_retry_modules_ok():
+    src = """
+    def fetch(self):
+        while True:
+            try:
+                return self._get()
+            except ConnectionError:
+                continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, "druid_tpu/engine/x.py")
+
+
+def test_unbounded_retry_capacity_and_tuple_types():
+    src = """
+    def fetch(self):
+        while True:
+            try:
+                return self._get()
+            except (QueryCapacityError, socket.timeout):
+                continue
+    """
+    assert "unbounded-retry" in rules_hit(src, RETRY_MOD)
+
+
+def test_unbounded_retry_suppression():
+    src = """
+    def fetch(self):
+        while True:
+            try:
+                return self._get()
+            except ConnectionError:  # druidlint: disable=unbounded-retry
+                continue
+    """
+    assert "unbounded-retry" not in rules_hit(src, RETRY_MOD)
+
+
 def test_inline_suppression_silences_named_rule():
     src = """
     def f():

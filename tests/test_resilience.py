@@ -1,0 +1,532 @@
+"""Unit tests for the broker's data-plane fault-tolerance layer
+(cluster/resilience.py): circuit breakers, decorrelated jitter, typed
+partial results, latency EWMA feedback, metrics monitor, and the wire /
+HTTP / SQL surfaces of the partial-result contract."""
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from druid_tpu.cluster import (Broker, DataNode, InventoryView,
+                               PartialResult, ResiliencePolicy,
+                               descriptor_for)
+from druid_tpu.cluster.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                          BrokerResilience, CircuitBreaker,
+                                          CircuitRegistry,
+                                          ResilienceMetricsMonitor,
+                                          decorrelated_jitter)
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.utils.intervals import Interval
+
+WEEK = Interval.of("2026-01-01", "2026-01-08")
+AGGS = [CountAggregator("rows"), LongSumAggregator("ls", "metLong")]
+
+
+# ---------------------------------------------------------------------------
+# decorrelated jitter
+# ---------------------------------------------------------------------------
+
+def test_jitter_within_bounds_and_decorrelated():
+    rng = random.Random(0)
+    prev = 1.0
+    sleeps = []
+    for _ in range(200):
+        s = decorrelated_jitter(rng, 1.0, prev, 30.0)
+        assert 1.0 <= s <= 30.0
+        sleeps.append(s)
+        prev = s
+    # decorrelation: the sleeps spread out instead of repeating one value
+    assert len({round(s, 6) for s in sleeps}) > 100
+    assert max(sleeps) > 2.0
+
+
+def test_jitter_respects_cap_and_base():
+    rng = random.Random(1)
+    for _ in range(100):
+        assert decorrelated_jitter(rng, 5.0, 100.0, 8.0) <= 8.0
+        assert decorrelated_jitter(rng, 5.0, 0.0, 8.0) >= 5.0
+    # base above cap clamps to cap, never negative range
+    assert decorrelated_jitter(rng, 50.0, 1.0, 8.0) == pytest.approx(8.0)
+
+
+def test_jitter_deterministic_under_seed():
+    a = [decorrelated_jitter(random.Random(7), 1.0, 1.0, 10.0)
+         for _ in range(3)]
+    b = [decorrelated_jitter(random.Random(7), 1.0, 1.0, 10.0)
+         for _ in range(3)]
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers
+# ---------------------------------------------------------------------------
+
+def _clocked_registry(threshold=3, cooldown=5.0):
+    now = [0.0]
+    reg = CircuitRegistry(
+        ResiliencePolicy(circuit_failure_threshold=threshold,
+                         circuit_cooldown_s=cooldown,
+                         circuit_cooldown_cap_s=cooldown * 6),
+        seed=0, clock=lambda: now[0])
+    return reg, now
+
+
+def test_breaker_opens_after_consecutive_failures():
+    reg, now = _clocked_registry(threshold=3)
+    for _ in range(2):
+        reg.on_failure("s1")
+    assert reg.state_of("s1") == CLOSED and reg.closed("s1")
+    reg.on_failure("s1")
+    assert reg.state_of("s1") == OPEN and not reg.closed("s1")
+    assert reg.snapshot() == {"open": 1, "trips": 1, "probes": 0}
+
+
+def test_success_resets_consecutive_count():
+    reg, _ = _clocked_registry(threshold=3)
+    reg.on_failure("s1")
+    reg.on_failure("s1")
+    reg.on_success("s1")
+    reg.on_failure("s1")
+    reg.on_failure("s1")
+    assert reg.state_of("s1") == CLOSED   # never 3 consecutive
+
+
+def test_half_open_probe_cycle():
+    reg, now = _clocked_registry(threshold=1, cooldown=5.0)
+    reg.on_failure("s1")
+    assert reg.state_of("s1") == OPEN
+    assert not reg.probe_candidate("s1"), "cooldown not elapsed"
+    now[0] = 100.0                        # jittered cooldown ≤ 6x base
+    assert reg.probe_candidate("s1")
+    reg.begin_probe("s1")
+    assert reg.state_of("s1") == HALF_OPEN
+    assert not reg.probe_candidate("s1"), "one probe in flight"
+    reg.on_success("s1")
+    assert reg.state_of("s1") == CLOSED
+    assert reg.snapshot()["probes"] == 1
+
+
+def test_half_open_failure_reopens_with_fresh_cooldown():
+    reg, now = _clocked_registry(threshold=1, cooldown=5.0)
+    reg.on_failure("s1")
+    now[0] = 100.0
+    reg.begin_probe("s1")
+    reg.on_failure("s1")                  # the probe failed
+    assert reg.state_of("s1") == OPEN
+    assert not reg.probe_candidate("s1"), "fresh cooldown started"
+    assert reg.snapshot()["trips"] == 2
+
+
+def test_cooldown_is_jittered_decorrelated():
+    """Successive trips draw different cooldowns in [base, cap]."""
+    pol = ResiliencePolicy(circuit_failure_threshold=1,
+                           circuit_cooldown_s=1.0,
+                           circuit_cooldown_cap_s=30.0)
+    b = CircuitBreaker(pol, random.Random(3), clock=lambda: 0.0)
+    spans = []
+    for _ in range(20):
+        b.trip()
+        assert 1.0 <= b._cooldown_until <= 30.0
+        spans.append(b._cooldown_until)
+    assert len(set(spans)) > 10
+
+
+def test_disabled_policy_keeps_everything_closed():
+    reg = CircuitRegistry(ResiliencePolicy(circuit_enabled=False), seed=0)
+    for _ in range(10):
+        reg.on_failure("s1")
+    assert reg.closed("s1")
+
+
+# ---------------------------------------------------------------------------
+# view: latency EWMA + circuit-aware pick (unit)
+# ---------------------------------------------------------------------------
+
+def test_view_latency_ewma():
+    view = InventoryView()
+    assert view.latency_ms("a") is None
+    view.note_latency("a", 100.0, alpha=0.5)
+    assert view.latency_ms("a") == 100.0
+    view.note_latency("a", 50.0, alpha=0.5)
+    assert view.latency_ms("a") == pytest.approx(75.0)
+
+
+def test_hedge_delay_derives_from_ewma():
+    view = InventoryView()
+    res = BrokerResilience(ResiliencePolicy(hedge_min_delay_ms=50,
+                                            hedge_latency_multiplier=3.0))
+    assert res.hedge_delay_s(view, "a") == pytest.approx(0.05)
+    view.note_latency("a", 200.0, alpha=1.0)
+    assert res.hedge_delay_s(view, "a") == pytest.approx(0.6)
+
+
+# ---------------------------------------------------------------------------
+# typed partial results
+# ---------------------------------------------------------------------------
+
+def test_partial_result_is_a_typed_list():
+    rows = [{"a": 1}, {"a": 2}]
+    p = PartialResult(rows, ["seg2", "seg1", "seg2"])
+    assert list(p) == rows and len(p) == 2
+    assert p.missing_segments == ["seg1", "seg2"], "sorted AND deduped"
+    assert p.response_context() == {"partial": True,
+                                    "missingSegments": ["seg1", "seg2"]}
+    assert json.dumps(p)                  # serializes like a plain list
+
+
+# ---------------------------------------------------------------------------
+# wire surface of the partial contract
+# ---------------------------------------------------------------------------
+
+def test_wire_round_trips_missing_report(segments):
+    from druid_tpu.cluster import wire
+    from druid_tpu.engine.engines import make_aggregate_partials
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    ap = make_aggregate_partials(q, segments[:1])
+    data = wire.dumps_partials(ap, served=[str(segments[0].id)],
+                               missing=["lost-b", "lost-a"])
+    payload = wire.loads_partials(data)
+    got_ap, served, spans = payload       # 3-tuple unpack preserved
+    assert served == {str(segments[0].id)}
+    assert payload.missing == ["lost-a", "lost-b"]
+    # a pre-missing-field payload still loads (empty report)
+    legacy = wire.dumps_partials(ap, served=[str(segments[0].id)])
+    assert wire.loads_partials(legacy).missing == []
+
+
+# ---------------------------------------------------------------------------
+# broker integration: circuits + partials + EWMA feedback
+# ---------------------------------------------------------------------------
+
+class _DeadNode(DataNode):
+    def __init__(self, name):
+        super().__init__(name)
+        self.calls = 0
+
+    def run_partials(self, query, segment_ids, check=None):
+        self.calls += 1
+        raise ConnectionError(f"[{self.name}] down")
+
+
+def _two_replica_cluster(segments, policy=None, seed=0):
+    view = InventoryView()
+    dead = _DeadNode("dead")
+    good = DataNode("good")
+    for n in (dead, good):
+        view.register(n)
+        for s in segments:
+            n.load_segment(s)
+            view.announce(n.name, descriptor_for(s))
+    return view, dead, good, Broker(view, seed=seed,
+                                    resilience_policy=policy)
+
+
+def test_broker_opens_circuit_and_stops_paying_the_dead_node(segments):
+    pol = ResiliencePolicy(circuit_failure_threshold=2,
+                           circuit_cooldown_s=60.0,
+                           circuit_cooldown_cap_s=60.0,
+                           hedge_enabled=False)
+    view, dead, good, broker = _two_replica_cluster(segments, pol)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    expect = QueryExecutor(segments).run(q)
+    for _ in range(12):
+        assert broker.run(q) == expect
+    # once the circuit trips, replica selection skips the dead server —
+    # call volume stays at the handful it took to trip, not one per query
+    assert broker.resilience.circuits.state_of("dead") == OPEN
+    calls_at_trip = dead.calls
+    for _ in range(5):
+        assert broker.run(q) == expect
+    assert dead.calls == calls_at_trip
+    broker.stop()
+
+
+def test_broker_half_open_probe_recovers(segments):
+    pol = ResiliencePolicy(circuit_failure_threshold=1,
+                           circuit_cooldown_s=0.01,
+                           circuit_cooldown_cap_s=0.02,
+                           hedge_enabled=False)
+    view, dead, good, broker = _two_replica_cluster(segments, pol)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    expect = QueryExecutor(segments).run(q)
+    for _ in range(3):
+        assert broker.run(q) == expect
+    assert broker.resilience.circuits.state_of("dead") == OPEN
+    # heal the node; after the (tiny) cooldown a probe rides through and
+    # closes the circuit
+    dead.run_partials = lambda query, sids, check=None: \
+        DataNode.run_partials(dead, query, sids, check=check)
+    time.sleep(0.05)
+    for _ in range(20):
+        assert broker.run(q) == expect
+        if broker.resilience.circuits.state_of("dead") == CLOSED:
+            break
+    assert broker.resilience.circuits.state_of("dead") == CLOSED
+    assert broker.resilience.circuits.snapshot()["probes"] >= 1
+    broker.stop()
+
+
+def test_broker_partial_results_on_exhausted_replicas(segments):
+    view = InventoryView()
+    only = _DeadNode("only")
+    live = DataNode("live")
+    view.register(only)
+    view.register(live)
+    # half the segments ONLY on the dead node, half on the live one
+    for i, s in enumerate(segments):
+        n = only if i % 2 == 0 else live
+        n.load_segment(s)
+        view.announce(n.name, descriptor_for(s))
+    broker = Broker(view)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS,
+                           context={"allowPartialResults": True})
+    rows = broker.run(q)
+    assert isinstance(rows, PartialResult)
+    lost = {str(s.id) for i, s in enumerate(segments) if i % 2 == 0}
+    assert set(rows.missing_segments) == lost
+    # bit-parity over the SURVIVING path: rows == oracle minus missing
+    survivors = [s for i, s in enumerate(segments) if i % 2 == 1]
+    assert list(rows) == QueryExecutor(survivors).run(q)
+    # partials are counted, exactly once
+    snap = broker.resilience.stats.snapshot()
+    assert snap["partial_queries"] == 1
+    assert snap["partial_missing_segments"] == len(lost)
+    broker.stop()
+
+
+def test_partial_never_populates_result_cache(segments):
+    from druid_tpu.cluster import LruCache
+    view = InventoryView()
+    flaky = _DeadNode("flaky")
+    view.register(flaky)
+    for s in segments:
+        flaky.load_segment(s)
+        view.announce("flaky", descriptor_for(s))
+    broker = Broker(view, cache=LruCache())
+    q = TimeseriesQuery.of("test", [WEEK], AGGS,
+                           context={"allowPartialResults": True})
+    rows = broker.run(q)
+    assert isinstance(rows, PartialResult) and list(rows) == []
+    # heal: the next run must NOT be served the cached hole
+    flaky.run_partials = lambda query, sids, check=None: \
+        DataNode.run_partials(flaky, query, sids, check=check)
+    # circuit may still be open — probe fallback serves it
+    expect = QueryExecutor(segments).run(q)
+    got = None
+    for _ in range(10):
+        got = broker.run(q)
+        if not getattr(got, "missing_segments", None):
+            break
+    assert list(got) == expect
+    assert getattr(got, "missing_segments", None) is None
+    broker.stop()
+
+
+def test_strict_mode_unchanged_without_context_flag(segments):
+    from druid_tpu.cluster import MissingSegmentsError
+    view = InventoryView()
+    only = _DeadNode("only")
+    view.register(only)
+    for s in segments:
+        only.load_segment(s)
+        view.announce("only", descriptor_for(s))
+    broker = Broker(view)
+    with pytest.raises(MissingSegmentsError):
+        broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+    broker.stop()
+
+
+def test_broker_feeds_latency_ewma(segments):
+    view = InventoryView()
+    node = DataNode("n1")
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("n1", descriptor_for(s))
+    broker = Broker(view)
+    assert view.latency_ms("n1") is None
+    broker.run(TimeseriesQuery.of("test", [WEEK], AGGS))
+    assert view.latency_ms("n1") is not None and view.latency_ms("n1") > 0
+    broker.stop()
+
+
+def test_broker_pool_is_hoisted_and_released(segments):
+    view, dead, good, broker = _two_replica_cluster(segments)
+    q = TimeseriesQuery.of("test", [WEEK], AGGS)
+    broker.run(q)
+    pool1 = broker._pool
+    assert pool1 is not None, "scatter created the broker-owned pool"
+    broker.run(q)
+    assert broker._pool is pool1, "retry rounds reuse ONE pool"
+    broker.stop()
+    assert broker._pool is None
+    assert pool1._shutdown
+    # the broker stays usable after stop(): the pool is recreated
+    expect = QueryExecutor(segments).run(q)
+    assert broker.run(q) == expect
+    broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# client Retry-After jitter wiring
+# ---------------------------------------------------------------------------
+
+def test_client_retry_after_sleep_is_jittered(monkeypatch):
+    from druid_tpu.cluster import resilience as R
+    from druid_tpu.cluster.dataserver import RemoteDataNodeClient
+    seen = {}
+
+    def fake_jitter(rng, base, prev, cap):
+        seen["args"] = (base, prev, cap)
+        return 0.0                        # no real sleep in the test
+
+    monkeypatch.setattr(R, "decorrelated_jitter", fake_jitter)
+    monkeypatch.setattr(RemoteDataNodeClient, "MAX_RETRY_AFTER_SLEEP", 0.05)
+    import tests.test_scheduler as TS
+    from druid_tpu.data.generator import DataGenerator
+    from tests.conftest import TEST_SCHEMA
+    segs = DataGenerator(TEST_SCHEMA, seed=42).segments(
+        1, 512, Interval.of("2026-01-01", "2026-01-02"),
+        datasource="test")
+    httpd, handler, q = TS._stub_shedding_server(segs, shed_n=1)
+    try:
+        client = RemoteDataNodeClient(
+            "stub", f"http://127.0.0.1:{httpd.server_address[1]}",
+            jitter_seed=0)
+        client.run_partials(q, [str(segs[0].id)])
+        base, prev, cap = seen["args"]
+        assert base == prev > 0           # seeded from the Retry-After
+        assert cap == 0.05
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# metrics monitor
+# ---------------------------------------------------------------------------
+
+def test_resilience_monitor_emits_declared_deltas():
+    from druid_tpu.obs import catalog
+    res = BrokerResilience(ResiliencePolicy(circuit_failure_threshold=1))
+    res.circuits.on_failure("s1")
+    res.stats.note_hedge_issued()
+    res.stats.note_hedge_won()
+    res.stats.note_partial(3)
+    events = []
+
+    class _Emitter:
+        def metric(self, name, value, **dims):
+            events.append((name, value))
+
+    mon = ResilienceMetricsMonitor(res)
+    mon.do_monitor(_Emitter())
+    got = dict(events)
+    assert catalog.validate_emitted(got) == []
+    assert got["broker/circuit/open"] == 1
+    assert got["broker/circuit/trips"] == 1
+    assert got["query/hedge/issued"] == 1
+    assert got["query/hedge/won"] == 1
+    assert got["query/partial/missingSegments"] == 3
+    events.clear()
+    mon.do_monitor(_Emitter())
+    got = dict(events)
+    # second tick: deltas drop to zero, the open gauge stays live
+    assert got["broker/circuit/trips"] == 0
+    assert got["query/partial/missingSegments"] == 0
+    assert got["broker/circuit/open"] == 1
+
+
+def test_partial_contract_over_http_and_sql(segments):
+    """End-to-end surface test: the missing-segments report rides the
+    X-Druid-Response-Context header on native HTTP queries AND on SQL
+    (context passthrough added for the data-plane flags), exactly once,
+    with the body rows equal to the surviving oracle."""
+    import http.client
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.lifecycle import QueryLifecycle
+    from druid_tpu.sql.executor import SqlExecutor
+    view = InventoryView()
+    dead = _DeadNode("dead")
+    live = DataNode("live")
+    view.register(dead)
+    view.register(live)
+    for i, s in enumerate(segments):
+        n = dead if i % 2 == 0 else live
+        n.load_segment(s)
+        view.announce(n.name, descriptor_for(s))
+    broker = Broker(view)
+    srv = QueryHttpServer(QueryLifecycle(broker),
+                          sql_executor=SqlExecutor(broker)).start()
+    lost = {str(s.id) for i, s in enumerate(segments) if i % 2 == 0}
+    survivors = [s for i, s in enumerate(segments) if i % 2 == 1]
+    try:
+        q = TimeseriesQuery.of("test", [WEEK], AGGS,
+                               context={"allowPartialResults": True})
+        c = http.client.HTTPConnection("127.0.0.1", srv.port)
+        c.request("POST", "/druid/v2", json.dumps(q.to_json()),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 200
+        rc = json.loads(r.headers["X-Druid-Response-Context"])
+        assert rc["partial"] is True
+        assert set(rc["missingSegments"]) == lost
+        assert body == QueryExecutor(survivors).run(q)
+        # review regression: a partial must NOT carry the complete
+        # result's ETag — a client caching the partial body against it
+        # would be 304-confirmed forever after the cluster heals
+        assert r.headers.get("X-Druid-ETag") is None
+        # a strict query over the same cluster keeps the 500-class error
+        strict = TimeseriesQuery.of("test", [WEEK], AGGS)
+        c.request("POST", "/druid/v2", json.dumps(strict.to_json()),
+                  {"Content-Type": "application/json"})
+        r2 = c.getresponse()
+        r2.read()
+        assert r2.status == 500
+        assert r2.headers.get("X-Druid-Response-Context") is None
+        # SQL surface: the context object reaches the native query and
+        # the report reaches the header
+        c.request("POST", "/druid/v2/sql", json.dumps({
+            "query": "SELECT COUNT(*) AS c FROM test",
+            "context": {"allowPartialResults": True}}),
+            {"Content-Type": "application/json"})
+        r3 = c.getresponse()
+        sql_rows = json.loads(r3.read())
+        assert r3.status == 200
+        rc3 = json.loads(r3.headers["X-Druid-Response-Context"])
+        assert set(rc3["missingSegments"]) == lost
+        assert sql_rows[0]["c"] == sum(s.n_rows for s in survivors)
+        c.close()
+    finally:
+        srv.stop()
+        broker.stop()
+
+
+def test_http_server_wires_resilience_monitor(segments):
+    """A broker-backed QueryHttpServer surfaces broker/circuit/* on its
+    /metrics registry after a tick."""
+    from druid_tpu.server.http import QueryHttpServer
+    from druid_tpu.server.lifecycle import QueryLifecycle
+    view = InventoryView()
+    node = DataNode("n1")
+    view.register(node)
+    for s in segments:
+        node.load_segment(s)
+        view.announce("n1", descriptor_for(s))
+    broker = Broker(view)
+    srv = QueryHttpServer(QueryLifecycle(broker)).start()
+    try:
+        broker.resilience.circuits.on_failure("n1")
+        srv.metrics_tick()
+        expo = srv.registry.exposition()
+        assert "broker_circuit_open" in expo
+        assert "query_hedge_issued" in expo
+    finally:
+        srv.stop()
+        broker.stop()

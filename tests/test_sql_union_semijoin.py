@@ -151,8 +151,8 @@ def test_not_in_subquery_with_null_matches_nothing(sql, monkeypatch):
     in the materialized inner result must empty the outer result."""
     real = SqlExecutor._execute_select
 
-    def fake(self, sel, depth):
-        names, rows = real(self, sel, depth)
+    def fake(self, sel, depth, context=None):
+        names, rows = real(self, sel, depth, context)
         if depth > 0:
             rows = rows + [[None]]
         return names, rows

@@ -124,6 +124,9 @@ _DEFAULT_CONFIG = {
     "shard-modules": ["druid_tpu/parallel/distributed.py"],
     # tracecheck: VMEM tile budget in bytes; 0 = contracts.VMEM_BUDGET_BYTES
     "vmem-cap-bytes": 0,
+    # unbounded-retry: data-plane modules whose catch-and-retry loops
+    # must consult a Deadline or attempt bound
+    "retry-modules": ["druid_tpu/cluster/*", "druid_tpu/server/*"],
     # raceguard: the whole-program concurrency-analysis member set — every
     # module whose locks/threads/shared state enter the shared index
     "raceguard-modules": ["druid_tpu/*"],
@@ -171,6 +174,8 @@ class LintConfig:
     shard_modules: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["shard-modules"]))
     vmem_cap_bytes: int = 0
+    retry_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["retry-modules"]))
     raceguard_modules: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["raceguard-modules"]))
     extra_thread_roots: List[str] = field(

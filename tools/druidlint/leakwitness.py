@@ -89,6 +89,9 @@ def end_session_witness() -> Optional["LeakWitness"]:
 _FD_NOISE_PREFIXES = ("anon_inode:", "pipe:", "/dev/", "/proc/", "/sys/")
 _FD_NOISE_SUFFIXES = (".so",)
 
+#: once-per-process note that the fd axis is skipped (non-procfs)
+_FD_AXIS_NOTE = {"emitted": False}
+
 
 def _fd_leakworthy(target: str) -> bool:
     if target.startswith(_FD_NOISE_PREFIXES):
@@ -106,6 +109,10 @@ class LeakSnapshot:
     fds: Tuple[Tuple[int, str], ...]      # (fd, readlink target)
     pool_resident: int
     pool_entries: int
+    #: whether /proc/self/fd was readable when this snapshot was taken —
+    #: False on non-procfs platforms, where the fd axis is SKIPPED (with
+    #: a one-line note) and the thread/pool axes carry the gate alone
+    fd_axis: bool = True
 
 
 class LeakWitness:
@@ -194,17 +201,32 @@ class LeakWitness:
 
     @staticmethod
     def open_fds() -> Tuple[Tuple[int, str], ...]:
+        return LeakWitness.fd_axis_snapshot()[0]
+
+    @staticmethod
+    def fd_axis_snapshot() -> Tuple[Tuple[Tuple[int, str], ...], bool]:
+        """(fd table, axis available). On platforms without procfs the
+        axis degrades gracefully: one logged note (once per process),
+        empty table, available=False — _compare then skips the fd axis
+        entirely while threads and pool stay active, instead of erroring
+        or silently reading 'no fds open'."""
         out = []
         try:
             names = os.listdir("/proc/self/fd")
         except OSError:
-            return ()                    # no procfs: fd axis disabled
+            if not _FD_AXIS_NOTE["emitted"]:
+                _FD_AXIS_NOTE["emitted"] = True
+                import logging
+                logging.getLogger(__name__).info(
+                    "leakwitness: /proc/self/fd unavailable — fd axis "
+                    "skipped; thread and pool axes remain active")
+            return (), False
         for n in names:
             try:
                 out.append((int(n), os.readlink(f"/proc/self/fd/{n}")))
             except (OSError, ValueError):
                 pass                     # fd closed mid-listing
-        return tuple(sorted(out))
+        return tuple(sorted(out)), True
 
     @staticmethod
     def pool_stats() -> Tuple[int, int]:
@@ -220,11 +242,13 @@ class LeakWitness:
         with self._meta:
             watermark = len(self._started)
         resident, entries = self.pool_stats()
+        fds, fd_axis = self.fd_axis_snapshot()
         return LeakSnapshot(started_watermark=watermark,
                             thread_count=threading.active_count(),
-                            fds=self.open_fds(),
+                            fds=fds,
                             pool_resident=resident,
-                            pool_entries=entries)
+                            pool_entries=entries,
+                            fd_axis=fd_axis)
 
     # ---- comparison -----------------------------------------------------
     def _compare(self, baseline: LeakSnapshot,
@@ -239,17 +263,22 @@ class LeakWitness:
         # number, so a leaked re-open of a baseline file can land on the
         # baseline's own fd (invisible to an identity check), while a
         # legitimately re-opened baseline file on a higher number is not
-        # growth and must not fail the gate.
-        base_counts = Counter(t for _, t in baseline.fds
-                              if _fd_leakworthy(t))
-        current = self.open_fds()
-        excess = Counter(t for _, t in current
-                         if _fd_leakworthy(t)) - base_counts
-        for fd, target in current:
-            if excess.get(target, 0) > 0:
-                excess[target] -= 1
-                out.append(f"fd leak: fd {fd} -> {target} (more open than "
-                           f"at baseline)")
+        # growth and must not fail the gate. The axis is skipped whole
+        # when /proc/self/fd was unavailable at EITHER end (non-procfs
+        # platforms; the one-line note comes from fd_axis_snapshot) —
+        # comparing a real table against a degraded empty one would only
+        # manufacture phantom findings.
+        current, cur_axis = self.fd_axis_snapshot()
+        if baseline.fd_axis and cur_axis:
+            base_counts = Counter(t for _, t in baseline.fds
+                                  if _fd_leakworthy(t))
+            excess = Counter(t for _, t in current
+                             if _fd_leakworthy(t)) - base_counts
+            for fd, target in current:
+                if excess.get(target, 0) > 0:
+                    excess[target] -= 1
+                    out.append(f"fd leak: fd {fd} -> {target} (more open "
+                               f"than at baseline)")
         resident, entries = self.pool_stats()
         if resident > baseline.pool_resident + pool_slack_bytes:
             out.append(f"device pool leak: resident {resident}B / "

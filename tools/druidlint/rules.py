@@ -395,6 +395,116 @@ def _enclosed_in_deferred(ctx: ModuleContext, node: ast.AST,
     return False
 
 
+# ---- unbounded-retry ------------------------------------------------------
+
+#: exception names whose catch-and-retry marks a NETWORK/CAPACITY retry
+#: loop (connectivity, remote errors, sheds, socket timeouts). Broad
+#: `except Exception` is deliberately NOT in this set — that is the
+#: swallowed-exception rule's domain, and flagging it here would indict
+#: every skip-and-continue iteration loop (inventory sync, liveness).
+_RETRYABLE_ERRORS = {"ConnectionError", "ConnectionResetError",
+                     "ConnectionRefusedError", "BrokenPipeError",
+                     "TimeoutError", "timeout", "OSError", "URLError",
+                     "HTTPError", "QueryCapacityError", "QueryTimeoutError",
+                     "RemoteQueryError"}
+
+#: a call with one of these attrs on a receiver named like a deadline
+#: counts as consulting the bound
+_DEADLINE_CONSULTS = {"check", "expired", "remaining_ms", "remaining"}
+
+
+def _same_loop_children(stmts) -> Iterable[ast.AST]:
+    """Walk statements WITHOUT descending into nested loops, function
+    defs, or classes — a Try in a nested loop retries THAT loop (which
+    gets its own check), not this one."""
+    for s in stmts:
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While, ast.ClassDef,
+                          ast.Lambda) + _FUNC_DEFS):
+            continue
+        yield s
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(s, field, None)
+            if sub:
+                if field == "handlers":
+                    for h in sub:
+                        yield h
+                        yield from _same_loop_children(h.body)
+                else:
+                    yield from _same_loop_children(sub)
+
+
+def _catches_retryable(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False                       # bare except: swallowed-exception
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(_terminal(e) in _RETRYABLE_ERRORS for e in elts)
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """True when the handler can reach the next loop iteration: it does
+    not END in an unconditional raise/return/break. (A conditional abort
+    followed by fall-through still retries.)"""
+    last = handler.body[-1]
+    return not isinstance(last, (ast.Raise, ast.Return, ast.Break))
+
+
+def _consults_deadline(loop: ast.AST) -> bool:
+    for n in ast.walk(loop):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _DEADLINE_CONSULTS \
+                and "deadline" in _terminal(n.func.value).lower():
+            return True
+    return False
+
+
+def _loop_bounded(loop) -> bool:
+    if isinstance(loop, ast.For):
+        it = loop.iter
+        if isinstance(it, (ast.Tuple, ast.List, ast.Set)):
+            return True                    # for attempt in (0, 1)
+        if isinstance(it, ast.Call) and _terminal(it.func) == "range":
+            return True                    # for _ in range(retries + 1)
+        if isinstance(it, ast.Call) and _terminal(it.func) == "enumerate" \
+                and it.args and isinstance(it.args[0],
+                                           (ast.Tuple, ast.List)):
+            return True
+    elif isinstance(loop.test, ast.Compare):
+        return True                        # while attempt < self.max_...
+    return _consults_deadline(loop)
+
+
+@rule("unbounded-retry", "error",
+      "catch-and-retry of a network/capacity error with no reachable "
+      "Deadline or attempt bound in the loop")
+def check_unbounded_retry(ctx: ModuleContext) -> Iterable[Finding]:
+    """In data-plane modules (config `retry-modules`), any loop that
+    catches a network/capacity error (connection, timeout, 429/capacity,
+    remote query error) and can fall through to another iteration must
+    carry a bound reachable in the loop: a finite `for` iteration
+    (range()/literal sequence), a condition-bounded `while`, or a
+    Deadline consult (`deadline.check()` / `.expired()` /
+    `.remaining_ms()`). An unbounded retry turns one dead replica into a
+    client spinning past its caller's deadline — the hang the chaos
+    suite's no-hang contract forbids."""
+    if not ctx.path_matches(ctx.config.retry_modules):
+        return
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        handlers = [n for n in _same_loop_children(loop.body)
+                    if isinstance(n, ast.ExceptHandler)
+                    and _catches_retryable(n) and _handler_retries(n)]
+        if not handlers or _loop_bounded(loop):
+            continue
+        for h in handlers:
+            yield ctx.finding(
+                h, f"retrying {_dotted(h.type) if h.type else 'error'} "
+                   f"in an unbounded loop — bound the attempts "
+                   f"(range/literal) or consult a Deadline "
+                   f"(.check()/.expired()/.remaining_ms()) in the loop")
+
+
 # ---- metric-name ----------------------------------------------------------
 
 #: parsed catalogs keyed by absolute path; value = ((mtime_ns, size), names)
