@@ -6,6 +6,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 "filter_host_rate", "filter_device_rate", "filter_cache_hit_rate",
 "decoded_rate", "pack_ratio", "fused_rate", "staged_rate",
 "dispatch_count_fused", "dispatch_count_staged", "donated_tick_rate",
+"rle_rate", "packed_only_rate", "cascade_ratio", "code_domain_rate",
+"hll_log2m12_rate",
 "untraced_rate", "traced_rate", "trace_overhead"} — packed_* compare
 compressed-domain vs decoded staging on the cold-miss H2D path; fused_*
 compare the one-dispatch megakernel path vs the staged fill-wave path on
@@ -33,6 +35,8 @@ Environment:
   DRUID_TPU_BENCH_BATCH_SEGMENTS  segments in the batch comparison (default 16)
   DRUID_TPU_BENCH_BATCH_ROWS      rows PER SEGMENT there (default 4096)
   DRUID_TPU_BENCH_INIT_TIMEOUT    backend-init watchdog seconds (default 600)
+  DRUID_TPU_BENCH_CASCADE_SEGMENTS  cascade-comparison segments (default 8)
+  DRUID_TPU_BENCH_CASCADE_ROWS      rows PER SEGMENT there (default 8192)
   DRUID_TPU_BENCH_CLIENTS         concurrent closed-loop clients (default 8)
   DRUID_TPU_BENCH_CLIENT_QUERIES  queries per client per mode (default 12)
   DRUID_TPU_BENCH_SCHED_ROWS      rows per segment in that mode (default 4096)
@@ -482,6 +486,147 @@ def _bench_fused(iters: int):
     }
 
 
+def cascade_segments(n_segments: int, rows: int):
+    """Rollup-shaped RLE-friendly segments: dimension-sorted rows,
+    near-constant time, a constant rollup count metric and a run-aligned
+    small-range value metric — the skewed-real-data shape the cascade
+    rungs (data/cascade.py) exist for."""
+    from druid_tpu.data.segment import SegmentBuilder
+    iv = headline_interval()
+    card = 64
+    reps = -(-rows // card)
+    segs = []
+    for si in range(n_segments):
+        b = SegmentBuilder("cascade", iv, version="v0", partition=si)
+        dim_a = np.repeat([f"a{i:04d}" for i in range(card)], reps)[:rows]
+        dim_b = np.repeat([f"b{i:04d}" for i in range(card)], reps)[:rows]
+        time = iv.start + (np.arange(rows, dtype=np.int64) // 64)
+        val = np.repeat((np.arange(card) * 37) % 1000, reps)[:rows]
+        b.add_columns(time, {"dimA": dim_a.tolist(), "dimB": dim_b.tolist()},
+                      {"cnt": np.ones(rows, dtype=np.int64),
+                       "val": val.astype(np.int64)})
+        segs.append(b.build())
+    return segs
+
+
+def _bench_cascade(iters: int):
+    """Cascaded-encodings comparison (data/cascade.py) on the RLE-friendly
+    rollup shape, pool CLEARED before every timed iteration:
+
+      rle_rate          cold rate with the cascade rungs on, through the
+                        ROW program (granularity hour keeps run-domain
+                        out), vs the packed-only baseline (logged);
+      cascade_ratio     decoded-equivalent / actual bytes of the
+                        cascade-encoded pool entries after the cold run;
+      code_domain_rate  WARM rate of the run-domain-eligible variant
+                        (granularity all): the whole aggregation over run
+                        metadata, zero unpack, zero row-width staging.
+    """
+    from druid_tpu.data import cascade
+    from druid_tpu.data.devicepool import device_pool
+    from druid_tpu.engine.executor import QueryExecutor
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.filters import InFilter
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_CASCADE_SEGMENTS", 8))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_CASCADE_ROWS", 8192))
+    segments = cascade_segments(n_segments, rows_per_seg)
+    total_rows = sum(s.n_rows for s in segments)
+    dim_b_vals = list(segments[0].dims["dimB"].dictionary.values)
+    aggs = [CountAggregator("rows"), LongSumAggregator("c", "cnt"),
+            LongSumAggregator("v", "val")]
+    flt = InFilter("dimB", dim_b_vals[::2])
+    row_query = GroupByQuery.of(
+        "cascade", [headline_interval()], [DefaultDimensionSpec("dimA")],
+        aggs, granularity="hour", filter=flt)
+    run_query = GroupByQuery.of(
+        "cascade", [headline_interval()], [DefaultDimensionSpec("dimA")],
+        aggs, granularity="all", filter=flt)
+    executor = QueryExecutor(segments)
+    pool = device_pool()
+
+    rates = {}
+    cascade_ratio = 0.0
+    for label, on in (("packed_only", False), ("cascade", True)):
+        prev = cascade.set_enabled(on)
+        try:
+            t = time.time()
+            executor.run(row_query)      # warm: compile once per mode
+            log(f"cascade-bench warmup {label}: {time.time() - t:.2f}s")
+            times = []
+            for _ in range(max(iters, 3)):
+                pool.clear()             # force the cold-miss H2D path
+                t = time.time()
+                executor.run(row_query)
+                times.append(time.time() - t)
+            if on:
+                cascade_ratio = pool.snapshot().cascade_ratio
+        finally:
+            cascade.set_enabled(prev)
+        rates[label] = total_rows / min(times)
+        log(f"cascade-bench {label}: best {min(times) * 1e3:.1f}ms over "
+            f"{len(times)} cold iters -> {rates[label] / 1e6:.1f}M rows/s")
+    log(f"cascade-bench pool cascade ratio: {cascade_ratio:.2f}x")
+
+    # code-domain: warm repeated execution of the run-space variant
+    prev = cascade.set_enabled(True)
+    try:
+        executor.run(run_query)          # warm: run tables + compile
+        h0 = cascade.code_domain_stats().snapshot()["hits"]
+        ticks = max(iters, 3)
+        t0 = time.time()
+        for _ in range(ticks):
+            executor.run(run_query)
+        code_rate = total_rows * ticks / (time.time() - t0)
+        hits = cascade.code_domain_stats().snapshot()["hits"] - h0
+    finally:
+        cascade.set_enabled(prev)
+    log(f"cascade-bench code-domain: {ticks} warm run(s), {hits} run-space "
+        f"executions -> {code_rate / 1e6:.1f}M rows/s")
+    return {
+        "rle_rate": round(rates["cascade"], 0),
+        "packed_only_rate": round(rates["packed_only"], 0),
+        "cascade_ratio": round(cascade_ratio, 3),
+        "code_domain_rate": round(code_rate, 0),
+    }
+
+
+def _bench_hll(iters: int):
+    """hyperUnique/cardinality at a NON-default register count (log2m=12;
+    the ROADMAP-carried rider): per-core rate of a groupBy carrying a
+    4096-register sketch, so sketch-width regressions show up in BENCH_r*
+    instead of only at the default 2048 registers."""
+    from druid_tpu.engine.executor import QueryExecutor
+
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_BATCH_SEGMENTS", 16))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_BATCH_ROWS", 4096))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    iv = headline_interval()
+    q = {"queryType": "groupBy", "dataSource": "bench",
+         "intervals": [str(iv)], "granularity": "all",
+         "dimensions": ["dimA"],
+         "aggregations": [
+             {"type": "count", "name": "rows"},
+             {"type": "hyperUnique", "name": "u", "fieldName": "dimB",
+              "log2m": 12}]}
+    executor = QueryExecutor(segments)
+    t = time.time()
+    executor.run_json(q)
+    log(f"hll-bench warmup: {time.time() - t:.2f}s")
+    times = []
+    for _ in range(max(iters, 3)):
+        t = time.time()
+        executor.run_json(q)
+        times.append(time.time() - t)
+    rate = total_rows / min(times)
+    log(f"hll-bench log2m=12: best {min(times) * 1e3:.1f}ms "
+        f"-> {rate / 1e6:.1f}M rows/s")
+    return {"hll_log2m12_rate": round(rate, 0)}
+
+
 def _bench_tracing(iters: int):
     """qtrace overhead in one number pair: the batch-comparison query at
     many small segments (the worst case for per-dispatch span overhead —
@@ -755,6 +900,16 @@ def main():
         log(f"fused-bench failed: {type(e).__name__}: {e}")
         fused = {"fused_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        casc = _bench_cascade(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"cascade-bench failed: {type(e).__name__}: {e}")
+        casc = {"cascade_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        hll = _bench_hll(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"hll-bench failed: {type(e).__name__}: {e}")
+        hll = {"hll_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         traced = _bench_tracing(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"trace-bench failed: {type(e).__name__}: {e}")
@@ -784,6 +939,8 @@ def main():
     out.update(packed_cmp)
     out.update(filt)
     out.update(fused)
+    out.update(casc)
+    out.update(hll)
     out.update(traced)
     out.update(sched)
     out.update(soak)

@@ -293,6 +293,7 @@ class DataNodeServer:
         else:
             self._restore_sink = compose_sink(emitter, self.registry)
         self.emitter = emitter
+        from druid_tpu.data.cascade import CodeDomainMonitor
         from druid_tpu.data.devicepool import DevicePoolMonitor
         from druid_tpu.engine.batching import BatchMetricsMonitor
         from druid_tpu.engine.filters import FilterBitmapMonitor
@@ -301,7 +302,8 @@ class DataNodeServer:
         from druid_tpu.utils.emitter import MonitorScheduler
         monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
                     FilterBitmapMonitor(), MegakernelMonitor(),
-                    DispatchMonitor(), self._query_counts]
+                    CodeDomainMonitor(), DispatchMonitor(),
+                    self._query_counts]
         if self._scheduler_config is not None:
             self.scheduler = DataNodeScheduler(
                 node, self._scheduler_config, emitter=emitter)

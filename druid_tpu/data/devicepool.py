@@ -44,44 +44,72 @@ def _default_budget() -> int:
     return DEVICE_POOL_BUDGET_BYTES
 
 
+def _fold_entry(value, measure) -> int:
+    """THE one recursive walker over a pool entry's structure —
+    DeviceBlocks (their array dict), dicts, tuples/lists — summing
+    `measure(leaf)`; `measure` returns None to recurse into a node, and
+    unmeasurable leaves count 0. Every accounting view (actual bytes,
+    decoded-equivalent bytes, cascade bytes) folds through here, so a new
+    container shape added once covers all of them."""
+    if value is None:
+        return 0
+    got = measure(value)
+    if got is not None:
+        return int(got)
+    arrays = getattr(value, "arrays", None)
+    if isinstance(arrays, dict):
+        value = arrays
+    if isinstance(value, dict):
+        return sum(_fold_entry(v, measure) for v in value.values())
+    if isinstance(value, (tuple, list)):
+        return sum(_fold_entry(v, measure) for v in value)
+    return 0
+
+
+def _measure_nbytes(v):
+    # containers have no nbytes; anything that does is a leaf
+    if isinstance(v, (dict, tuple, list)) or hasattr(v, "arrays"):
+        return None
+    return getattr(v, "nbytes", None)
+
+
 def entry_bytes(value) -> int:
     """Actual device bytes a pool entry pins: DeviceBlocks count their
     array dict, containers count their leaves, arrays their nbytes.
-    PackedColumn entries (and any pytree mixing packed words with aux
-    arrays) count their COMPRESSED words bytes — the pool budgets what HBM
-    actually holds, so effective capacity multiplies by the pack ratio."""
-    if value is None:
-        return 0
-    arrays = getattr(value, "arrays", None)
-    if isinstance(arrays, dict):
-        return sum(entry_bytes(v) for v in arrays.values())
-    if isinstance(value, dict):
-        return sum(entry_bytes(v) for v in value.values())
-    if isinstance(value, (tuple, list)):
-        return sum(entry_bytes(v) for v in value)
-    nbytes = getattr(value, "nbytes", None)
-    return int(nbytes) if nbytes is not None else 0
+    PackedColumn/cascade entries (and any pytree mixing compressed words
+    with aux arrays) count their COMPRESSED bytes — the pool budgets what
+    HBM actually holds, so effective capacity multiplies by the ratio."""
+    return _fold_entry(value, _measure_nbytes)
 
 
 def entry_logical_bytes(value) -> int:
     """Decoded-equivalent bytes of a pool entry: what the same data would
     pin if staged fully decoded. Equals entry_bytes for plain arrays;
-    PackedColumns report rows × element width. logical / actual is the
-    pool's packedRatio — the effective-capacity multiplier."""
-    if value is None:
-        return 0
-    logical = getattr(value, "logical_nbytes", None)
-    if logical is not None:
-        return int(logical)
-    arrays = getattr(value, "arrays", None)
-    if isinstance(arrays, dict):
-        return sum(entry_logical_bytes(v) for v in arrays.values())
-    if isinstance(value, dict):
-        return sum(entry_logical_bytes(v) for v in value.values())
-    if isinstance(value, (tuple, list)):
-        return sum(entry_logical_bytes(v) for v in value)
-    nbytes = getattr(value, "nbytes", None)
-    return int(nbytes) if nbytes is not None else 0
+    packed/cascade columns report rows × element width. logical / actual
+    is the pool's packedRatio — the effective-capacity multiplier."""
+    def measure(v):
+        logical = getattr(v, "logical_nbytes", None)
+        if logical is not None:
+            return logical
+        return _measure_nbytes(v)
+    return _fold_entry(value, measure)
+
+
+def entry_cascade_bytes(value) -> Tuple[int, int]:
+    """(actual, decoded-equivalent) bytes of the CASCADE-encoded leaves of
+    a pool entry (data/cascade.py RLE/delta/FOR/LZ4 columns, marked by
+    `cascade_kind`). Their ratio is the pool's cascadeRatio — the
+    capacity multiplier the cascade rungs specifically add on top of
+    bit-packing."""
+    def cascade_leaf(attr):
+        def measure(v):
+            if getattr(v, "cascade_kind", None) is not None:
+                return getattr(v, attr, 0)
+            return None if isinstance(v, (dict, tuple, list)) \
+                or hasattr(v, "arrays") else 0
+        return measure
+    return (_fold_entry(value, cascade_leaf("nbytes")),
+            _fold_entry(value, cascade_leaf("logical_nbytes")))
 
 
 @dataclass
@@ -92,6 +120,8 @@ class PoolStats:
     evicted_bytes: int = 0
     resident_bytes: int = 0
     logical_bytes: int = 0
+    cascade_bytes: int = 0
+    cascade_logical_bytes: int = 0
     entries: int = 0
     budget_bytes: int = 0
 
@@ -107,6 +137,13 @@ class PoolStats:
         return self.logical_bytes / self.resident_bytes \
             if self.resident_bytes else 1.0
 
+    @property
+    def cascade_ratio(self) -> float:
+        """Decoded-equivalent / actual bytes over CASCADE-encoded entries
+        only (1.0 when nothing cascade-encoded is resident)."""
+        return self.cascade_logical_bytes / self.cascade_bytes \
+            if self.cascade_bytes else 1.0
+
 
 class DeviceSegmentPool:
     """Byte-budgeted LRU over (owner, key) -> device value."""
@@ -114,8 +151,9 @@ class DeviceSegmentPool:
     def __init__(self, budget_bytes: Optional[int] = None):
         self._budget = budget_bytes            # None -> resolve lazily
         self._lock = threading.Lock()
-        # key -> (value, actual_bytes, logical_bytes)
-        self._entries: "collections.OrderedDict[Tuple, Tuple[object, int, int]]" \
+        # key -> (value, actual_bytes, logical_bytes,
+        #         cascade_actual_bytes, cascade_logical_bytes)
+        self._entries: "collections.OrderedDict[Tuple, Tuple]" \
             = collections.OrderedDict()
         self._owner_keys: Dict[int, Set[Tuple]] = {}
         self._owner_seq = itertools.count(1)
@@ -127,6 +165,8 @@ class DeviceSegmentPool:
         self._dead_owners: "collections.deque[int]" = collections.deque()
         self._resident = 0
         self._logical = 0
+        self._cascade = 0
+        self._cascade_logical = 0
         self._hits = 0
         self._misses = 0
         self._evictions = 0
@@ -189,6 +229,8 @@ class DeviceSegmentPool:
             if value is not None:
                 freed += value[1]
                 self._logical -= value[2]
+                self._cascade -= value[3]
+                self._cascade_logical -= value[4]
         self._resident -= freed
         return freed
 
@@ -230,6 +272,7 @@ class DeviceSegmentPool:
             value = build()
             nbytes = entry_bytes(value)
             logical = entry_logical_bytes(value)
+            casc, casc_logical = entry_cascade_bytes(value)
             if sp is not None:
                 # "bytes" is what actually crossed the bus (compressed for
                 # packed entries); logicalBytes the decoded-equivalent size
@@ -247,10 +290,15 @@ class DeviceSegmentPool:
             if old is not None:
                 self._resident -= old[1]
                 self._logical -= old[2]
-            self._entries[full_key] = (value, nbytes, logical)
+                self._cascade -= old[3]
+                self._cascade_logical -= old[4]
+            self._entries[full_key] = (value, nbytes, logical, casc,
+                                       casc_logical)
             keys.add(full_key)
             self._resident += nbytes
             self._logical += logical
+            self._cascade += casc
+            self._cascade_logical += casc_logical
             budget = self.budget_bytes
             if budget > 0:
                 self._evict_to(budget, keep=full_key)
@@ -274,6 +322,8 @@ class DeviceSegmentPool:
             self._owner_keys.get(owner, set()).discard(full_key)
             self._resident -= entry[1]
             self._logical -= entry[2]
+            self._cascade -= entry[3]
+            self._cascade_logical -= entry[4]
             return entry[0]
 
     def _evict_to(self, budget: int, keep: Optional[Tuple]) -> None:
@@ -287,11 +337,13 @@ class DeviceSegmentPool:
                     return
                 self._entries.move_to_end(key)
                 continue
-            _, nbytes, logical = self._entries.pop(key)
+            _, nbytes, logical, casc, casc_logical = self._entries.pop(key)
             # key[0] is the owner token (get_or_build prefixes it)
             self._owner_keys.get(key[0], set()).discard(key)
             self._resident -= nbytes
             self._logical -= logical
+            self._cascade -= casc
+            self._cascade_logical -= casc_logical
             self._evictions += 1
             self._evicted_bytes += nbytes
 
@@ -304,6 +356,8 @@ class DeviceSegmentPool:
                 keys.clear()
             self._resident = 0
             self._logical = 0
+            self._cascade = 0
+            self._cascade_logical = 0
 
     # ---- observability --------------------------------------------------
     def snapshot(self) -> PoolStats:
@@ -314,6 +368,8 @@ class DeviceSegmentPool:
                              evicted_bytes=self._evicted_bytes,
                              resident_bytes=self._resident,
                              logical_bytes=self._logical,
+                             cascade_bytes=self._cascade,
+                             cascade_logical_bytes=self._cascade_logical,
                              entries=len(self._entries),
                              budget_bytes=self.budget_bytes)
 
@@ -350,3 +406,4 @@ class DevicePoolMonitor(Monitor):
         emitter.metric("segment/devicePool/residentBytes", s.resident_bytes)
         emitter.metric("segment/devicePool/entries", s.entries)
         emitter.metric("segment/devicePool/packedRatio", s.packed_ratio)
+        emitter.metric("segment/devicePool/cascadeRatio", s.cascade_ratio)

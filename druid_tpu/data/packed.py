@@ -264,6 +264,12 @@ def unpack_device(pc: PackedColumn):
     pallas the decoded array never materializes in HBM on its own."""
     import jax.numpy as jnp
 
+    # trace-time decode accounting (data/cascade.py): the code-domain
+    # paths' zero-unpack contract is asserted against this counter. Lazy
+    # import: cascade imports this module at load time.
+    from druid_tpu.data import cascade
+    cascade.record_decode(getattr(pc, "cascade_kind", "packed"))
+
     width, vpw = pc.width, pc.vpw
     m = jnp.int32((1 << width) - 1)
     w2 = pc.words.reshape(-1, _LANE)

@@ -252,14 +252,19 @@ class Segment:
         of the reference keeping segments mmapped and page-cached
         (server/.../SegmentLoaderLocalCacheManager.java).
 
-        Pack-eligible columns (data/packed.py — narrow dictionary ids,
-        small-range int32-staged longs; a pure function of column stats)
-        stage as bit-packed PackedColumn words: compressed in HBM, so the
-        pool's byte budget holds pack-ratio more segments and a cold miss
-        ships pack-ratio fewer H2D bytes. The traced programs decode them
-        on-device (grouping/packed.unpack_columns; the pallas kernel
-        per-tile). The descriptor joins the cache key, so flipping
-        packed.set_enabled never serves a mismatched representation.
+        Cascade-eligible columns (data/cascade.py — low-run-count dims and
+        int32 metrics as RLE, near-constant `__time_offset` as delta/FOR,
+        compressible floats as LZ4 tokens) stage under their cascade
+        encoding; pack-eligible columns (data/packed.py — narrow
+        dictionary ids, small-range int32-staged longs) stage as
+        bit-packed PackedColumn words. Both selections are pure functions
+        of column stats (cascade.plan_pair, cascade claims first):
+        compressed in HBM, so the pool's byte budget holds ratio more
+        segments and a cold miss ships ratio fewer H2D bytes. The traced
+        programs decode on-device (cascade.split_resident at the program
+        top; the pallas kernel per-tile for packed words). Both
+        descriptors join the cache key, so flipping either enable switch
+        never serves a mismatched representation.
 
         `perm` applies a row permutation host-side before staging (the sorted
         projection path); callers must pass a stable hashable `perm_key`
@@ -269,26 +274,33 @@ class Segment:
         row_align >= n_rows pads to EXACTLY row_align rows, so batch-mates on
         the same ladder rung stack into one [K, R] program.
         """
-        from druid_tpu.data import packed as packed_mod
+        from druid_tpu.data import cascade as cascade_mod
         if perm is not None and perm_key is None:
             raise ValueError("device_block(perm=...) requires perm_key")
         if columns is None:
             columns = list(self.dims.keys()) + list(self.metrics.keys())
-        packs = packed_mod.plan_columns(self, columns)
+        # the shared encode derivation (data/cascade.plan_pair): cascade
+        # rungs claim their columns first, bit-packing covers the rest —
+        # both descriptors join the pool key, so flipping either switch
+        # never serves a mismatched representation
+        cascades, packs = cascade_mod.plan_pair(self, columns,
+                                                permuted=perm is not None)
         key = ("block", tuple(sorted(set(columns))), row_align,
-               getattr(device, "id", None), perm_key, packs)
+               getattr(device, "id", None), perm_key, packs, cascades)
         return self._pool.get_or_build(
             self._pool_owner, key,
             lambda: self._stage_block(columns, row_align, device, perm,
-                                      packs))
+                                      packs, cascades))
 
     def _stage_block(self, columns: Sequence[str], row_align: int,
                      device, perm: Optional[np.ndarray],
-                     packs: Tuple = ()) -> DeviceBlock:
+                     packs: Tuple = (), cascades: Tuple = ()) -> DeviceBlock:
         import jax
 
+        from druid_tpu.data import cascade as cascade_mod
         from druid_tpu.data import packed as packed_mod
         pack_for = {name: (w, base) for name, w, base in packs}
+        cascade_for = {e[0]: e for e in cascades}
 
         pad_n = max(row_align, ((self.n_rows + row_align - 1) // row_align) * row_align)
         time0 = self.interval.start
@@ -330,6 +342,9 @@ class Segment:
             else jax.device_put
 
         def _stage(name: str, v: np.ndarray):
+            c = cascade_for.get(name)
+            if c is not None:
+                return cascade_mod.encode_column(self, name, c, v, put)
             p = pack_for.get(name)
             if p is None:
                 return put(v)

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from druid_tpu.data import packed
+from druid_tpu.data import cascade
 from druid_tpu.data.segment import DEFAULT_ROW_ALIGN, Segment
 from druid_tpu.engine import filters as filters_mod
 from druid_tpu.engine import grouping
@@ -232,6 +232,7 @@ class _Plan:
     col_dtypes: Dict[str, np.dtype] = None
     rung: int = 0
     packs: Tuple = ()                # pack descriptor (data/packed.py)
+    cascades: Tuple = ()             # cascade descriptor (data/cascade.py)
     digest: Tuple = None             # hashable shape-bucket prefilter
 
     @property
@@ -274,6 +275,14 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     plan = _Plan(segment=segment, kds=kds, index=index, gplan=gplan,
                  intervals=tuple(intervals), granularity=granularity)
     if segment.n_rows > BATCH_MAX_SEGMENT_ROWS:
+        return plan
+    if cascade.enabled() and cascade.run_domain_probe(
+            segment, intervals, granularity, gplan.spec, gplan.kernels,
+            flt, virtual_columns):
+        # code-domain eligible: the per-segment straggler path runs it
+        # fully over run metadata (run_grouped_aggregate's cascade hook) —
+        # stacking it into a row program would decode what never needs
+        # decoding
         return plan
     if any(d.host_ids is not None and d.ids_key is None for d in kds):
         # a derived id column with no stable cache identity cannot stage
@@ -323,13 +332,14 @@ def _plan_for(segment: Segment, kds: Sequence[KeyDim], index: int,
     plan.columns = columns
     plan.col_dtypes = col_dtypes
     plan.rung = row_rung(segment.n_rows)
-    # pack descriptor (pure fn of column stats, pow2-quantized widths/bases
-    # precisely so near-identical segments keep sharing buckets): packed
-    # inputs change the stacked program's treedef, so chunk-mates must
-    # agree on it — it joins both the signature and the digest
-    plan.packs = packed.plan_columns(segment, columns)
+    # cascade + pack descriptors (pure fns of column stats, pow2-quantized
+    # widths/bases/run counts precisely so near-identical segments keep
+    # sharing buckets): both change the stacked program's treedef, so
+    # chunk-mates must agree on them — they join the signature AND the
+    # digest (cascade.plan_pair is the same derivation device_block uses)
+    plan.cascades, plan.packs = cascade.plan_pair(segment, columns)
     sig = grouping._structure_sig(spec, len(intervals), filter_node, kernels,
-                                  gplan.vc_plans, plan.packs)
+                                  gplan.vc_plans, plan.packs, plan.cascades)
     # granularity + bucket count join the digest for CROSS-QUERY grouping:
     # the stacked aux (assemble_stacked_aux) carries one shared period /
     # num_buckets for the whole chunk, so chunk-mates from different
@@ -483,7 +493,7 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
                                ref.granularity, ref.vc_luts)
     sig = "batched|" + grouping._structure_sig(
         ref.spec, len(ref.intervals), ref.filter_node, ref.kernels,
-        ref.vc_plans, ref.packs) + f"|K={K}|R={R}"
+        ref.vc_plans, ref.packs, ref.cascades) + f"|K={K}|R={R}"
     with _JIT_CACHE_LOCK:
         fn = _JIT_CACHE.get(sig)
         # the miss IS the compile event (jit traces/compiles on the first

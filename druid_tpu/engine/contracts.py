@@ -94,6 +94,21 @@ PACK_WORD_BITS = 32
 #: (the same design rule as SumKernel.chunk_rows pow2 quantization).
 PACK_WIDTHS = (4, 8, 16)
 
+# ---- cascaded encodings (data/cascade.py) ---------------------------------
+
+#: hard cap on the pow2-padded run count of any cascade run array (RLE run
+#: values/ends, the run-domain aggregation tables, LZ4 token streams): run
+#: metadata must stay small enough that a (CASCADE_MAX_RUNS // LANE, LANE)
+#: run tile fits the pallas VMEM budget with room to spare, and that the
+#: host-side run planning stays O(small). A column whose padded run count
+#: exceeds this is simply not run-compressible — it falls back to
+#: bit-packing or decoded staging (correctness never depends on cascades).
+CASCADE_MAX_RUNS = 1 << 16
+
+#: run-value tile rows when a kernel streams run metadata as (RUN_TILE_ROWS,
+#: LANE) VMEM tiles — the worst case is every run resident at once.
+RUN_TILE_ROWS = CASCADE_MAX_RUNS // LANE
+
 # ---- megakernel mask words (engine/megakernel.py) -------------------------
 
 #: bits per row of the megakernel's fused row-mask words: the width-1
@@ -206,4 +221,13 @@ SYMBOL_BOUNDS = {
     # bounded by FILTER_WORDS_PER_BLOCK — covers the bitmap words' worst-
     # case tile should a kernel ever stream them in.
     "Rw32": (1, FILTER_WORDS_PER_BLOCK, 1),
+    # cascade run metadata (data/cascade.py): run counts are pow2-padded and
+    # capped at CASCADE_MAX_RUNS by planning (plan_column / the run-domain
+    # eligibility check), run-value tiles declare at most RUN_TILE_ROWS
+    # (LANE-wide) rows, and a single run can span at most a whole batched
+    # segment. These bounds let vmem-budget / pallas-tile-shape statically
+    # cover any kernel that streams run tables as (Rrun, 128) tiles.
+    "n_runs": (1, CASCADE_MAX_RUNS, 1),
+    "Rrun": (1, RUN_TILE_ROWS, 1),
+    "run_len": (1, BATCH_MAX_SEGMENT_ROWS, 1),
 }

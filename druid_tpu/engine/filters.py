@@ -929,15 +929,32 @@ def combine_structure_words(structure, leaf_words, const_words):
 
 def _eval_structure(structure, kinds: Tuple, leaves: Tuple, Rw: int):
     """Traced word-wise bitmap algebra: leaves arrive as device arrays
-    (dense uint32 words, or sparse int32 id lists scattered into words
+    (dense uint32 words, sparse int32 id lists scattered into words
     in-program — distinct ids set distinct bits, so scatter-add IS
-    bitwise-or; padding ids equal padded_rows and drop out of bounds), and
+    bitwise-or; padding ids equal padded_rows and drop out of bounds — or
+    RLE run tables whose per-RUN match bit was decided host-side once per
+    run and expands to rows by a searchsorted over run ends), and
     AND/OR/NOT/XOR combine word-wise on the VPU. Output: uint32 [Rw]."""
     import jax.numpy as jnp
 
     def leaf_words(i):
         if kinds[i][0] == "dense":
             return leaves[i]
+        if kinds[i][0] == "runs":
+            # RLE-run-aware leaf (data/cascade.py run tables): column 0 =
+            # EXCLUSIVE run ends (+ a 2^31-1 sentinel run covering
+            # padding, match 0), column 1 = the per-run match decided ONCE
+            # per run. Ships 8 bytes/run instead of 1 bit/row.
+            ends = leaves[i][:, 0]
+            match = leaves[i][:, 1]
+            iota = jnp.arange(Rw * 32, dtype=jnp.int32)
+            idx = jnp.clip(jnp.searchsorted(ends, iota, side="right"),
+                           0, ends.shape[0] - 1)
+            bits = (match[idx] > 0).astype(jnp.uint32).reshape(-1, 32)
+            w = bits[:, 0]
+            for s in range(1, 32):
+                w = w | (bits[:, s] << jnp.uint32(s))
+            return w
         ids = leaves[i]
         bit = jnp.uint32(1) << (ids & 31).astype(jnp.uint32)
         return jnp.zeros((Rw,), jnp.uint32).at[ids >> 5].add(bit, mode="drop")
@@ -987,11 +1004,38 @@ def _permuted_bitmap(segment: Segment, bm: AnyBitmap,
     return Bitmap.from_bool(bm.to_bool()[perm])
 
 
+def _run_leaf_payload(segment: Segment, dim: str, lut: np.ndarray,
+                      padded_rows: int) -> Optional[np.ndarray]:
+    """RLE-run-aware leaf payload: int32 [Rpad, 2] of (EXCLUSIVE run end —
+    start-of-next-run index — and per-run match) when `dim` is run-compressible enough that the run
+    table undercuts both bitmap representations (data/cascade.py run
+    info), else None. The match bit is decided ONCE PER RUN (one LUT
+    gather over run values) instead of once per row; a 2^31-1-end
+    sentinel run covers padding rows with match 0."""
+    from druid_tpu.data import cascade as cascade_mod
+    if not cascade_mod.enabled():
+        return None
+    # beat the dense words (padded_rows/32 uint32) with clear margin
+    info = cascade_mod.column_run_info(segment, dim,
+                                       max_runs=padded_rows // 256)
+    if info is None:
+        return None
+    values, ends, nr = info
+    rpad = cascade_mod.pad_pow2(nr + 1)
+    payload = np.zeros((rpad, 2), dtype=np.int32)
+    payload[:, 0] = 2**31 - 1            # sentinel tail (match 0)
+    payload[:nr, 0] = ends
+    payload[:nr, 1] = lut[values]
+    return payload
+
+
 def _leaf_arrays(segment: Segment, node: DeviceBitmapNode,
                  padded_rows: int, perm: Optional[np.ndarray] = None,
                  perm_key=None) -> Tuple[Tuple, Tuple]:
     """(kinds, device leaf payloads) for one node: leaf bitmaps come from
-    the host index and ship density-adaptively, pool-resident per leaf.
+    the host index and ship density-adaptively — RLE run tables when the
+    dim is run-compressed (match decided once per run, data/cascade.py),
+    else sparse ids or dense words — pool-resident per leaf.
     `perm` reorders rows into a projection layout before packing; the
     permutation digest keys those entries separately."""
     import jax
@@ -1000,11 +1044,17 @@ def _leaf_arrays(segment: Segment, node: DeviceBitmapNode,
     kinds: List[Tuple] = []
     arrays = []
     for dim, lut in node.leaves:
-        col = segment.dims[dim]
-        bm = col.bitmap_index().union_of(np.flatnonzero(lut))
-        if perm is not None:
-            bm = _permuted_bitmap(segment, bm, perm, perm_key)
-        kind, payload = device_repr(bm, padded_rows)
+        payload = None
+        if perm is None:
+            payload = _run_leaf_payload(segment, dim, lut, padded_rows)
+        if payload is not None:
+            kind = "runs"
+        else:
+            col = segment.dims[dim]
+            bm = col.bitmap_index().union_of(np.flatnonzero(lut))
+            if perm is not None:
+                bm = _permuted_bitmap(segment, bm, perm, perm_key)
+            kind, payload = device_repr(bm, padded_rows)
         kinds.append((kind, payload.shape[0]))
         lkey = ("fbmpleaf", dim, _leaf_digest(lut), padded_rows, kind,
                 payload.shape[0], pdg)

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from druid_tpu.data import cascade as cascade_mod
 from druid_tpu.data import packed as packed_mod
 from druid_tpu.data.segment import DeviceBlock, Segment
 from druid_tpu.engine import filters as filters_mod
@@ -737,7 +738,7 @@ def _blocked_reduce(arrays: Dict, mask, key, kernels: Sequence[AggKernel],
 
 
 def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
-                   vc_plans, packs: Tuple = ()) -> str:
+                   vc_plans, packs: Tuple = (), cascades: Tuple = ()) -> str:
     dims_sig = ",".join(
         f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in spec.dims)
     # repr(expr) is the rewritten AST structure — two segments share a
@@ -758,6 +759,9 @@ def _structure_sig(spec: GroupSpec, n_intervals: int, filter_node, kernels,
         # structure: packed inputs have different treedefs/shapes, so two
         # executions share a jitted program only when their packing agrees
         f"packs={packs}",
+        # the cascade descriptor (data/cascade.plan_columns) likewise:
+        # RLE/delta/FOR/LZ4 inputs are distinct treedefs per descriptor
+        f"casc={cascades}",
     ])
 
 
@@ -787,11 +791,13 @@ def _build_device_fn(spec: GroupSpec, n_intervals: int,
 
     def fn(arrays: Dict[str, object], aux: Tuple, carries: Tuple = ()):
         it = iter(aux)
-        # decode bit-packed columns at the program top: HBM keeps the words,
-        # XLA fuses the shift/mask decode into every consumer; the pallas
-        # strategy additionally receives the raw words (packed_cols) and
-        # unpacks per tile inside the kernel instead
-        packed_cols, arrays = packed_mod.split_packed(arrays)
+        # decode compressed columns at the program top: HBM keeps the
+        # packed/RLE/delta/LZ4 representation, XLA fuses the decode into
+        # every consumer; the pallas strategy additionally receives the
+        # raw packed words (packed_cols, FOR included) and unpacks per
+        # tile inside the kernel instead (data/cascade.split_resident is
+        # the ONE decode entry point)
+        packed_cols, arrays = cascade_mod.split_resident(arrays)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
@@ -912,10 +918,10 @@ def make_stacked_segment_fn(spec: GroupSpec, kds: Sequence[KeyDim],
     def per_segment(arrays, time0, iv_rel, bucket_off, aux):
         it = iter(aux)
         # same decode-at-top story as _build_device_fn: stacked blocks may
-        # carry bit-packed columns (the batched path stages through the
-        # same pool); the sharded path host-stacks decoded arrays, so this
-        # is a no-op there
-        packed_cols, arrays = packed_mod.split_packed(arrays)
+        # carry bit-packed or cascade-encoded columns (the batched path
+        # stages through the same pool); the sharded path host-stacks
+        # decoded arrays, so this is a no-op there
+        packed_cols, arrays = cascade_mod.split_resident(arrays)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]
 
@@ -1101,6 +1107,23 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
             states={k.name: k.empty_state(spec.num_total) for k in kernels},
             kernels=kernels)
 
+    # code-domain fast path (data/cascade.py): when every referenced
+    # column is constant within one shared run partition and the query
+    # shape allows it, the whole aggregation executes over run metadata —
+    # no row-width column stages, nothing decodes, and the results are
+    # bit-identical to the row program (exact int arithmetic, identical
+    # identities). batching._plan_for routes eligible segments here.
+    if cascade_mod.enabled():
+        rd = cascade_mod.try_run_domain(segment, intervals, granularity,
+                                        spec, kernels, flt, virtual_columns)
+        if rd is not None:
+            counts, states = rd
+            host_states = {k.name: k.host_post(st, segment)
+                           for k, st in zip(kernels, states)}
+            return SegmentPartial(segment=segment, spec=spec,
+                                  counts=np.asarray(counts, dtype=np.int64),
+                                  states=host_states, kernels=kernels)
+
     vc_names = {v.name for v in virtual_columns}
     base_needed = set(extra_columns)
     if filter_node is not None:
@@ -1184,11 +1207,13 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
     else:
         megakernel.record_disabled_fallback(filter_node, kernels)
 
-    # pack descriptor of the staged column set: must be derived IDENTICALLY
-    # to device_block's own planning (pure fn of column stats), and joins
-    # the jit-cache signature — a packed and a decoded staging of the same
-    # structure are different programs
-    packs = packed_mod.plan_columns(segment, sorted(needed))
+    # cascade + pack descriptors of the staged column set: must be derived
+    # IDENTICALLY to device_block's own planning (cascade.plan_pair, the
+    # one shared derivation), and both join the jit-cache signature — a
+    # cascade-encoded, packed, and decoded staging of the same structure
+    # are different programs
+    cascades, packs = cascade_mod.plan_pair(segment, sorted(needed),
+                                            permuted=perm is not None)
     block = segment.device_block(sorted(needed), perm=perm, perm_key=perm_key)
 
     arrays = dict(block.arrays)
@@ -1200,13 +1225,16 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                 arrays[d.column] = _pad_device_cached(
                     segment, d.ids_key, d.host_ids, block.padded_rows, 0)
     if spec.key_mode == "host":
+        # derived projection keys ride the cascade FOR rung: their value
+        # range [-1, num_total) is known exactly, so they range-pack at
+        # plan-determined width (data/cascade.for_encode_derived)
         arrays["__key"] = _pad_device_cached(
             segment, spec.host_keys_cache, spec.host_keys,
-            block.padded_rows, -1)
+            block.padded_rows, -1, value_range=(-1, spec.num_total - 1))
     elif spec.bucket_mode == "host":
         arrays["__bucket"] = _pad_device_cached(
             segment, spec.host_bucket_cache, spec.host_bucket_ids,
-            block.padded_rows, -1)
+            block.padded_rows, -1, value_range=(-1, spec.num_buckets - 1))
     # resident filter-bitmap words (engine/filters.py device-bitmap path):
     # cached per (segment, filter structure, aux digest, permutation
     # digest) in the same pool; filtered-aggregator trees stage alongside
@@ -1231,7 +1259,7 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                         vc_plans, vc_luts)
     while True:
         sig = _structure_sig(spec, len(intervals), filter_node, kernels,
-                             vc_plans, packs)
+                             vc_plans, packs, cascades)
         if spec.strategy == "megakernel":
             # donation changes the jit construction (donate_argnums) and
             # the carry handoff changes the carries treedef (empty vs full
@@ -1331,10 +1359,33 @@ def _pad_device(arr: np.ndarray, padded: int, fill) -> object:
 
 
 def _pad_device_cached(segment: Segment, cache_key: Optional[Tuple],
-                       arr: np.ndarray, padded: int, fill) -> object:
+                       arr: np.ndarray, padded: int, fill,
+                       value_range: Optional[Tuple[int, int]] = None
+                       ) -> object:
     """Padded device copy of a derived host column, cached on the segment so
     repeated queries reuse the HBM-resident array exactly like staged data
-    columns (data/segment.py device cache, LRU-bounded)."""
+    columns (data/segment.py device cache, LRU-bounded).
+
+    `value_range=(lo, hi)` marks an int32 column whose exact range is a
+    plan constant (`__key`/`__bucket`): when the cascade FOR rung covers
+    it, the column stages as base-biased range-packed words instead of
+    dense int32 — decoded at the program top like any cascade column."""
+    plan = cascade_mod.for_encode_derived(*value_range) \
+        if value_range is not None and arr.dtype == np.int32 else None
+    if plan is not None:
+        w, base = plan
+
+        def _build_for():
+            import jax
+            out = np.full((padded,), fill, dtype=arr.dtype)
+            out[: arr.shape[0]] = arr
+            words = packed_mod.pack_padded(out, w, base)
+            return cascade_mod.ForColumn(jax.device_put(words), w, base,
+                                         padded, str(arr.dtype))
+        if cache_key is None:
+            return _build_for()
+        return segment.device_cached(
+            ("devpadfor", cache_key, padded, fill, w, base), _build_for)
     if cache_key is None:
         return _pad_device(arr, padded, fill)
     return segment.device_cached(("devpad", cache_key, padded, fill),

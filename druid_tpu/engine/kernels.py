@@ -229,6 +229,13 @@ class SumKernel(AggKernel):
     def __init__(self, spec, vtype: ValueType, segment: Optional[Segment] = None):
         super().__init__(spec)
         self.vtype = vtype
+        # code-domain constant sum (data/cascade.py ladder): a LONG column
+        # whose cached min == max sums as constant × group count — the
+        # column neither stages nor decodes (required_device_columns = {}).
+        # Exact: Σ c over k int rows ≡ c·k in int64. LONG only — float
+        # repetition vs multiplication differ in rounding. The constant
+        # rides aux (not the closure), so one program serves every value.
+        self.const_value: Optional[int] = None
         # exact narrow path: int32-staged long columns sum via CHUNKED int32
         # scatters (64-bit scatter is limb-emulated, ~5x) with int64
         # accumulation only at group granularity. chunk_rows bounds each
@@ -246,6 +253,12 @@ class SumKernel(AggKernel):
             vtype is ValueType.FLOAT and segment is not None
             and spec.field in segment.metrics
             and segment.column_finite(spec.field))
+        if vtype is ValueType.LONG and segment is not None \
+                and spec.field in segment.metrics:
+            from druid_tpu.data import cascade as cascade_mod
+            lo, hi = segment.column_minmax(spec.field)
+            if lo == hi and cascade_mod.enabled():
+                self.const_value = int(lo)
         if vtype is ValueType.LONG and segment is not None \
                 and spec.field in segment.metrics \
                 and segment.staged_dtype(spec.field) == np.int32:
@@ -270,11 +283,26 @@ class SumKernel(AggKernel):
     def signature(self):
         return (f"sum({self.spec.field},{self.vtype.value},{self.chunk_rows},"
                 f"mm{self.mm_limbs}:{self.mm_base}:"
-                f"{int(self.mm_float_ok)})")
+                f"{int(self.mm_float_ok)},"
+                f"c{int(self.const_value is not None)})")
+
+    def aux_arrays(self):
+        if self.const_value is not None:
+            return [np.asarray(self.const_value, dtype=np.int64)]
+        return []
+
+    def required_device_columns(self):
+        # constant column: the update reads NOTHING — the column stops
+        # staging entirely (the strongest cascade rung)
+        return set() if self.const_value is not None else None
 
     def mm_plan(self, cols_avail, padded_rows):
         import jax.numpy as jnp
         f = self.spec.field
+        if self.const_value is not None:
+            # the constant must stay out of the traced closure (aux-only,
+            # so one program serves every value) — no mm decomposition
+            return None
         # checked before the missing-column branch so plan-time
         # (select_strategy, staged columns only) and trace-time
         # (fuse_filter_update, includes virtual columns) decisions agree
@@ -328,6 +356,8 @@ class SumKernel(AggKernel):
 
     def pallas_op(self, cols_avail):
         f = self.spec.field
+        if self.const_value is not None:
+            return None                   # aux-fed paths only (see mm_plan)
         if f not in cols_avail:
             return ("zero",)
         dt = str(cols_avail[f])
@@ -344,6 +374,12 @@ class SumKernel(AggKernel):
         import jax
         import jax.numpy as jnp
         acc_dtype = jnp.dtype(self._DTYPES[self.vtype])
+        if self.const_value is not None:
+            # code-domain: Σ = constant × per-group row count; the column
+            # itself is never read (and was never staged)
+            c = next(aux)
+            # exact const×count contract; x64 is globally on (engine/__init__)
+            return _seg_sum(mask.astype(jnp.int64), keys, num) * c  # druidlint: disable=x64-dtype
         if self.spec.field not in cols:
             # missing column aggregates as null/zero (reference semantics)
             return jnp.zeros((num,), dtype=acc_dtype)
@@ -390,6 +426,8 @@ class SumKernel(AggKernel):
     BLOCK_ROWS = 2048
 
     def blocked_supported(self, cols_avail):
+        if self.const_value is not None:
+            return False  # the blocked step has no aux stream for c
         if self.spec.field not in cols_avail:
             return True   # missing column: constant zero carry
         if self.vtype is ValueType.FLOAT:

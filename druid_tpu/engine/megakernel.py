@@ -429,11 +429,25 @@ def stage_mega_leaves(segment, filter_node: Optional[FilterNode],
 
             def _build(dim=dim, lut=lut):
                 import jax
-                col = segment.dims[dim]
-                bm = col.bitmap_index().union_of(np.flatnonzero(lut))
-                b = bm.to_bool()
-                if perm is not None:
-                    b = b[perm]
+
+                from druid_tpu.data import cascade as cascade_mod
+                b = None
+                if perm is None and cascade_mod.enabled():
+                    # RLE-run-aware build: the match bit is decided once
+                    # PER RUN (one LUT gather over run values + a repeat),
+                    # not once per row — same output words bit-for-bit, so
+                    # the resident cache and kernel paths compose unchanged
+                    info = cascade_mod.column_run_info(segment, dim)
+                    if info is not None:
+                        values, ends, nr = info
+                        lengths = np.diff(np.concatenate([[0], ends]))
+                        b = np.repeat(lut[values], lengths)
+                if b is None:
+                    col = segment.dims[dim]
+                    bm = col.bitmap_index().union_of(np.flatnonzero(lut))
+                    b = bm.to_bool()
+                    if perm is not None:
+                        b = b[perm]
                 padded = np.zeros(n_w, dtype=bool)
                 padded[: b.shape[0]] = b
                 return jax.device_put(
@@ -541,6 +555,8 @@ def mega_reduce(arrays: Dict, mask, key, mega_nodes: Sequence[MegaBitmapNode],
     if packed_cols:
         for f in uniq_fields:
             pc = packed_cols.get(f)
+            # no decode-counter record: split_resident counted each
+            # packed column once at the program top (pallas_agg's rule)
             if pc is not None and R % pc.vpw == 0 and pc.rows == n:
                 pcs[f] = pc
     dense_fields = [f for f in uniq_fields if f not in pcs]

@@ -214,7 +214,11 @@ def pallas_reduce(arrays: Dict, mask, key, kernels: Sequence, num_total: int,
             pc = packed_cols.get(f)
             # vpw divides R by the PACK_WIDTHS contract; a descriptor that
             # violates it (or a row-count mismatch) falls back to the dense
-            # view of that field — correctness never depends on packing
+            # view of that field — correctness never depends on packing.
+            # No decode-counter record here: split_resident already
+            # counted each packed column once at the program top (the XLA
+            # unpack XLA dead-code-eliminates when this kernel consumes
+            # the words instead) — recording again would double-count.
             if pc is not None and R % pc.vpw == 0 and pc.rows == n:
                 pcs[f] = pc
     dense_fields = [f for f in uniq_fields if f not in pcs]

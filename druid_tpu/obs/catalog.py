@@ -191,6 +191,18 @@ METRICS = {
         "help": "per-group partial-buffer bytes handed back DONATED across "
                 "repeated executions since the last tick (standing-query "
                 "ticks update partials in place, zero per-tick HBM churn)"},
+    # ---- code-domain aggregation (data/cascade.py) ---------------------
+    "query/codeDomain/hits": {
+        "unit": "count/period", "dims": (),
+        "site": "data/cascade.py (CodeDomainMonitor)",
+        "help": "segment executions served fully over run metadata since "
+                "the last tick (no row-width column staged or decoded — "
+                "count/sum/min-max computed from run values × lengths)"},
+    "query/codeDomain/rows": {
+        "unit": "count/period", "dims": (),
+        "site": "data/cascade.py (CodeDomainMonitor)",
+        "help": "logical rows covered by code-domain (run-space) "
+                "executions since the last tick"},
     # ---- device filter-bitmap cache (engine/filters.py) ----------------
     "query/filter/deviceBitmapHits": {
         "unit": "count/period", "dims": (),
@@ -253,6 +265,13 @@ METRICS = {
                 "compressed-domain pool entries (1.0 = nothing packed); "
                 "the pool/h2d trace span's bytes attr is likewise the "
                 "COMPRESSED bus transfer, logicalBytes the decoded size"},
+    "segment/devicePool/cascadeRatio": {
+        "unit": "ratio", "dims": (),
+        "site": "data/devicepool.py",
+        "help": "decoded-equivalent bytes / actual resident bytes over "
+                "CASCADE-encoded pool entries only (RLE/delta/FOR/LZ4 — "
+                "data/cascade.py; 1.0 when nothing cascade-encoded is "
+                "resident)"},
     # ---- coordination (coordination/latch.py) --------------------------
     "coordination/leader/transitions": {
         "unit": "count", "dims": ("service", "node", "event", "term",

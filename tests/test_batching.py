@@ -184,8 +184,11 @@ def test_repeated_batched_query_builds_once(mixed_segments, monkeypatch):
 
     monkeypatch.setattr(batching, "_build_batched_fn", counted)
     ex = QueryExecutor(mixed_segments)
+    # "hour", not "all": a granularity-all pure count is code-domain
+    # eligible (data/cascade.py run-domain) and deliberately bypasses
+    # batching — this test is about the batched program cache
     q = {"queryType": "timeseries", "dataSource": "mix",
-         "intervals": [str(IV)], "granularity": "all",
+         "intervals": [str(IV)], "granularity": "hour",
          "aggregations": [{"type": "count", "name": "n"}]}
     first = ex.run_json(q)
     built = len(calls)
@@ -220,8 +223,11 @@ def test_pow2_chunks():
 
 def test_fill_ratio_recorded(mixed_segments):
     batching.stats().drain_events()
+    # "hour", not "all": granularity-all pure counts run code-domain
+    # (data/cascade.py) instead of batching — this test asserts the
+    # batched dispatch event stream
     q = {"queryType": "timeseries", "dataSource": "mix",
-         "intervals": [str(IV)], "granularity": "all",
+         "intervals": [str(IV)], "granularity": "hour",
          "aggregations": [{"type": "count", "name": "n"}]}
     QueryExecutor(mixed_segments).run_json(q)
     events, dropped = batching.stats().drain_events()

@@ -25,6 +25,8 @@ BENCH_ENV = {
     "DRUID_TPU_BENCH_BATCH_SEGMENTS": "4",
     "DRUID_TPU_BENCH_BATCH_ROWS": "1024",
     "DRUID_TPU_BENCH_INIT_TIMEOUT": "120",
+    "DRUID_TPU_BENCH_CASCADE_SEGMENTS": "4",
+    "DRUID_TPU_BENCH_CASCADE_ROWS": "2048",
     "DRUID_TPU_BENCH_CLIENTS": "4",
     "DRUID_TPU_BENCH_CLIENT_QUERIES": "3",
     "DRUID_TPU_BENCH_SCHED_ROWS": "1024",
@@ -87,6 +89,16 @@ def test_bench_exits_zero_with_one_json_line():
     assert out["dispatch_count_fused"] == 1
     assert out["dispatch_count_staged"] >= 2
     assert out["donated_tick_rate"] > 0
+    # the cascaded-encodings comparison (contract only: rates positive,
+    # the pool really held cascade-encoded bytes, and the code-domain
+    # run-space path really executed — throughput ordering is asserted on
+    # real hardware, the filter-bench discipline)
+    assert out["rle_rate"] > 0
+    assert out["packed_only_rate"] > 0
+    assert out["cascade_ratio"] > 1.0
+    assert out["code_domain_rate"] > 0
+    # the non-default-register sketch shape (log2m=12 rider)
+    assert out["hll_log2m12_rate"] > 0
     # the qtrace-overhead fields tracked across BENCH_r* runs
     assert out["traced_rate"] > 0
     assert out["untraced_rate"] > 0

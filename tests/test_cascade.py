@@ -1,0 +1,489 @@
+"""Cascaded encodings + code-domain aggregation (data/cascade.py).
+
+The acceptance bar of the cascade PR: cascade-encoded execution is
+bit-identical (floats included) to the decoded oracle over groupBy /
+timeseries / topN / virtual-column / batched / megakernel paths; the
+code-domain paths perform ZERO unpack (trace-time decode counter) and
+stage no row-width column; and the fixed-budget residency test holds ≥3x
+more segments than packed-only staging on an RLE-friendly shape."""
+import numpy as np
+import pytest
+
+import druid_tpu.engine  # noqa: F401  (x64 on before jax numerics)
+from druid_tpu.data import cascade, devicepool, packed
+from druid_tpu.data.devicepool import DeviceSegmentPool, entry_cascade_bytes
+from druid_tpu.data.segment import SegmentBuilder
+from druid_tpu.engine.executor import QueryExecutor
+from druid_tpu.native import lz4block
+from druid_tpu.utils.intervals import Interval
+
+IV = Interval.of("2026-06-01", "2026-06-02")
+
+
+@pytest.fixture
+def fresh_pool(monkeypatch):
+    pool = DeviceSegmentPool(budget_bytes=1 << 40)
+    monkeypatch.setattr(devicepool, "_POOL", pool)
+    return pool
+
+
+def rollup_segments(n=3, rows=2048, card=8, n_dims=2, n_mets=2,
+                    float_col=False, seed=0):
+    """Rollup-shaped segments: dimension-sorted rows, near-constant time,
+    a constant `cnt` metric, run-aligned small-range `mN` metrics, a
+    row-random `noise` metric, and optionally a compressible float."""
+    rng = np.random.default_rng(seed)
+    reps = -(-rows // card)
+    segs = []
+    for si in range(n):
+        b = SegmentBuilder("casc", IV, version="v0", partition=si)
+        dims = {f"d{i}": np.repeat(
+            [f"v{i}_{j:03d}" for j in range(card)], reps)[:rows].tolist()
+            for i in range(n_dims)}
+        mets = {"cnt": np.ones(rows, dtype=np.int64),
+                "noise": rng.integers(0, 500, rows).astype(np.int64)}
+        for i in range(n_mets):
+            mets[f"m{i}"] = np.repeat(
+                (np.arange(card) * (7 + i)) % 13, reps)[:rows].astype(
+                    np.int64)
+        if float_col:
+            mets["f"] = (np.arange(rows) % 16).astype(np.float32)
+        time = IV.start + (np.arange(rows, dtype=np.int64) // 64)
+        b.add_columns(time, dims, mets)
+        segs.append(b.build())
+    return segs
+
+
+def _run_modes(query_json, segments):
+    """(decoded-oracle results, cascade results) — the oracle runs with
+    BOTH cascade and packing off (fully decoded staging)."""
+    ex = QueryExecutor(segments)
+    pc, pk = cascade.set_enabled(False), packed.set_enabled(False)
+    try:
+        oracle = ex.run_json(query_json)
+    finally:
+        cascade.set_enabled(pc)
+        packed.set_enabled(pk)
+    return oracle, ex.run_json(query_json)
+
+
+# ---------------------------------------------------------------------------
+# encoder unit level
+# ---------------------------------------------------------------------------
+
+def test_rle_roundtrip_device():
+    import jax
+    v = np.repeat(np.arange(11, dtype=np.int32), 97)[:1000]
+    values, ends = cascade.rle_encode(v)
+    assert values.shape == ends.shape and ends[-1] == 1000
+    rpad = cascade.pad_pow2(values.shape[0])
+    pv = np.zeros(rpad, np.int32)
+    pv[: values.shape[0]] = values
+    pe = np.full(rpad, 1000, np.int32)
+    pe[: ends.shape[0]] = ends
+    rc = cascade.RleColumn(jax.device_put(pv), jax.device_put(pe),
+                           1000, 1024)
+    out = np.asarray(jax.jit(cascade.rle_decode_device)(rc))
+    np.testing.assert_array_equal(out[:1000], v)
+    np.testing.assert_array_equal(out[1000:], 0)   # staging pad fill
+
+
+def test_delta_roundtrip_device():
+    import jax
+    v = np.cumsum(np.random.default_rng(1).integers(
+        0, 13, 2048)).astype(np.int32)
+    padded = np.zeros(4096, np.int32)
+    padded[:2048] = v
+    w = packed.width_for(12, 0)
+    words, first = cascade.delta_encode(padded, 2048, w)
+    dc = cascade.DeltaColumn(jax.device_put(words), jax.device_put(first),
+                             w, 4096)
+    out = np.asarray(jax.jit(cascade.delta_decode_device)(dc))
+    np.testing.assert_array_equal(out[:2048], v)
+    np.testing.assert_array_equal(out[2048:], v[-1])  # pad repeats last
+
+
+@pytest.mark.parametrize("codec", ["python", "best"])
+def test_lz4_block_roundtrip(codec):
+    rng = np.random.default_rng(2)
+    for data in (b"", b"abc", b"a" * 5000,
+                 bytes(rng.integers(0, 4, 400).astype(np.uint8)),
+                 (np.arange(999, dtype=np.float32) % 7).tobytes(),
+                 bytes(rng.integers(0, 256, 256).astype(np.uint8))):
+        comp = lz4block.py_compress(data) if codec == "python" \
+            else lz4block.compress(data)
+        assert lz4block.py_decompress(comp, len(data)) == data
+        lits, ll, ml, off = lz4block.tokenize(comp)
+        assert int(ll.sum()) + int(ml.sum()) == len(data)
+        assert int(ll.sum()) == lits.shape[0]
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_lz4_device_decode_bit_identical(dtype):
+    import jax
+    vals = ((np.arange(3000) % 21) * 0.5).astype(dtype)
+    comp = lz4block.compress(vals.tobytes())
+    lits, ll, ml, off = lz4block.tokenize(comp)
+    tp = cascade.pad_pow2(ll.shape[0])
+    lp = cascade.pad_pow2(max(lits.shape[0], 1))
+
+    def padto(a, n, dt):
+        out = np.zeros(n, dtype=dt)
+        out[: a.shape[0]] = a
+        return jax.device_put(out)
+    col = cascade.Lz4Column(padto(lits, lp, np.uint8),
+                            padto(ll, tp, np.int32),
+                            padto(ml, tp, np.int32),
+                            padto(off, tp, np.int32),
+                            3000, 4096, np.dtype(dtype).name)
+    out = np.asarray(jax.jit(cascade.lz4_decode_device)(col))
+    np.testing.assert_array_equal(out[:3000], vals)   # exact, bit-level
+    np.testing.assert_array_equal(out[3000:], 0)
+
+
+def test_plan_is_pure_and_claims_are_exclusive(fresh_pool):
+    seg = rollup_segments(1, rows=2048, float_col=True)[0]
+    cols = ["d0", "d1", "cnt", "m0", "noise", "f"]
+    cascades, packs = cascade.plan_pair(seg, cols)
+    by_name = {e[0]: e for e in cascades}
+    assert by_name["d0"][1] == "rle"            # sorted dim: RLE
+    assert by_name["cnt"][1] == "rle"           # constant metric: 1 run
+    assert by_name["m0"][1] == "rle"
+    assert by_name["__time_offset"][1] in ("delta", "for")
+    assert by_name["f"][1] == "lz4"             # compressible float
+    assert "noise" not in by_name               # row-random: no runs
+    packed_names = {p[0] for p in packs}
+    assert packed_names.isdisjoint(by_name)     # one encoding per column
+    assert "noise" in packed_names              # small range still packs
+    # purity: identical stats -> identical descriptors, every call
+    assert cascade.plan_pair(seg, cols) == (cascades, packs)
+    # permuted staging never cascades (a row permutation destroys runs)
+    assert cascade.plan_columns(seg, cols, permuted=True) == ()
+    prev = cascade.set_enabled(False)
+    try:
+        assert cascade.plan_columns(seg, cols) == ()
+    finally:
+        cascade.set_enabled(prev)
+
+
+def test_wide_time_spread_does_not_cascade(fresh_pool):
+    b = SegmentBuilder("casc", IV)
+    rng = np.random.default_rng(5)
+    t = np.sort(rng.integers(IV.start, IV.end, 512))
+    b.add_columns(t, {"d": [f"x{i}" for i in range(512)]},
+                  {"m": rng.integers(0, 100, 512).astype(np.int64)})
+    seg = b.build()
+    assert cascade.plan_column(seg, "__time_offset") is None
+
+
+# ---------------------------------------------------------------------------
+# engine parity (the acceptance bar: exact equality, floats included)
+# ---------------------------------------------------------------------------
+
+GROUPBY = {
+    "queryType": "groupBy", "dataSource": "casc", "intervals": [str(IV)],
+    "granularity": "all", "dimensions": ["d0"],
+    "aggregations": [
+        {"type": "count", "name": "n"},
+        {"type": "longSum", "name": "c", "fieldName": "cnt"},
+        {"type": "longSum", "name": "s", "fieldName": "m0"},
+        {"type": "longMin", "name": "lm", "fieldName": "noise"},
+    ],
+    "filter": {"type": "in", "dimension": "d1",
+               "values": [f"v1_{j:03d}" for j in range(0, 8, 2)]},
+}
+
+#: the fully run-aligned variant: every referenced column (group dim,
+#: filter dim, summed/min'd metrics) is constant within the shared run
+#: partition, so granularity-"all" executions go code-domain
+RUN_GROUPBY = dict(GROUPBY)
+RUN_GROUPBY["aggregations"] = [
+    {"type": "count", "name": "n"},
+    {"type": "longSum", "name": "c", "fieldName": "cnt"},
+    {"type": "longSum", "name": "s", "fieldName": "m0"},
+    {"type": "longMin", "name": "lm", "fieldName": "m1"},
+]
+
+
+@pytest.mark.parametrize("granularity", ["all", "hour"],
+                         ids=["all", "hour"])
+def test_groupby_parity(fresh_pool, granularity):
+    # GROUPBY aggregates the row-random `noise` column, so even the
+    # granularity-all variant stays a ROW program (the joint run
+    # partition is too fine) — the code-domain variant is RUN_GROUPBY
+    q = dict(GROUPBY, granularity=granularity)
+    oracle, casc = _run_modes(q, rollup_segments())
+    assert oracle == casc
+
+
+def test_groupby_run_domain_parity(fresh_pool):
+    oracle, casc = _run_modes(RUN_GROUPBY, rollup_segments())
+    assert oracle == casc
+
+
+def test_timeseries_and_topn_parity(fresh_pool):
+    segs = rollup_segments(float_col=True)
+    ts = {"queryType": "timeseries", "dataSource": "casc",
+          "intervals": [str(IV)], "granularity": "hour",
+          "aggregations": [
+              {"type": "count", "name": "n"},
+              {"type": "longSum", "name": "s", "fieldName": "m0"},
+              {"type": "doubleSum", "name": "fs", "fieldName": "f"},
+          ]}
+    oracle, casc = _run_modes(ts, segs)
+    assert oracle == casc
+    topn = {"queryType": "topN", "dataSource": "casc",
+            "intervals": [str(IV)], "granularity": "all",
+            "dimension": "d0", "metric": "s", "threshold": 5,
+            "aggregations": [
+                {"type": "count", "name": "n"},
+                {"type": "longSum", "name": "s", "fieldName": "m1"}]}
+    oracle, casc = _run_modes(topn, segs)
+    assert oracle == casc
+
+
+def test_virtual_column_parity_reads_cascade_input(fresh_pool):
+    q = dict(GROUPBY)
+    q["virtualColumns"] = [{"type": "expression", "name": "v",
+                            "expression": "m0 * 2 + 1",
+                            "outputType": "long"}]
+    q["aggregations"] = GROUPBY["aggregations"] + [
+        {"type": "longSum", "name": "vs", "fieldName": "v"}]
+    oracle, casc = _run_modes(q, rollup_segments())
+    assert oracle == casc
+
+
+def test_batched_path_parity_and_shared_buckets(fresh_pool):
+    from druid_tpu.engine import batching
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.utils.granularity import Granularity
+
+    segs = rollup_segments(4, rows=1500)
+    q = dict(GROUPBY, granularity="hour")       # row program: batchable
+    oracle, casc = _run_modes(q, segs)
+    assert oracle == casc
+    # chunk-mates agree on the cascade descriptor: same-stats segments
+    # share one shape bucket, and the descriptor is present in it
+    aggs = [CountAggregator("n"), LongSumAggregator("s", "m0")]
+    plans = [batching._plan_for(s, [], i, [IV], Granularity.of("hour"),
+                                aggs, None, [])
+             for i, s in enumerate(segs)]
+    assert all(p.eligible for p in plans)
+    assert len({p.cascades for p in plans}) == 1
+    assert plans[0].cascades
+    assert len({p.digest for p in plans}) == 1
+
+
+def test_megakernel_path_parity(fresh_pool):
+    """Single-segment cold query with a bitmap-eligible filter: the
+    megakernel (one-dispatch) path over cascade-staged columns."""
+    from druid_tpu.engine import megakernel
+    assert megakernel.enabled()
+    segs = rollup_segments(1, rows=4096)
+    q = dict(GROUPBY, granularity="hour",
+             filter={"type": "or", "fields": [
+                 {"type": "selector", "dimension": "d1",
+                  "value": "v1_001"},
+                 {"type": "selector", "dimension": "d1",
+                  "value": "v1_005"}]})
+    oracle, casc = _run_modes(q, segs)
+    assert oracle == casc
+
+
+def test_staged_bitmap_runs_leaf_parity(fresh_pool):
+    """The staged (fill-wave) device-bitmap path with the RLE-run-aware
+    leaf representation: a sorted dim's leaf ships as a run table and the
+    expanded words match the row-built oracle bit-for-bit."""
+    from druid_tpu.engine import filters as filters_mod
+    from druid_tpu.engine import megakernel
+    seg = rollup_segments(1, rows=4096)[0]
+    lut = np.zeros(seg.dims["d1"].cardinality, dtype=bool)
+    lut[1::2] = True
+    payload = filters_mod._run_leaf_payload(seg, "d1", lut, 4096)
+    assert payload is not None and payload.shape[1] == 2
+    prev = megakernel.set_enabled(False)   # pin the staged fill path
+    try:
+        oracle, casc = _run_modes(
+            dict(GROUPBY, granularity="hour"), [seg])
+    finally:
+        megakernel.set_enabled(prev)
+    assert oracle == casc
+
+
+# ---------------------------------------------------------------------------
+# code-domain: zero unpack, zero row-width staging
+# ---------------------------------------------------------------------------
+
+def test_run_domain_zero_unpack_and_parity(fresh_pool):
+    segs = rollup_segments(2, rows=4096)
+    oracle, _ = _run_modes(RUN_GROUPBY, segs)  # oracle decodes; then reset
+    fresh_pool.clear()
+    cascade.reset_decode_stats()
+    h0 = cascade.code_domain_stats().snapshot()
+    from druid_tpu.obs import dispatch as dispatch_mod
+    d0 = dispatch_mod.stats().snapshot().get("runDomain", 0)
+    got = QueryExecutor(segs).run_json(RUN_GROUPBY)
+    assert got == oracle
+    # ZERO unpack: no decode of any kind entered any program
+    assert cascade.decode_stats() == {}
+    h1 = cascade.code_domain_stats().snapshot()
+    assert h1["hits"] - h0["hits"] == len(segs)
+    assert h1["rows"] - h0["rows"] == sum(s.n_rows for s in segs)
+    assert dispatch_mod.stats().snapshot()["runDomain"] - d0 == len(segs)
+    # zero row-width staging: every pool entry is run-table sized
+    assert fresh_pool.snapshot().resident_bytes < 4096 * 4
+
+
+def test_const_sum_column_never_stages(fresh_pool):
+    """sum-over-dictionary-constant: the constant column contributes NO
+    staged column even on the row program path (required_device_columns
+    = {}), and the sum is exact."""
+    segs = rollup_segments(2, rows=2048)
+    q = {"queryType": "timeseries", "dataSource": "casc",
+         "intervals": [str(IV)], "granularity": "hour",
+         "aggregations": [{"type": "count", "name": "n"},
+                          {"type": "longSum", "name": "c",
+                           "fieldName": "cnt"}]}
+    oracle, casc_rows = _run_modes(q, segs)
+    assert oracle == casc_rows
+    for row in casc_rows:
+        assert row["result"]["c"] == row["result"]["n"]  # cnt ≡ 1
+    from druid_tpu.engine.kernels import SumKernel, make_kernel
+    from druid_tpu.query.aggregators import LongSumAggregator
+    k = make_kernel(LongSumAggregator("c", "cnt"), segs[0])
+    assert isinstance(k, SumKernel) and k.const_value == 1
+    assert k.required_device_columns() == set()
+    prev = cascade.set_enabled(False)
+    try:
+        k2 = make_kernel(LongSumAggregator("c", "cnt"), segs[0])
+    finally:
+        cascade.set_enabled(prev)
+    assert k2.const_value is None              # opt-out restores old world
+
+
+def test_run_domain_respects_optout(fresh_pool):
+    segs = rollup_segments(2, rows=2048)
+    prev = cascade.set_enabled(False)
+    try:
+        h0 = cascade.code_domain_stats().snapshot()["hits"]
+        QueryExecutor(segs).run_json(RUN_GROUPBY)
+        assert cascade.code_domain_stats().snapshot()["hits"] == h0
+    finally:
+        cascade.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# residency: ≥3x more segments than packed-only at a fixed budget
+# ---------------------------------------------------------------------------
+
+def test_pool_holds_3x_more_segments_than_packed_only(fresh_pool):
+    """The acceptance bar on the RLE-friendly shape: cascade staging must
+    fit ≥ 3x the segments packed-only staging fits at one byte budget."""
+    n_segments, rows = 12, 2048
+    segs = rollup_segments(n_segments, rows=rows, card=8, n_dims=5,
+                           n_mets=3, seed=3)
+    q = {"queryType": "groupBy", "dataSource": "casc",
+         "intervals": [str(IV)], "granularity": "hour",
+         "dimensions": ["d0", "d1"],
+         "filter": {"type": "and", "fields": [
+             {"type": "in", "dimension": d,
+              "values": [f"v{d[1]}_{j:03d}" for j in range(4)]}
+             for d in ("d2", "d3", "d4")]},
+         "aggregations": [{"type": "count", "name": "n"},
+                          {"type": "longSum", "name": "s0",
+                           "fieldName": "m0"},
+                          {"type": "longSum", "name": "s1",
+                           "fieldName": "m1"},
+                          {"type": "longMin", "name": "s2",
+                           "fieldName": "m2"}]}
+    ex = QueryExecutor(segs)
+    # pin the column paths: this measures STAGED bytes, so the device
+    # bitmap path (which stops staging filter columns) is disabled in
+    # both modes, exactly like test_packed's ≥3x test
+    from druid_tpu.engine import filters as _filters
+    prev_bmp = _filters.set_device_bitmap_enabled(False)
+    prev_c = cascade.set_enabled(False)
+    try:
+        packed_only = ex.run_json(q)
+        packed_per_seg = fresh_pool.snapshot().resident_bytes / n_segments
+        fresh_pool.clear()
+        cascade.set_enabled(True)
+        casc_rows = ex.run_json(q)
+        s = fresh_pool.snapshot()
+        assert packed_only == casc_rows            # parity rides along
+        casc_per_seg = s.resident_bytes / n_segments
+        multiplier = packed_per_seg / casc_per_seg
+        assert multiplier >= 3.0, (
+            f"cascade staging only {multiplier:.2f}x over packed-only "
+            f"({packed_per_seg:.0f}B -> {casc_per_seg:.0f}B per segment)")
+        assert s.cascade_ratio >= 3.0
+        # a budget sized for ~4 packed-only stagings holds every segment
+        budget = int(packed_per_seg * 4)
+        fresh_pool.clear()
+        fresh_pool.configure(budget)
+        ex.run_json(q)
+        s = fresh_pool.snapshot()
+        assert s.entries >= n_segments
+        assert s.resident_bytes <= budget
+    finally:
+        cascade.set_enabled(prev_c)
+        _filters.set_device_bitmap_enabled(prev_bmp)
+
+
+# ---------------------------------------------------------------------------
+# pool accounting + monitors
+# ---------------------------------------------------------------------------
+
+def test_pool_cascade_accounting(fresh_pool):
+    segs = rollup_segments(1, rows=2048)
+    q = dict(GROUPBY, granularity="hour")
+    QueryExecutor(segs).run_json(q)
+    s = fresh_pool.snapshot()
+    assert s.cascade_bytes > 0
+    assert s.cascade_logical_bytes > s.cascade_bytes
+    assert s.cascade_ratio > 1.0
+    assert s.cascade_bytes <= s.resident_bytes
+    # the walker counts cascade-marked leaves only
+    import jax
+    rc = cascade.RleColumn(jax.device_put(np.zeros(8, np.int32)),
+                           jax.device_put(np.zeros(8, np.int32)), 64, 1024)
+    actual, logical = entry_cascade_bytes({"a": rc, "b": np.zeros(16)})
+    assert (actual, logical) == (64, 4096)
+
+
+def test_code_domain_monitor_emits_cataloged_names(fresh_pool):
+    from druid_tpu.obs import catalog
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+    sink = InMemoryEmitter()
+    em = ServiceEmitter("s", "h", sink)
+    mon = cascade.CodeDomainMonitor(cascade.CodeDomainStats())
+    mon.source.record(1234)
+    mon.do_monitor(em)
+    names = {e.metric for e in sink.events}
+    assert names == {"query/codeDomain/hits", "query/codeDomain/rows"}
+    assert catalog.validate_emitted(names) == []
+
+
+# ---------------------------------------------------------------------------
+# hyperUnique/cardinality at non-default registers (log2m != 11 rider)
+# ---------------------------------------------------------------------------
+
+def test_hyperunique_log2m12_parity(fresh_pool):
+    from druid_tpu.engine import batching
+    segs = rollup_segments(4, rows=1500, card=8)
+    q = {"queryType": "groupBy", "dataSource": "casc",
+         "intervals": [str(IV)], "granularity": "all",
+         "dimensions": ["d0"],
+         "aggregations": [
+             {"type": "count", "name": "n"},
+             {"type": "hyperUnique", "name": "u", "fieldName": "d1",
+              "log2m": 12}]}
+    oracle, casc_rows = _run_modes(q, segs)
+    assert oracle == casc_rows
+    prev = batching.set_enabled(False)
+    try:
+        per_seg = QueryExecutor(segs).run_json(q)
+    finally:
+        batching.set_enabled(prev)
+    assert per_seg == oracle

@@ -286,6 +286,150 @@ class Fan:
     assert findings_of(src, "unreleased-resource") == []
 
 
+def test_points_to_keeps_obligation_when_callee_cannot_close():
+    """The PR 14 rider: bare `self.X` as an argument transfers ownership
+    ONLY when the callee can actually close it. A resolvable program
+    function that merely READS the handle (no release call, no store, no
+    return, no re-escape) does not take the obligation — the missing
+    release is still flagged."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+def describe(pool):
+    return f"pool with {pool._max_workers} workers"
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        self.label = describe(self._pool)
+
+    def stop(self):
+        pass
+"""
+    got = findings_of(src, "unreleased-resource")
+    assert got, "an inert read-only callee must not transfer ownership"
+
+
+def test_points_to_transfer_when_callee_really_closes():
+    """A program callee that releases (or stores) its parameter IS an
+    ownership transfer — exactly the registrar shape that must stay
+    quiet, now proven instead of assumed."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+def drain_and_close(pool):
+    pool.shutdown()
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        drain_and_close(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
+def test_points_to_bound_method_reference_transfers():
+    """A callee that stashes a RELEASE bound method (`c.shutdown` as a
+    value) or captures the parameter in a closure can close it later —
+    both must count as ownership transfer (stay quiet)."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+_SINKS = {}
+
+def defer_close(pool):
+    _SINKS["x"] = pool.shutdown
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        defer_close(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unreleased-resource") == []
+    src2 = """\
+from concurrent.futures import ThreadPoolExecutor
+
+_CBS = []
+
+def defer(pool):
+    _CBS.append(lambda: pool.shutdown())
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        defer(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src2, "unreleased-resource") == []
+
+
+def test_points_to_global_store_and_tuple_return_transfer():
+    """A callee storing the parameter into a declared global, or
+    returning it inside a tuple, hands ownership onward — both quiet."""
+    src = """\
+from concurrent.futures import ThreadPoolExecutor
+
+_POOL = None
+
+def install(pool):
+    global _POOL
+    _POOL = pool
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        install(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unreleased-resource") == []
+    src2 = """\
+from concurrent.futures import ThreadPoolExecutor
+
+def wrap(pool):
+    return (pool, "label")
+
+class Fan:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(4)
+        self.handle = wrap(self._pool)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src2, "unreleased-resource") == []
+
+
+def test_points_to_transitive_escape_stays_conservative():
+    """The callee hands the parameter onward to something unresolvable:
+    the pass must stay conservative (transfer assumed, no finding)."""
+    src = """\
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+def register(pool, registry):
+    registry.add(pool)
+
+class Fan:
+    def __init__(self, registry):
+        self._pool = ThreadPoolExecutor(4)
+        register(self._pool, registry)
+
+    def stop(self):
+        pass
+"""
+    assert findings_of(src, "unreleased-resource") == []
+
+
 def test_held_threaded_service_needs_stop():
     """A class whose ctor starts a thread is itself a resource: holding
     one without stopping it strands the worker."""

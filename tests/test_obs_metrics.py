@@ -68,6 +68,7 @@ def test_every_monitor_metric_is_cataloged():
     emits are all declared — the runtime counterpart of the AST-level
     metric-name lint rule."""
     from druid_tpu.cluster import LruCache
+    from druid_tpu.data.cascade import CodeDomainMonitor, CodeDomainStats
     from druid_tpu.data.devicepool import DevicePoolMonitor
     from druid_tpu.engine.batching import BatchMetricsMonitor
     from druid_tpu.utils.emitter import (CacheMonitor, MonitorScheduler,
@@ -78,9 +79,12 @@ def test_every_monitor_metric_is_cataloged():
     qc.on_query(True)
     cache = LruCache()
     cache.put("x", "k", 1)
+    cds = CodeDomainStats()
+    cds.record(100)
     sched = MonitorScheduler(
         em, [SysMonitor(), ProcessMonitor(), qc, CacheMonitor(cache),
-             DevicePoolMonitor(), BatchMetricsMonitor()], 999)
+             DevicePoolMonitor(), BatchMetricsMonitor(),
+             CodeDomainMonitor(cds)], 999)
     sched.tick()
     sched.tick()
     missing = catalog.validate_emitted(e.metric for e in sink.metrics())

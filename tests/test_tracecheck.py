@@ -1044,3 +1044,59 @@ def test_opaque_comprehension_multiplicity_flagged():
     hits = check_source(textwrap.dedent(src), PALLAS, cfg())
     assert any(f.rule == "vmem-budget" and "multiplicity" in f.message
                for f in hits)
+
+
+# ---- cascade run tiles (data/cascade.py run metadata) ---------------------
+
+def test_cascade_run_tile_shapes_within_bounds():
+    """Run-metadata tiles resolve through the declared run-count/run-length
+    SYMBOL_BOUNDS (contracts: n_runs/Rrun ≤ CASCADE_MAX_RUNS, run_len ≤ a
+    batched segment): a kernel streaming run values/ends as (Rrun, 128)
+    tiles — the full CASCADE_MAX_RUNS table resident at once — passes
+    pallas-tile-shape and stays inside the VMEM budget without per-site
+    annotations."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(n_runs, Rrun, run_len):
+        rpad = _round_up(n_runs, 128)
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((Rrun, 128),
+                                   lambda i: (i, jnp.int32(0))),
+                      pl.BlockSpec((rpad // 128, 128),
+                                   lambda i: (jnp.int32(0), jnp.int32(0))),
+                      pl.BlockSpec((max(run_len // 128, 1), 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    hits = check_source(textwrap.dedent(src), PALLAS, cfg())
+    assert not [f for f in hits if f.rule in ("vmem-budget",
+                                              "pallas-tile-shape")], hits
+
+
+def test_cascade_run_tile_oversized_flagged():
+    """Scaling a run tile past the contract cap must blow the VMEM budget
+    — the n_runs/Rrun bounds are measured contracts, not waivers."""
+    src = """
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def build(Rrun):
+        return pl.GridSpec(
+            grid=(8,),
+            in_specs=[pl.BlockSpec((Rrun * 8192, 128),
+                                   lambda i: (i, jnp.int32(0)))],
+        )
+    """
+    assert "vmem-budget" in rules_hit(src, PALLAS)
+
+
+def test_cascade_unbounded_run_symbol_still_flagged():
+    """A run-shaped name OUTSIDE the declared bounds stays unresolvable —
+    the bounds cover exactly the contract symbols, nothing else."""
+    src = ("from jax.experimental import pallas as pl\n"
+           "grid_spec = pl.GridSpec(grid=(8,), in_specs=[" +
+           "pl.BlockSpec((mystery_runs, 128), lambda i: (i, 0))])\n")
+    assert "pallas-tile-shape" in rules_hit(src, PALLAS)

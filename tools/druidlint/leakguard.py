@@ -363,17 +363,164 @@ def _collect_class(prog: Program, ck: str,
                         out.started_attrs.add(home)
                 # bare `self.X` as an argument = ownership escapes (a
                 # Lifecycle.add(self._monitors) registrar now owns the
-                # stop; a callback receiver may close it)
-                for arg in list(node.args) + [kw.value
-                                              for kw in node.keywords]:
+                # stop; a callback receiver may close it) — but ONLY when
+                # the callee can actually close it: a points-to pass over
+                # resolvable program callees keeps the obligation here
+                # when the receiving parameter is provably never
+                # released, stored, returned, or re-escaped (the PR 14
+                # rider; unresolvable callees stay conservative)
+                for pos, arg in enumerate(node.args):
                     attr = _self_attr(arg, self_name)
-                    if attr is not None:
+                    if attr is not None and _callee_can_close(
+                            prog, mod, scope, node, pos, None):
+                        out.escaped_attrs.add(attr)
+                for kw in node.keywords:
+                    attr = _self_attr(kw.value, self_name)
+                    if attr is not None and _callee_can_close(
+                            prog, mod, scope, node, None, kw.arg):
                         out.escaped_attrs.add(attr)
             elif isinstance(node, ast.Return) and node.value is not None:
                 attr = _self_attr(node.value, self_name)
                 if attr is not None:
                     out.escaped_attrs.add(attr)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Points-to: can a callee close the attribute handed to it?
+# ---------------------------------------------------------------------------
+
+#: transitive-escape recursion bound: past this depth the pass answers
+#: "yes, it can close it" (the pre-pass conservative default)
+_POINTS_TO_DEPTH = 3
+
+
+def _callee_can_close(prog: Program, mod, scope: _Scope, call: ast.Call,
+                      pos: Optional[int], kw_name: Optional[str],
+                      depth: int = 0) -> bool:
+    """True when passing an owned attribute as this call argument may
+    transfer the release obligation. Conservative by default (unknown or
+    external callees, constructors, varargs, re-escapes all answer True);
+    False ONLY when the callee resolves to a program function whose
+    receiving parameter is provably inert — never the receiver of a
+    release-family method, never stored into an attribute/subscript,
+    never returned/yielded, never a context manager, and never passed on
+    to anything that could itself close it (followed transitively to
+    _POINTS_TO_DEPTH)."""
+    if depth >= _POINTS_TO_DEPTH:
+        return True
+    got = _resolve_value(prog, mod, scope, call.func)
+    if got is None or got[0] == "class":
+        return True                       # unknown / constructor stores it
+    if got[0] != "func":
+        return True
+    fi = prog.funcs.get(got[1])
+    if fi is None or isinstance(fi.node, ast.Lambda):
+        return True
+    args = fi.node.args
+    if args.vararg is not None or args.kwarg is not None:
+        return True
+    params = [a.arg for a in getattr(args, "posonlyargs", [])] \
+        + [a.arg for a in args.args]
+    if fi.class_key is not None and isinstance(call.func, ast.Attribute) \
+            and params:
+        params = params[1:]               # bound call: drop self
+    if kw_name is not None:
+        pname = kw_name if kw_name in params \
+            or kw_name in {a.arg for a in args.kwonlyargs} else None
+    else:
+        pname = params[pos] if pos is not None and pos < len(params) \
+            else None
+    if pname is None:
+        return True
+    return _param_can_be_closed(prog, fi, pname, depth)
+
+
+def _param_can_be_closed(prog: Program, fi, pname: str,
+                         depth: int) -> bool:
+    """Whether `pname` inside `fi` can end up closed/owned elsewhere.
+    Tracks direct uses plus simple local aliases (`x = pname`)."""
+    all_release_names = set().union(*RELEASES.values())
+    names = {pname}
+    #: names the function declares global/nonlocal: a store to one is an
+    #: ownership transfer, not a local alias
+    outer_names: Set[str] = set()
+    for node in _own(fi):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            outer_names.update(node.names)
+    for node in _src_order(fi):           # aliases first, source order
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id not in outer_names \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in names:
+            names.add(node.targets[0].id)
+
+    def is_tracked(expr) -> bool:
+        return isinstance(expr, ast.Name) and expr.id in names
+
+    def holds_tracked(expr) -> bool:
+        """The VALUE being stored/returned holds the resource itself: the
+        bare name, or the name inside (nested) tuple/list/set/dict
+        containers. Derived expressions (an f-string reading an
+        attribute, arithmetic) yield new objects, not the handle."""
+        if is_tracked(expr):
+            return True
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(holds_tracked(e) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return any(v is not None and holds_tracked(v)
+                       for v in expr.values)
+        if isinstance(expr, ast.Starred):
+            return holds_tracked(expr.value)
+        return False
+
+    mod = prog.modules[fi.path]
+    scope = _Scope(mod, _closure_frames(prog, mod, fi)
+                   + [_frame_of(prog, mod, fi)])
+    # a closure (nested def/lambda) capturing the parameter can release
+    # it later from anywhere — conservative escape
+    for node in ast.walk(fi.node):
+        if isinstance(node, _FUNC_DEFS + (ast.Lambda,)) \
+                and node is not fi.node:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in names:
+                    return True
+    for node in _own(fi):
+        if isinstance(node, ast.Attribute) and is_tracked(node.value) \
+                and node.attr in all_release_names:
+            # any REFERENCE to a release-family attribute of the param —
+            # `param.close()` but also a bound-method value like
+            # `sinks.append(param.close)` — can release it
+            return True
+        if isinstance(node, ast.Call):
+            # param passed onward: recurse (bounded); unresolvable → True
+            for i, arg in enumerate(node.args):
+                if is_tracked(arg) and _callee_can_close(
+                        prog, mod, scope, node, i, None, depth + 1):
+                    return True
+            for kw in node.keywords:
+                if is_tracked(kw.value) and _callee_can_close(
+                        prog, mod, scope, node, None, kw.arg, depth + 1):
+                    return True
+        elif isinstance(node, ast.Assign):
+            # stored into an attribute/subscript or a global/nonlocal
+            # name: ownership taken (any tracked name anywhere in the
+            # stored value counts — tuples, method references, wrappers)
+            for t in node.targets:
+                outer = isinstance(t, ast.Name) and t.id in outer_names
+                if (isinstance(t, (ast.Attribute, ast.Subscript))
+                        or outer) and holds_tracked(node.value):
+                    return True
+        elif isinstance(node, (ast.Return, ast.Yield)) \
+                and getattr(node, "value", None) is not None:
+            if holds_tracked(node.value):
+                return True
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if is_tracked(item.context_expr):
+                    return True           # __exit__ closes it
+    return False
 
 
 # ---------------------------------------------------------------------------
