@@ -514,8 +514,9 @@ def _bench_cascade(iters: int):
     rollup shape, pool CLEARED before every timed iteration:
 
       rle_rate          cold rate with the cascade rungs on, through the
-                        ROW program (granularity hour keeps run-domain
-                        out), vs the packed-only baseline (logged);
+                        ROW program (run-domain pinned off — since the
+                        uniform-granularity rung even the hour query
+                        would ride run space), vs packed-only (logged);
       cascade_ratio     decoded-equivalent / actual bytes of the
                         cascade-encoded pool entries after the cold run;
       code_domain_rate  WARM rate of the run-domain-eligible variant
@@ -549,25 +550,38 @@ def _bench_cascade(iters: int):
 
     rates = {}
     cascade_ratio = 0.0
-    for label, on in (("packed_only", False), ("cascade", True)):
-        prev = cascade.set_enabled(on)
-        try:
-            t = time.time()
-            executor.run(row_query)      # warm: compile once per mode
-            log(f"cascade-bench warmup {label}: {time.time() - t:.2f}s")
-            times = []
-            for _ in range(max(iters, 3)):
-                pool.clear()             # force the cold-miss H2D path
+    # rle_rate/cascade_ratio measure the ROW program's STAGED bytes: the
+    # uniform-granularity run-domain rung would serve this hour-aligned
+    # shape from run tables with no column staging at all, so it is
+    # pinned off here (code_domain_rate below measures it on)
+    prev_rd = cascade.set_run_domain_enabled(False)
+    try:
+        for label, on in (("packed_only", False), ("cascade", True)):
+            prev = cascade.set_enabled(on)
+            try:
                 t = time.time()
-                executor.run(row_query)
-                times.append(time.time() - t)
-            if on:
-                cascade_ratio = pool.snapshot().cascade_ratio
-        finally:
-            cascade.set_enabled(prev)
-        rates[label] = total_rows / min(times)
-        log(f"cascade-bench {label}: best {min(times) * 1e3:.1f}ms over "
-            f"{len(times)} cold iters -> {rates[label] / 1e6:.1f}M rows/s")
+                executor.run(row_query)  # warm: compile once per mode
+                log(f"cascade-bench warmup {label}: "
+                    f"{time.time() - t:.2f}s")
+                times = []
+                for _ in range(max(iters, 3)):
+                    pool.clear()         # force the cold-miss H2D path
+                    t = time.time()
+                    executor.run(row_query)
+                    times.append(time.time() - t)
+                if on:
+                    cascade_ratio = pool.snapshot().cascade_ratio
+            finally:
+                cascade.set_enabled(prev)
+            rates[label] = total_rows / min(times)
+            log(f"cascade-bench {label}: best {min(times) * 1e3:.1f}ms "
+                f"over {len(times)} cold iters -> "
+                f"{rates[label] / 1e6:.1f}M rows/s")
+    finally:
+        # restored in a finally: main() swallows bench-section failures,
+        # and leaving run-domain off would silently poison every later
+        # section's numbers in the same JSON line
+        cascade.set_run_domain_enabled(prev_rd)
     log(f"cascade-bench pool cascade ratio: {cascade_ratio:.2f}x")
 
     # code-domain: warm repeated execution of the run-space variant
@@ -764,6 +778,113 @@ def _bench_scheduler():
     }
 
 
+def _bench_standing():
+    """Standing queries over streaming ingest: per-wave tick cost of the
+    incremental standing program vs a from-scratch re-scan of every sink
+    (rates are cumulative rows SERVED per second of serving work), plus
+    the fan-out story — N subscribers on one hub (ONE standing program)
+    vs N independent queries."""
+    import numpy as np
+
+    from druid_tpu.cluster.metadata import MetadataStore
+    from druid_tpu.engine.standing import StandingQuery
+    from druid_tpu.ingest import (Appenderator, RowBatch, SegmentAllocator,
+                                  StreamAppenderatorDriver)
+    from druid_tpu.query import aggregators as A
+    from druid_tpu.query.model import TimeseriesQuery, query_from_json
+    from druid_tpu.server.subscriptions import SubscriptionHub
+    from druid_tpu.utils.intervals import Interval
+
+    rows = int(os.environ.get("DRUID_TPU_BENCH_STANDING_ROWS", 400_000))
+    waves = int(os.environ.get("DRUID_TPU_BENCH_STANDING_WAVES", 8))
+    n_subs = int(os.environ.get("DRUID_TPU_BENCH_STANDING_SUBS", 64))
+    per_wave = max(rows // waves, 1)
+
+    iv = Interval.of("2026-03-01", "2026-03-02")
+    rng = np.random.default_rng(7)
+    app = Appenderator(
+        "bench_rt",
+        [A.CountAggregator("rows"), A.LongSumAggregator("v", "value")],
+        query_granularity="none", max_rows_per_hydrant=per_wave)
+    driver = StreamAppenderatorDriver(
+        app, SegmentAllocator(MetadataStore(), "day"), MetadataStore())
+    q = query_from_json({
+        "queryType": "timeseries", "dataSource": "bench_rt",
+        "intervals": [str(iv)], "granularity": "hour",
+        "aggregations": [
+            {"type": "longSum", "name": "rows", "fieldName": "rows"},
+            {"type": "longSum", "name": "v", "fieldName": "v"}]})
+    assert isinstance(q, TimeseriesQuery)
+    sq = StandingQuery(q, [app])
+
+    def wave_batch():
+        ts = iv.start + rng.integers(0, 24 * 3_600_000, size=per_wave)
+        return RowBatch(ts.astype(np.int64), {
+            "page": [f"p{int(x)}" for x in rng.integers(16, size=per_wave)],
+            "value": rng.integers(0, 100, size=per_wave)})
+
+    served = 0
+    t_standing = 0.0
+    t_rescan = 0.0
+    total = 0
+    for w in range(waves):
+        driver.add_batch(wave_batch())
+        total += per_wave
+        if w % 2 == 1:
+            app.persist_all()
+        t = time.time()
+        sq.tick()
+        sq.rows()
+        t_standing += time.time() - t
+        t = time.time()
+        sq.rescan_rows()
+        t_rescan += time.time() - t
+        served += total
+    sq.close()
+    standing_rate = served / max(t_standing, 1e-9)
+    rescan_rate = served / max(t_rescan, 1e-9)
+    log(f"standing-bench: {waves} waves x {per_wave} rows — standing "
+        f"{t_standing * 1e3:.1f}ms vs rescan {t_rescan * 1e3:.1f}ms "
+        f"({standing_rate / rescan_rate:.2f}x)")
+
+    # fan-out: N subscribers dedupe onto ONE standing program; the
+    # comparison is N independent executor runs over the same sinks
+    hub = SubscriptionHub(idle_timeout_s=0)
+    hub.attach(app)
+    subs = [hub.subscribe(q) for _ in range(n_subs)]
+    driver.add_batch(wave_batch())
+    hub.tick()                            # warm: compile + first fold
+    driver.add_batch(wave_batch())
+    t = time.time()
+    hub.tick()
+    for sid, _ in subs:
+        hub.poll(sid)
+    t_hub = time.time() - t
+    n_programs = hub.active_programs()
+
+    from druid_tpu.engine import QueryExecutor
+    world = app.query_segments()
+    QueryExecutor().run(q, segments=world)   # warm
+    t = time.time()
+    for _ in range(n_subs):
+        QueryExecutor().run(q, segments=world)
+    t_ind = time.time() - t
+    hub.stop()
+    log(f"standing-bench fanout x{n_subs}: hub {t_hub * 1e3:.1f}ms vs "
+        f"independent {t_ind * 1e3:.1f}ms "
+        f"({t_ind / max(t_hub, 1e-9):.1f}x), {n_programs} program(s)")
+    return {
+        "standing_rate": round(standing_rate, 0),
+        "rescan_rate": round(rescan_rate, 0),
+        "standing_speedup": round(standing_rate / rescan_rate, 3),
+        "standing_fanout_subs": n_subs,
+        "standing_fanout_hub_ms": round(t_hub * 1e3, 2),
+        "standing_fanout_independent_ms": round(t_ind * 1e3, 2),
+        "standing_fanout_speedup": round(t_ind / max(t_hub, 1e-9), 3),
+        "standing_programs": n_programs,
+    }
+
+
 def _bench_soak():
     """Opt-in (DRUID_TPU_BENCH_SOAK=<waves>) resource-drift mode: repeated
     query waves + full server start/stop cycles, reporting rss/fd/thread
@@ -920,6 +1041,11 @@ def main():
         log(f"sched-bench failed: {type(e).__name__}: {e}")
         sched = {"sched_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        standing = _bench_standing()
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"standing-bench failed: {type(e).__name__}: {e}")
+        standing = {"standing_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         soak = _bench_soak()
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"soak-bench failed: {type(e).__name__}: {e}")
@@ -943,6 +1069,7 @@ def main():
     out.update(hll)
     out.update(traced)
     out.update(sched)
+    out.update(standing)
     out.update(soak)
     print(json.dumps(out), flush=True)
 

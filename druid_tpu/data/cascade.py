@@ -100,6 +100,25 @@ def enabled() -> bool:
     return _ENABLED
 
 
+_RUN_DOMAIN = True
+
+
+def set_run_domain_enabled(on: bool) -> bool:
+    """Toggle ONLY the code-domain (run-space) execution path, leaving the
+    cascade STAGING rungs on — tests/benches that measure staged bytes or
+    the row program pin this off so an eligible shape cannot route around
+    what they measure."""
+    global _RUN_DOMAIN
+    with _STATE_LOCK:
+        prev = _RUN_DOMAIN
+        _RUN_DOMAIN = bool(on)
+        return prev
+
+
+def run_domain_enabled() -> bool:
+    return _RUN_DOMAIN
+
+
 def set_lz4_mode(mode: str) -> str:
     global _LZ4_MODE
     with _STATE_LOCK:
@@ -960,7 +979,8 @@ def _run_update(rk: _RunKernel, arrays: Dict, mask, key, lens,
 
 
 def _build_run_fn(dim_cols: Tuple, has_remap: Tuple, filter_node,
-                  rkernels: List[_RunKernel], num_total: int):
+                  rkernels: List[_RunKernel], num_total: int,
+                  has_bucket: bool = False):
     import jax
     import jax.numpy as jnp
 
@@ -970,7 +990,16 @@ def _build_run_fn(dim_cols: Tuple, has_remap: Tuple, filter_node,
         mask = lens > 0                   # zero-length pad runs drop out
         arrays = dict(arrays)
         arrays["__valid"] = mask          # ConstNode's shape anchor
-        key = jnp.zeros(lens.shape, dtype=jnp.int32)
+        if has_bucket:
+            # uniform granularity: the bucket id is run-constant by
+            # partition construction — it rides as a staged per-run table
+            # (pad runs carry -1) and seeds the fused key exactly like the
+            # row program's device bucket math
+            key = arrays["__runbucket"]
+            mask = mask & (key >= 0)
+            key = jnp.maximum(key, 0)
+        else:
+            key = jnp.zeros(lens.shape, dtype=jnp.int32)
         for col, remap in zip(dim_cols, has_remap):
             if col is None:
                 continue
@@ -1021,15 +1050,30 @@ def _plan_run_domain(segment, intervals, granularity, spec, kernels,
 
 def _plan_run_domain_uncached(segment, intervals, granularity, spec,
                               kernels, flt, virtual_columns):
-    if not _ENABLED or segment.n_rows == 0 or virtual_columns:
+    if not _ENABLED or not _RUN_DOMAIN or segment.n_rows == 0 \
+            or virtual_columns:
         return None
-    if spec.bucket_mode != "all" or spec.key_mode != "dense":
+    if spec.bucket_mode not in ("all", "uniform") \
+            or spec.key_mode != "dense":
         return None
     if not any(iv.start <= segment.min_time and iv.end > segment.max_time
                for iv in intervals):
         return None                       # the time mask must be all-true
     if any(d.host_ids is not None for d in spec.dims):
         return None
+    # uniform granularities ride run space too, when their bucket
+    # boundaries provably align with run boundaries: the per-row bucket id
+    # JOINS the joint run partition, so alignment is exactly the condition
+    # that the joint run count stays within the profitability cap — a
+    # granularity fine enough to split runs row-by-row prices itself out
+    # and falls back to the row program (the ROADMAP item-3 rung)
+    bucket = None
+    if spec.bucket_mode == "uniform":
+        if granularity is None or not granularity.is_uniform \
+                or spec.num_buckets < 1:
+            return None
+        first = int(spec.bucket_starts[0])
+        bucket = (first, int(granularity.period_ms), int(spec.num_buckets))
     cols = set()
     for d in spec.dims:
         if d.column is not None:
@@ -1055,18 +1099,22 @@ def _plan_run_domain_uncached(segment, intervals, granularity, spec,
             return None
     pkey = tuple(sorted(cols))
     # the shared run partition: joint change points of EVERY referenced
-    # column (cached per column set)
-    info = _joint_runs(segment, pkey)
+    # column — and, for uniform granularities, of the bucket id (cached
+    # per column set + bucket signature)
+    info = _joint_runs(segment, pkey, bucket)
     if info is None:
         return None
     return (tuple(d.column for d in spec.dims),
             tuple(d.remap is not None for d in spec.dims),
-            fnode, rkernels, pkey, info)
+            fnode, rkernels, pkey, bucket, info)
 
 
-def _joint_runs(segment, pkey: Tuple[str, ...]):
+def _joint_runs(segment, pkey: Tuple[str, ...],
+                bucket: Optional[Tuple[int, int, int]] = None):
     """Cached (starts, lengths, n_runs) of the joint run partition over
-    the named columns, or None when too fine-grained to pay."""
+    the named columns (plus, when `bucket` = (first, period, B), the
+    uniform-granularity bucket id), or None when too fine-grained to
+    pay."""
     def _compute():
         n = segment.n_rows
         b = np.zeros(n, dtype=bool)
@@ -1075,12 +1123,21 @@ def _joint_runs(segment, pkey: Tuple[str, ...]):
             col = segment.dims.get(c)
             v = col.ids if col is not None else segment.metrics[c].values
             b[1:] |= v[1:] != v[:-1]
+        if bucket is not None:
+            first, period, _ = bucket
+            bid = (segment.time_ms - first) // period
+            b[1:] |= bid[1:] != bid[:-1]
         starts = np.flatnonzero(b).astype(np.int32)
         lengths = np.diff(np.concatenate(
             [starts, [n]])).astype(np.int32)
         return starts, lengths, int(starts.shape[0])
-    starts, lengths, nr = segment.aux_cached(("cascade_runpart", pkey),
-                                             _compute)
+    # cache identity = what the change points actually depend on: bucket
+    # BOUNDARIES are (first mod period, period) — a rolling covering
+    # window whose start shifts by whole periods reuses the partition
+    # instead of re-scanning n_rows and duplicating aux entries
+    bkey = None if bucket is None else (bucket[0] % bucket[1], bucket[1])
+    starts, lengths, nr = segment.aux_cached(
+        ("cascade_runpart", pkey, bkey), _compute)
     cap = _contracts().CASCADE_MAX_RUNS
     if nr > cap or nr * RUN_DOMAIN_MIN_ROWS_PER_RUN > segment.n_rows:
         return None
@@ -1097,22 +1154,31 @@ def try_run_domain(segment, intervals, granularity, spec, kernels, flt,
                             kernels, flt, virtual_columns)
     if plan is None:
         return None
-    dim_cols, has_remap, fnode, rkernels, pkey, info = plan
+    dim_cols, has_remap, fnode, rkernels, pkey, bucket, info = plan
     starts, lengths, nr = info
     rpad = pad_pow2(nr)
 
     import jax
+
+    # the staging identity must name the PARTITION, not just the column
+    # set: a uniform-granularity partition of the same columns has
+    # different run tables than the all-granularity one
+    part_key = (pkey, bucket)
 
     def _staged(colname: str, values: np.ndarray, fill=0):
         def _build(v=values):
             out = np.full(rpad, fill, dtype=v.dtype)
             out[: v.shape[0]] = v
             return jax.device_put(out)
-        return segment.device_cached(("rundom", pkey, rpad, colname),
+        return segment.device_cached(("rundom", part_key, rpad, colname),
                                      _build)
 
     arrays: Dict[str, object] = {
         "__runlen": _staged("__runlen", lengths)}
+    if bucket is not None:
+        first, period, _nb = bucket
+        bid = ((segment.time_ms[starts] - first) // period).astype(np.int32)
+        arrays["__runbucket"] = _staged("__runbucket", bid, fill=-1)
     cols = set(pkey)
     for c in cols:
         col = segment.dims.get(c)
@@ -1141,13 +1207,15 @@ def try_run_domain(segment, intervals, granularity, spec, kernels, flt,
         f"filt={fnode.signature() if fnode is not None else 'none'}",
         f"aggs={';'.join(rk.sig() for rk in rkernels)}",
         f"total={spec.num_total}", f"R={rpad}",
+        f"ub={int(bucket is not None)}",
     ])
     with _RUN_JIT_CACHE_LOCK:
         fn = _RUN_JIT_CACHE.get(sig)
         compiled = fn is None
         if fn is None:
             fn = _build_run_fn(dim_cols, has_remap, fnode, rkernels,
-                               spec.num_total)
+                               spec.num_total,
+                               has_bucket=bucket is not None)
             _RUN_JIT_CACHE[sig] = fn
             while len(_RUN_JIT_CACHE) > _RUN_JIT_CACHE_CAP:
                 _RUN_JIT_CACHE.popitem(last=False)

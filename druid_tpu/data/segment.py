@@ -376,6 +376,21 @@ class Segment:
         donation invalidates them)."""
         return self._pool.take(self._pool_owner, ("aux",) + key)
 
+    def adopt_carries_from(self, donor: "Segment") -> None:
+        """Standing-query carry bridge (engine/standing.py): a live sink's
+        snapshot is a FRESH Segment every generation, so the megakernel's
+        per-segment donated carries would never be reused across ticks.
+        Naming the previous snapshot here lets run_grouped_aggregate's
+        carry take fall back to the donor's parked grids. ONLY carries may
+        bridge — they are content-free HBM allocations the kernel re-inits
+        at grid step 0; staged data never transfers between segments."""
+        import weakref
+        self._carry_donor = weakref.ref(donor)
+
+    def carry_donor(self) -> Optional["Segment"]:
+        ref = getattr(self, "_carry_donor", None)
+        return ref() if ref is not None else None
+
     def column_minmax(self, name: str) -> Tuple[int, int]:
         """Cached (min, max) of a numeric column (0, 0 when empty)."""
         def _compute():

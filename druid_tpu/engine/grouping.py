@@ -1304,6 +1304,15 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                     cdefs = megakernel.carry_defs(
                         kernels, col_dtypes, spec.num_total, spec.window)
                     carried = segment.device_take(("megacarry", sig))
+                    if carried is None:
+                        # standing-query bridge: a live sink's fresh
+                        # snapshot adopts its predecessor's parked grids
+                        # (data/segment.py adopt_carries_from) — carries
+                        # are content-free, so cross-generation reuse is
+                        # exactly as bit-safe as same-segment reuse
+                        donor = segment.carry_donor()
+                        if donor is not None:
+                            carried = donor.device_take(("megacarry", sig))
                     donated = carried is not None \
                         and len(carried) == len(cdefs) \
                         and megakernel.donation_enabled()

@@ -168,12 +168,22 @@ class Appenderator:
         self._listeners: List[object] = []
 
     def add_listener(self, listener) -> None:
-        """listener gets sink_created(ident) / sink_dropped(ident)."""
+        """listener gets sink_created(ident) / sink_dropped(ident), and —
+        when it defines them — sink_published(descriptor, segment) just
+        before a publishing sink drops (the standing-query cutover hook,
+        engine/standing.py)."""
         with self._lock:
             self._listeners.append(listener)
             existing = [s.ident for s in self._sinks.values()]
         for ident in existing:
             listener.sink_created(ident)
+
+    def remove_listener(self, listener) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
 
     def add(self, ident: SegmentIdWithShard, batch: RowBatch) -> None:
         created = False
@@ -219,6 +229,30 @@ class Appenderator:
         with self._lock:
             sink = self._sinks.get(str(segment_id))
             return None if sink is None else sink.query_segments()
+
+    def standing_states(self) -> List[Tuple]:
+        """[(ident, immutable hydrant snapshots, live IncrementalIndex)]
+        per sink — the standing-query fold surface (engine/standing.py):
+        hydrants are append-only so the caller folds only the ones past
+        its high-water mark, and the live index exposes change_marker()
+        so an unchanged tick costs zero snapshots. Snapshot production
+        (to_segment) is the caller's, OUTSIDE this lock."""
+        with self._lock:
+            return [(s.ident, tuple(s.hydrants), s.index)
+                    for s in self._sinks.values()]
+
+    def note_published(self, pairs) -> None:
+        """Notify listeners that these sinks' merged historical segments
+        now exist ((descriptor, segment) pairs, about to hand off). Fires
+        BEFORE drop() so a standing listener can swap the contribution
+        exactly-once at the publish boundary."""
+        with self._lock:
+            listeners = list(self._listeners)
+        for desc, seg in pairs:
+            for ln in listeners:
+                fn = getattr(ln, "sink_published", None)
+                if fn is not None:
+                    fn(desc, seg)
 
     # ---- push -----------------------------------------------------------
     def push(self, idents: Sequence[SegmentIdWithShard]
@@ -321,6 +355,11 @@ class StreamAppenderatorDriver:
             if ok:
                 if self.handoff is not None and pushed:
                     self.handoff(pushed)
+                # published segments exist (and are handed off) BEFORE the
+                # sinks drop: standing listeners swap their incremental
+                # partials for the published contribution exactly-once,
+                # and the broker's ReplicaSet never has a serving gap
+                self.appenderator.note_published(pushed)
                 self.appenderator.drop(idents)
                 for key in [k for k, v in self._active.items()
                             if v in idents]:

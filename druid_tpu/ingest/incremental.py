@@ -521,6 +521,14 @@ class IncrementalIndex:
         with self._lock:
             return len(self._time) + len(self._pending_t)
 
+    def change_marker(self) -> Tuple[int, int]:
+        """(generation, pending rows): lexicographically advances on every
+        content change — compaction bumps the generation, appends grow the
+        pending tail. Standing queries (engine/standing.py) compare markers
+        across ticks so an unchanged live hydrant costs zero snapshots."""
+        with self._lock:
+            return (self._generation, len(self._pending_t))
+
     def can_append(self) -> bool:
         return self.n_rows < self.max_rows_in_memory
 
@@ -531,36 +539,52 @@ class IncrementalIndex:
         IncrementalIndexStorageAdapter; here realtime queries see cheap
         immutable snapshots, cached per generation)."""
         with self._lock:
-            self._compact_locked()
-            gen = self._generation
-            if self._snapshot_cache is not None \
-                    and self._snapshot_cache[0] == gen:
-                return self._snapshot_cache[1]
-            dims: Dict[str, StringDimColumn] = {}
-            for d in self._dim_order:
-                gd = self._dicts[d]
-                sorted_dict = Dictionary(sorted(gd.index))
+            return self._to_segment_locked(version, partition)
+
+    def snapshot_with_marker(self, version: str = "v0",
+                             partition: int = 0
+                             ) -> Tuple[Segment, Tuple[int, int]]:
+        """(snapshot, change marker) where the marker describes EXACTLY the
+        snapshot's content — taken under one lock hold, post-compaction,
+        so standing queries (engine/standing.py) can store a high-water
+        mark that neither re-folds an unchanged snapshot (the compaction
+        bumped the generation the caller saw pre-snapshot) nor misses
+        rows appended concurrently with snapshotting."""
+        with self._lock:
+            seg = self._to_segment_locked(version, partition)
+            return seg, (self._generation, 0)
+
+    def _to_segment_locked(self, version: str, partition: int) -> Segment:
+        self._compact_locked()
+        gen = self._generation
+        if self._snapshot_cache is not None \
+                and self._snapshot_cache[0] == gen:
+            return self._snapshot_cache[1]
+        dims: Dict[str, StringDimColumn] = {}
+        for d in self._dim_order:
+            gd = self._dicts[d]
+            sorted_dict = Dictionary(sorted(gd.index))
+            remap = np.asarray(
+                [sorted_dict.id_of(v) for v in gd.values],
+                dtype=np.int32) if gd.values else np.zeros(0, np.int32)
+            null_id = sorted_dict.id_of(NULL)
+            raw = self._dim_ids[d]
+            if null_id < 0:
+                sorted_dict = Dictionary(sorted(set(gd.index) | {NULL}))
                 remap = np.asarray(
                     [sorted_dict.id_of(v) for v in gd.values],
-                    dtype=np.int32) if gd.values else np.zeros(0, np.int32)
-                null_id = sorted_dict.id_of(NULL)
-                raw = self._dim_ids[d]
-                if null_id < 0:
-                    sorted_dict = Dictionary(sorted(set(gd.index) | {NULL}))
-                    remap = np.asarray(
-                        [sorted_dict.id_of(v) for v in gd.values],
-                        dtype=np.int32)
-                dims[d] = StringDimColumn(
-                    remap[raw] if len(raw) else raw.copy(), sorted_dict)
-            metrics: Dict[str, object] = {}
-            for s, st in zip(self.metric_states, self._states):
-                metrics[s.name] = s.final_column(st)
-                metrics.update(s.extra_columns(st))
-            seg = Segment(
-                SegmentId(self.datasource, self.interval, version, partition),
-                self._time.copy(), dims, metrics, sorted_by_time=False)
-            self._snapshot_cache = (gen, seg)
-            return seg
+                    dtype=np.int32)
+            dims[d] = StringDimColumn(
+                remap[raw] if len(raw) else raw.copy(), sorted_dict)
+        metrics: Dict[str, object] = {}
+        for s, st in zip(self.metric_states, self._states):
+            metrics[s.name] = s.final_column(st)
+            metrics.update(s.extra_columns(st))
+        seg = Segment(
+            SegmentId(self.datasource, self.interval, version, partition),
+            self._time.copy(), dims, metrics, sorted_by_time=False)
+        self._snapshot_cache = (gen, seg)
+        return seg
 
     def persist(self, directory: str, version: str = "v0",
                 partition: int = 0) -> Segment:

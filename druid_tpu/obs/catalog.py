@@ -191,6 +191,45 @@ METRICS = {
         "help": "per-group partial-buffer bytes handed back DONATED across "
                 "repeated executions since the last tick (standing-query "
                 "ticks update partials in place, zero per-tick HBM churn)"},
+    # ---- standing queries (engine/standing.py) -------------------------
+    "query/standing/ticks": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/standing.py (StandingMetricsMonitor)",
+        "help": "standing-query ticks executed since the last monitor "
+                "tick (each folds only data appended past the per-sink "
+                "high-water marks)"},
+    "query/standing/folds": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/standing.py (StandingMetricsMonitor)",
+        "help": "incremental segment folds (device work actually paid) "
+                "since the last tick — a quiet datasource ticks for free"},
+    "query/standing/rows": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/standing.py (StandingMetricsMonitor)",
+        "help": "newly appended rows folded into standing partials since "
+                "the last tick (the incremental win vs re-scanning every "
+                "sink)"},
+    "query/standing/cutovers": {
+        "unit": "count/period", "dims": (),
+        "site": "engine/standing.py (StandingMetricsMonitor)",
+        "help": "publish cutovers since the last tick (a sink's "
+                "incremental partials swapped exactly-once for its "
+                "published segment's contribution)"},
+    # ---- subscription fan-out (server/subscriptions.py) ----------------
+    "subscription/active": {
+        "unit": "count", "dims": (),
+        "site": "server/subscriptions.py (SubscriptionMetricsMonitor)",
+        "help": "live subscriptions at tick time (N structurally "
+                "identical ones share ONE standing program)"},
+    "subscription/fanout": {
+        "unit": "count/period", "dims": (),
+        "site": "server/subscriptions.py (SubscriptionMetricsMonitor)",
+        "help": "changed-result long-poll deliveries since the last tick"},
+    "subscription/ticks": {
+        "unit": "count/period", "dims": (),
+        "site": "server/subscriptions.py (SubscriptionMetricsMonitor)",
+        "help": "subscription-hub ticks since the last monitor tick "
+                "(each advances every standing program once)"},
     # ---- code-domain aggregation (data/cascade.py) ---------------------
     "query/codeDomain/hits": {
         "unit": "count/period", "dims": (),

@@ -10,6 +10,8 @@ from druid_tpu.server.security import (AllowAllAuthenticator,
                                        BasicHTTPAuthenticator, Escalator,
                                        Permission, RoleBasedAuthorizer,
                                        authorizer_for_query)
+from druid_tpu.server.subscriptions import (SubscriptionHub,
+                                            UnknownSubscriptionError)
 
 __all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer",
            "QueryManager", "Deadline", "QueryInterruptedError",
@@ -17,4 +19,5 @@ __all__ = ["QueryLifecycle", "RequestLogger", "QueryHttpServer",
            "TieredBrokerSelector", "AuthChain", "AuthenticationResult",
            "AllowAllAuthenticator", "BasicHTTPAuthenticator",
            "AllowAllAuthorizer", "RoleBasedAuthorizer", "Permission",
-           "Escalator", "authorizer_for_query"]
+           "Escalator", "authorizer_for_query", "SubscriptionHub",
+           "UnknownSubscriptionError"]

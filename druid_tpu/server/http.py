@@ -50,7 +50,8 @@ class QueryHttpServer:
     def __init__(self, lifecycle: QueryLifecycle, sql_executor=None,
                  host: str = "127.0.0.1", port: int = 0,
                  auth_chain=None, coordination=None, overlord=None,
-                 monitor_period_seconds: float = 60.0):
+                 monitor_period_seconds: float = 60.0,
+                 subscription_hub=None):
         """auth_chain: optional server.security.AuthChain — requests
         authenticate at the HTTP boundary (401 on failure) and the
         resulting AuthenticationResult flows into the lifecycle, whose
@@ -70,8 +71,15 @@ class QueryHttpServer:
         other coordinator/overlord API request on a NON-leader answers
         307 with Location on the current leader (503 while no leader is
         live). overlord: the local Overlord — leader-only task submission
-        (POST /druid/indexer/v1/task) and status reads serve from it."""
+        (POST /druid/indexer/v1/task) and status reads serve from it.
+
+        subscription_hub: optional server.subscriptions.SubscriptionHub —
+        adds the standing-query subscription surface (POST/GET/DELETE
+        /druid/v2/subscriptions[/<id>]): long-poll fan-out composing with
+        the same ETag/If-None-Match contract the one-shot query path
+        speaks, so an unchanged window is a 304."""
         self.lifecycle = lifecycle
+        self.subscription_hub = subscription_hub
         self.sql_executor = sql_executor
         self.auth_chain = auth_chain
         self.coordination = coordination or {}
@@ -119,6 +127,12 @@ class QueryHttpServer:
             lifecycle.on_result = _chained
         self._installed_on_result = lifecycle.on_result
         monitors = [self.query_counts]
+        if subscription_hub is not None:
+            from druid_tpu.engine.standing import StandingMetricsMonitor
+            from druid_tpu.server.subscriptions import \
+                SubscriptionMetricsMonitor
+            monitors.append(SubscriptionMetricsMonitor(subscription_hub))
+            monitors.append(StandingMetricsMonitor())
         resilience = getattr(lifecycle.runner, "resilience", None)
         if resilience is not None:
             # broker-backed lifecycles surface the fault-tolerance layer
@@ -267,6 +281,16 @@ class QueryHttpServer:
                                               "queryId": qid})
                         else:
                             self._reply(200, got)
+                elif self.path.startswith("/druid/v2/subscriptions/"):
+                    # long-poll fan-out: the handler thread parks in the
+                    # hub until the standing program's version moves past
+                    # the presented If-None-Match etag (or the timeout
+                    # lapses → 304, the unchanged-window contract)
+                    if outer.subscription_hub is None:
+                        self._reply(404, {"error": "subscriptions not "
+                                          "enabled"})
+                    elif self._authenticated():
+                        self._poll_subscription()
                 elif self.path in ("/druid/v2/datasources",
                                    "/druid/v2/datasources/"):
                     if self._authenticated():
@@ -299,6 +323,13 @@ class QueryHttpServer:
                     svc = outer._coord_service(self.path)
                     if svc is not None:
                         self._handle_coordination(svc, payload)
+                        return
+                    if self.path.rstrip("/") == "/druid/v2/subscriptions":
+                        if outer.subscription_hub is None:
+                            self._reply(404, {"error": "subscriptions not "
+                                              "enabled"})
+                        else:
+                            self._subscribe(payload, identity)
                         return
                     if self.path.rstrip("/") == "/druid/v2/sql/avatica":
                         if outer.avatica is None:
@@ -460,11 +491,74 @@ class QueryHttpServer:
                     gen.close()
                     self.close_connection = True
 
+            # ---- standing-query subscriptions (server/subscriptions.py)
+            def _poll_subscription(self) -> None:
+                import urllib.parse
+                from druid_tpu.server.subscriptions import \
+                    UnknownSubscriptionError
+                parsed = urllib.parse.urlparse(self.path)
+                sub_id = parsed.path[len("/druid/v2/subscriptions/"):] \
+                    .rstrip("/")
+                params = urllib.parse.parse_qs(parsed.query)
+                try:
+                    timeout_s = float(params.get("timeoutMs",
+                                                 ["0"])[0]) / 1000.0
+                except ValueError:
+                    timeout_s = 0.0
+                etag = self.headers.get("If-None-Match")
+                try:
+                    rows, new_etag, changed = outer.subscription_hub.poll(
+                        sub_id, etag=etag, timeout_s=timeout_s)
+                except UnknownSubscriptionError:
+                    # swept as idle or never registered: the client
+                    # re-subscribes
+                    self._reply(404, {"error": "unknown subscription",
+                                      "subscriptionId": sub_id})
+                    return
+                if not changed:
+                    self.send_response(304)
+                    self.send_header("X-Druid-ETag", new_etag)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self._reply(200, rows, {"X-Druid-ETag": new_etag})
+
+            def _subscribe(self, payload, identity) -> None:
+                """POST /druid/v2/subscriptions: body = a native aggregate
+                query; authorizes (with the identity do_POST already
+                authenticated) exactly like a one-shot run of it."""
+                from druid_tpu.engine.standing import StandingIneligible
+                from druid_tpu.query.model import query_from_json
+                query = query_from_json(payload)
+                authorizer = getattr(outer.lifecycle, "authorizer", None)
+                if authorizer is not None \
+                        and not authorizer(identity, query):
+                    self._reply(403, {"error": "unauthorized"})
+                    return
+                try:
+                    sub_id, etag = outer.subscription_hub.subscribe(query)
+                except StandingIneligible as e:
+                    self._reply(400, {"error": f"StandingIneligible: {e}"})
+                    return
+                self._reply(200, {"subscriptionId": sub_id, "etag": etag},
+                            {"X-Druid-ETag": etag})
+
             def do_DELETE(self):
                 # DELETE /druid/v2/{id} — QueryResource.cancelQuery:
                 # 202 accepted whether or not the id was in flight
                 from druid_tpu.server.querymanager import cancel_path_id
                 if not self._authenticated():
+                    return
+                if self.path.startswith("/druid/v2/subscriptions/"):
+                    if outer.subscription_hub is None:
+                        self._reply(404, {"error": "subscriptions not "
+                                          "enabled"})
+                        return
+                    sub_id = self.path[
+                        len("/druid/v2/subscriptions/"):].rstrip("/")
+                    found = outer.subscription_hub.unsubscribe(sub_id)
+                    self._reply(202, {"subscriptionId": sub_id,
+                                      "active": bool(found)})
                     return
                 qid = cancel_path_id(self.path)
                 if qid is not None:

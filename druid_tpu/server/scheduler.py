@@ -222,6 +222,10 @@ class DataNodeScheduler:
         #: EWMA service rate (rows/s) measured over completed flushes;
         #: None until the first flush lands
         self._rate_rows_per_s: Optional[float] = None
+        #: tick hooks the flush loop drives between flushes (standing-query
+        #: / subscription-hub ticks — server/subscriptions.py); fired
+        #: OUTSIDE the lock, exception-isolated
+        self._tick_hooks: List = []
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> "DataNodeScheduler":
@@ -251,6 +255,32 @@ class DataNodeScheduler:
     def depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    # ---- tick hooks (the standing-query tick driver) --------------------
+    def add_tick_hook(self, fn) -> None:
+        """Register a callable the dispatcher loop invokes between flushes
+        (and roughly every wait period when idle). Hooks run on the
+        dispatcher thread, outside the scheduler lock; exceptions are
+        logged, never fatal."""
+        with self._cond:
+            if fn not in self._tick_hooks:
+                self._tick_hooks.append(fn)
+
+    def remove_tick_hook(self, fn) -> None:
+        with self._cond:
+            try:
+                self._tick_hooks.remove(fn)
+            except ValueError:
+                pass
+
+    def _fire_tick_hooks(self) -> None:
+        with self._cond:
+            hooks = list(self._tick_hooks)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                log.exception("scheduler tick hook failed")
 
     # ---- admission + hold (request thread) -----------------------------
     def submit(self, query, segment_ids, check=None):
@@ -384,13 +414,20 @@ class DataNodeScheduler:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stopping:
+                if not self._queue and not self._stopping:
+                    # single-shot wait (submit notifies): the loop exits
+                    # the lock each period so tick hooks fire while idle
                     self._cond.wait(0.2)
                 if self._stopping:
                     self._fail_queued_locked(
                         RuntimeError("scheduler stopped"))
                     return
-                oldest = min(it.enq_t for it in self._queue)
+                oldest = min((it.enq_t for it in self._queue), default=None)
+            # the flush loop doubles as the standing-query tick driver:
+            # hooks fire between flushes, outside the lock
+            self._fire_tick_hooks()
+            if oldest is None:
+                continue
             # the batching window: give the oldest arrival's batch-mates
             # time to land before flushing (outside the lock; stop() stays
             # responsive via the post-sleep re-check)

@@ -184,9 +184,12 @@ def test_repeated_batched_query_builds_once(mixed_segments, monkeypatch):
 
     monkeypatch.setattr(batching, "_build_batched_fn", counted)
     ex = QueryExecutor(mixed_segments)
-    # "hour", not "all": a granularity-all pure count is code-domain
-    # eligible (data/cascade.py run-domain) and deliberately bypasses
-    # batching — this test is about the batched program cache
+    # run-domain pinned off: a pure count is code-domain eligible at ANY
+    # aligned granularity since the uniform rung (data/cascade.py) and
+    # deliberately bypasses batching — this test is about the batched
+    # program cache
+    from druid_tpu.data import cascade
+    monkeypatch.setattr(cascade, "_RUN_DOMAIN", False)
     q = {"queryType": "timeseries", "dataSource": "mix",
          "intervals": [str(IV)], "granularity": "hour",
          "aggregations": [{"type": "count", "name": "n"}]}
@@ -223,13 +226,18 @@ def test_pow2_chunks():
 
 def test_fill_ratio_recorded(mixed_segments):
     batching.stats().drain_events()
-    # "hour", not "all": granularity-all pure counts run code-domain
-    # (data/cascade.py) instead of batching — this test asserts the
-    # batched dispatch event stream
+    # run-domain pinned off: pure counts run code-domain at any aligned
+    # granularity since the uniform rung (data/cascade.py) instead of
+    # batching — this test asserts the batched dispatch event stream
+    from druid_tpu.data import cascade
+    prev_rd = cascade.set_run_domain_enabled(False)
     q = {"queryType": "timeseries", "dataSource": "mix",
          "intervals": [str(IV)], "granularity": "hour",
          "aggregations": [{"type": "count", "name": "n"}]}
-    QueryExecutor(mixed_segments).run_json(q)
+    try:
+        QueryExecutor(mixed_segments).run_json(q)
+    finally:
+        cascade.set_run_domain_enabled(prev_rd)
     events, dropped = batching.stats().drain_events()
     assert events, "batched dispatches must record (segments, fillRatio)"
     assert dropped == 0

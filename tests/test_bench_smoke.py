@@ -31,6 +31,9 @@ BENCH_ENV = {
     "DRUID_TPU_BENCH_CLIENT_QUERIES": "3",
     "DRUID_TPU_BENCH_SCHED_ROWS": "1024",
     "DRUID_TPU_BENCH_SOAK": "2",
+    "DRUID_TPU_BENCH_STANDING_ROWS": "3000",
+    "DRUID_TPU_BENCH_STANDING_WAVES": "3",
+    "DRUID_TPU_BENCH_STANDING_SUBS": "16",
 }
 
 
@@ -111,6 +114,18 @@ def test_bench_exits_zero_with_one_json_line():
     for mode in ("off", "on"):
         assert out[f"sched_{mode}_p50_ms"] > 0
         assert out[f"sched_{mode}_p99_ms"] >= out[f"sched_{mode}_p50_ms"]
+    # the standing-query comparison (contract only: rates positive, the
+    # hub really deduped N subscribers onto ONE standing program; the
+    # standing-vs-rescan throughput ordering is asserted on real hardware
+    # like the other comparisons — shared CI cannot promise it)
+    assert out["standing_rate"] > 0
+    assert out["rescan_rate"] > 0
+    assert out["standing_speedup"] > 0
+    assert out["standing_fanout_subs"] == 16
+    assert out["standing_fanout_hub_ms"] > 0
+    assert out["standing_fanout_independent_ms"] > 0
+    assert out["standing_fanout_speedup"] > 0
+    assert out["standing_programs"] == 1
     # the soak-mode drift fields (contract: present and near-zero on the
     # countable axes; rss is allocator-noisy, so presence only)
     assert out["soak_waves"] == 2

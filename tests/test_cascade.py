@@ -260,19 +260,27 @@ def test_batched_path_parity_and_shared_buckets(fresh_pool):
     from druid_tpu.utils.granularity import Granularity
 
     segs = rollup_segments(4, rows=1500)
-    q = dict(GROUPBY, granularity="hour")       # row program: batchable
-    oracle, casc = _run_modes(q, segs)
-    assert oracle == casc
-    # chunk-mates agree on the cascade descriptor: same-stats segments
-    # share one shape bucket, and the descriptor is present in it
-    aggs = [CountAggregator("n"), LongSumAggregator("s", "m0")]
-    plans = [batching._plan_for(s, [], i, [IV], Granularity.of("hour"),
-                                aggs, None, [])
-             for i, s in enumerate(segs)]
-    assert all(p.eligible for p in plans)
-    assert len({p.cascades for p in plans}) == 1
-    assert plans[0].cascades
-    assert len({p.digest for p in plans}) == 1
+    # pin the ROW program: the near-constant time column makes even the
+    # hour query run-domain eligible since the uniform-granularity rung —
+    # this test measures the BATCHED staging path
+    prev_rd = cascade.set_run_domain_enabled(False)
+    try:
+        q = dict(GROUPBY, granularity="hour")   # row program: batchable
+        oracle, casc = _run_modes(q, segs)
+        assert oracle == casc
+        # chunk-mates agree on the cascade descriptor: same-stats segments
+        # share one shape bucket, and the descriptor is present in it
+        aggs = [CountAggregator("n"), LongSumAggregator("s", "m0")]
+        plans = [batching._plan_for(s, [], i, [IV],
+                                    Granularity.of("hour"),
+                                    aggs, None, [])
+                 for i, s in enumerate(segs)]
+        assert all(p.eligible for p in plans)
+        assert len({p.cascades for p in plans}) == 1
+        assert plans[0].cascades
+        assert len({p.digest for p in plans}) == 1
+    finally:
+        cascade.set_run_domain_enabled(prev_rd)
 
 
 def test_megakernel_path_parity(fresh_pool):
@@ -403,6 +411,10 @@ def test_pool_holds_3x_more_segments_than_packed_only(fresh_pool):
     # both modes, exactly like test_packed's ≥3x test
     from druid_tpu.engine import filters as _filters
     prev_bmp = _filters.set_device_bitmap_enabled(False)
+    # ...and the run-domain path, which since the uniform-granularity rung
+    # would serve this aligned shape from run tables with no column
+    # staging at all — this test measures STAGED column bytes
+    prev_rd = cascade.set_run_domain_enabled(False)
     prev_c = cascade.set_enabled(False)
     try:
         packed_only = ex.run_json(q)
@@ -428,6 +440,7 @@ def test_pool_holds_3x_more_segments_than_packed_only(fresh_pool):
         assert s.resident_bytes <= budget
     finally:
         cascade.set_enabled(prev_c)
+        cascade.set_run_domain_enabled(prev_rd)
         _filters.set_device_bitmap_enabled(prev_bmp)
 
 
@@ -487,3 +500,109 @@ def test_hyperunique_log2m12_parity(fresh_pool):
     finally:
         batching.set_enabled(prev)
     assert per_seg == oracle
+
+
+# ---------------------------------------------------------------------------
+# run-domain over uniform granularities (bucket boundaries join the joint
+# run partition — the ROADMAP item-3 follow-on rung)
+# ---------------------------------------------------------------------------
+
+HOUR_MS = 3_600_000
+
+
+def hour_run_segments(n=2, rows=2048, card=8):
+    """Rollup shape whose TIME advances one hour per dimension block: the
+    hour-granularity bucket boundaries provably align with the run
+    boundaries of every referenced column."""
+    reps = -(-rows // card)
+    segs = []
+    for si in range(n):
+        b = SegmentBuilder("casc", IV, version="v0", partition=si)
+        dims = {f"d{i}": np.repeat(
+            [f"v{i}_{j:03d}" for j in range(card)], reps)[:rows].tolist()
+            for i in range(2)}
+        mets = {"cnt": np.ones(rows, dtype=np.int64),
+                "m0": np.repeat((np.arange(card) * 7) % 13,
+                                reps)[:rows].astype(np.int64),
+                "m1": np.repeat((np.arange(card) * 8) % 13,
+                                reps)[:rows].astype(np.int64)}
+        time = IV.start + (np.arange(rows, dtype=np.int64) // reps) * HOUR_MS
+        b.add_columns(time, dims, mets)
+        segs.append(b.build())
+    return segs
+
+
+def test_run_domain_uniform_granularity_parity_zero_unpack(fresh_pool):
+    """Hour-granularity execution over hour-aligned runs goes fully
+    code-domain: bit-identical to the decoded oracle, zero unpack, one
+    runDomain dispatch per segment — per-bucket rows now ride run space,
+    not just granularity-'all' covering-interval queries."""
+    from druid_tpu.obs import dispatch as dispatch_mod
+    segs = hour_run_segments()
+    q = dict(RUN_GROUPBY, granularity="hour")
+    oracle, _ = _run_modes(q, segs)
+    fresh_pool.clear()
+    cascade.reset_decode_stats()
+    h0 = cascade.code_domain_stats().snapshot()
+    d0 = dispatch_mod.stats().snapshot().get("runDomain", 0)
+    got = QueryExecutor(segs).run_json(q)
+    assert got == oracle
+    assert cascade.decode_stats() == {}
+    h1 = cascade.code_domain_stats().snapshot()
+    assert h1["hits"] - h0["hits"] == len(segs)
+    assert dispatch_mod.stats().snapshot()["runDomain"] - d0 == len(segs)
+    # timeseries rides the same rung (no dims: key = the run's bucket id)
+    ts = {"queryType": "timeseries", "dataSource": "casc",
+          "intervals": [str(IV)], "granularity": "hour",
+          "aggregations": RUN_GROUPBY["aggregations"]}
+    o2, c2 = _run_modes(ts, segs)
+    assert o2 == c2
+    assert cascade.code_domain_stats().snapshot()["hits"] > h1["hits"]
+
+
+def test_run_domain_uniform_eligibility_boundaries(fresh_pool):
+    """The alignment proof is the joint run count: bucket boundaries that
+    split runs too fine price the segment out of run space (row program,
+    still bit-identical); a non-covering interval likewise."""
+    segs = hour_run_segments()
+
+    # minute granularity over hour-blocked time: bucket ids change every
+    # row block of 1 minute... time advances in whole hours, so minute
+    # buckets ALIGN; break alignment with per-row minute steps instead
+    reps = -(-2048 // 8)
+    b = SegmentBuilder("casc", IV, version="vx", partition=9)
+    n = 2048
+    dims = {"d0": np.repeat([f"v0_{j:03d}" for j in range(8)],
+                            reps)[:n].tolist(),
+            "d1": np.repeat([f"v1_{j:03d}" for j in range(8)],
+                            reps)[:n].tolist()}
+    mets = {"cnt": np.ones(n, dtype=np.int64),
+            "m0": np.repeat((np.arange(8) * 7) % 13, reps)[:n].astype(
+                np.int64),
+            "m1": np.repeat((np.arange(8) * 8) % 13, reps)[:n].astype(
+                np.int64)}
+    b.add_columns(IV.start + np.arange(n, dtype=np.int64) * 60_000,
+                  dims, mets)
+    fine = b.build()
+
+    h0 = cascade.code_domain_stats().snapshot()["hits"]
+    q = dict(RUN_GROUPBY, granularity="minute")
+    oracle, got = _run_modes(q, [fine])
+    assert oracle == got
+    # per-row bucket changes -> joint runs == rows -> priced out
+    assert cascade.code_domain_stats().snapshot()["hits"] == h0
+
+    # a query interval that does NOT cover the segment keeps the row
+    # program (the time mask is not all-true), results identical
+    half = Interval(IV.start, IV.start + 4 * HOUR_MS)
+    qh = dict(RUN_GROUPBY, granularity="hour", intervals=[str(half)])
+    h1 = cascade.code_domain_stats().snapshot()["hits"]
+    oracle, got = _run_modes(qh, segs)
+    assert oracle == got
+    assert cascade.code_domain_stats().snapshot()["hits"] == h1
+
+    # and the aligned shape DOES run code-domain under the same budget
+    qa = dict(RUN_GROUPBY, granularity="hour")
+    oracle, got = _run_modes(qa, segs)
+    assert oracle == got
+    assert cascade.code_domain_stats().snapshot()["hits"] > h1

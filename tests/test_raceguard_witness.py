@@ -306,6 +306,31 @@ def stress_run():
             nodes[0], SchedulerConfig(batch_window_ms=2.0,
                                       max_queue_depth=64))
 
+        # the subscription hub joins too: ingest appends + standing ticks
+        # + long-poll fan-out + subscribe/unsubscribe churn drive the
+        # hub↔standing↔appenderator lock chains under real concurrency
+        from druid_tpu.cluster.metadata import MetadataStore
+        from druid_tpu.ingest import (Appenderator, RowBatch,
+                                      SegmentAllocator,
+                                      StreamAppenderatorDriver)
+        from druid_tpu.query.aggregators import (CountAggregator,
+                                                 LongSumAggregator)
+        from druid_tpu.query.model import TimeseriesQuery
+        from druid_tpu.server.subscriptions import SubscriptionHub
+
+        rt_iv = Interval.of("2026-07-01", "2026-07-02")
+        app = Appenderator(
+            "rtstress",
+            [CountAggregator("rows"), LongSumAggregator("v", "m")],
+            query_granularity="none")
+        rt_driver = StreamAppenderatorDriver(
+            app, SegmentAllocator(MetadataStore(), "day"), MetadataStore())
+        hub = SubscriptionHub(idle_timeout_s=0)
+        hub.attach(app)
+        standing_q = TimeseriesQuery.of(
+            "rtstress", [rt_iv],
+            [LongSumAggregator("rows", "rows")], granularity="all")
+
         def fan_out(q, rounds):
             try:
                 for _ in range(rounds):
@@ -331,6 +356,33 @@ def stress_run():
             except Exception as e:          # pragma: no cover - must not
                 errors.append(e)
 
+        def ingest_loop():
+            try:
+                t0 = rt_iv.start
+                n = 0
+                while not stop.is_set():
+                    rt_driver.add_batch(RowBatch(
+                        [t0 + n * 1000 + i for i in range(8)],
+                        {"m": list(range(8))}))
+                    n += 1
+                    if n % 7 == 0:
+                        app.persist_all()
+                    time.sleep(0.002)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
+        def subscribe_loop(rounds):
+            try:
+                for _ in range(rounds):
+                    subs = [hub.subscribe(standing_q) for _ in range(4)]
+                    hub.tick()
+                    for sid, etag in subs:
+                        hub.poll(sid, etag=etag, timeout_s=0.05)
+                    for sid, _ in subs:
+                        hub.unsubscribe(sid)
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
         def churn_loop():
             # segment churn: dropped generations GC while queries run,
             # driving the finalizer path concurrently with eviction
@@ -351,15 +403,19 @@ def stress_run():
                    threading.Thread(target=fan_out, args=(ts_q, 6)),
                    threading.Thread(target=sched_loop, args=(6,)),
                    threading.Thread(target=sched_loop, args=(6,)),
+                   threading.Thread(target=subscribe_loop, args=(4,)),
+                   threading.Thread(target=subscribe_loop, args=(4,)),
                    threading.Thread(target=tick_loop, daemon=True),
+                   threading.Thread(target=ingest_loop, daemon=True),
                    threading.Thread(target=churn_loop, daemon=True)]
         for t in workers:
             t.start()
-        for t in workers[:6]:
+        for t in workers[:8]:
             t.join(timeout=300)
         stop.set()
         scheduler.stop()
-        for t in workers[6:]:
+        hub.stop()
+        for t in workers[8:]:
             t.join(timeout=10)
 
         yield witness, errors, pool, emitter

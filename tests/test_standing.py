@@ -1,0 +1,463 @@
+"""Standing-query subsystem tests (engine/standing.py).
+
+THE gate is incremental parity: every emitted snapshot must be
+bit-identical (floats included) to a from-scratch re-scan of the same
+sinks, under randomized append/persist/publish schedules, including the
+exactly-once publish cutover — with DRUID_TPU_STANDING=0 restoring the
+re-scan world.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from druid_tpu.cluster.metadata import MetadataStore
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.engine import standing as standing_mod
+from druid_tpu.engine.standing import (StandingIneligible,
+                                       StandingMetricsMonitor,
+                                       StandingQuery)
+from druid_tpu.ingest import (Appenderator, RowBatch, SegmentAllocator,
+                              StreamAppenderatorDriver)
+from druid_tpu.query.aggregators import (CountAggregator,
+                                         DoubleSumAggregator,
+                                         LongMaxAggregator,
+                                         LongSumAggregator)
+from druid_tpu.query.model import (GroupByQuery, ScanQuery, TimeseriesQuery,
+                                   TopNQuery)
+from druid_tpu.utils.intervals import Interval
+
+SPECS = [CountAggregator("rows"), LongSumAggregator("v", "value"),
+         DoubleSumAggregator("d", "dvalue")]
+# rolled-up data re-queries through the combining forms
+QSPECS = [LongSumAggregator("rows", "rows"), LongSumAggregator("v", "v"),
+          DoubleSumAggregator("d", "d"), LongMaxAggregator("mx", "v")]
+DAY = Interval.of("2026-03-01", "2026-03-02")
+T0 = DAY.start
+HOUR = 3_600_000
+
+
+def _batch(rng, n, t_lo=0, t_hi=24 * HOUR, card=5):
+    ts = (T0 + rng.integers(t_lo, t_hi, size=n)).astype(np.int64)
+    return RowBatch(ts.tolist(), {
+        "page": [f"p{int(x)}" for x in rng.integers(card, size=n)],
+        "value": [int(x) for x in rng.integers(0, 100, size=n)],
+        "dvalue": [float(x) for x in rng.random(n)]})
+
+
+def _rig(max_rows_per_hydrant=200, granularity="day"):
+    md = MetadataStore()
+    app = Appenderator("rt", SPECS, query_granularity="none",
+                       max_rows_per_hydrant=max_rows_per_hydrant)
+    driver = StreamAppenderatorDriver(app, SegmentAllocator(md, granularity),
+                                     md)
+    return md, app, driver
+
+
+QUERIES = [
+    TimeseriesQuery.of("rt", [DAY], QSPECS, granularity="hour"),
+    TimeseriesQuery.of("rt", [DAY], QSPECS, granularity="all"),
+    GroupByQuery.of("rt", [DAY], ["page"],
+                    [LongSumAggregator("rows", "rows"),
+                     DoubleSumAggregator("d", "d")], granularity="hour"),
+    TopNQuery.of("rt", [DAY], "page", "rows", 3,
+                 [LongSumAggregator("rows", "rows"),
+                  DoubleSumAggregator("d", "d")]),
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_incremental_parity_randomized_schedule(qi):
+    """Randomized append/persist/publish churn: after every mutation the
+    standing tick's snapshot must equal BOTH the standing module's own
+    from-scratch re-scan AND an ordinary executor run over the same world
+    — exact equality, floats included (dict == compares float bits)."""
+    rng = np.random.default_rng(100 + qi)
+    md, app, driver = _rig()
+    q = QUERIES[qi]
+    sq = StandingQuery(q, [app])
+    publishes = 0
+    try:
+        for step in range(30):
+            op = rng.random()
+            if op < 0.70:
+                driver.add_batch(_batch(rng, int(rng.integers(20, 120))))
+            elif op < 0.88:
+                app.persist_all()
+            else:
+                cur = md.datasource_metadata("rt")
+                ok = driver.publish_all(
+                    cur, {"partitions": {"0": publishes + 1}})
+                assert ok
+                publishes += 1
+            sq.tick()
+            rows = sq.rows()
+            world = sq.world_segments()
+            assert rows == sq.rescan_rows()
+            assert rows == QueryExecutor().run(q, segments=world)
+    finally:
+        sq.close()
+
+
+def test_standing_disabled_restores_rescan_world(monkeypatch):
+    """DRUID_TPU_STANDING=0: every tick recomputes from scratch — results
+    identical, but the fold counter shows the whole world refolding."""
+    rng = np.random.default_rng(7)
+    md, app, driver = _rig(max_rows_per_hydrant=50)
+    q = QUERIES[0]
+    sq = StandingQuery(q, [app])
+    try:
+        for _ in range(4):                   # several hydrants
+            driver.add_batch(_batch(rng, 60))
+            app.persist_all()
+        sq.tick()
+        baseline = sq.rows()
+        n_world = len(sq.world_segments())
+        assert n_world > 2
+
+        prev = standing_mod.set_enabled(False)
+        try:
+            s0 = standing_mod.stats().snapshot()
+            sq.tick()
+            s1 = standing_mod.stats().snapshot()
+            # the whole world refolded (no incremental caching)...
+            assert s1["folds"] - s0["folds"] >= n_world
+            # ...to the identical result
+            assert sq.rows() == baseline
+        finally:
+            standing_mod.set_enabled(prev)
+
+        # re-enabled: the next tick rebuilds the incremental caches once,
+        # then quiet ticks are free again
+        sq.tick()
+        s2 = standing_mod.stats().snapshot()
+        sq.tick()
+        s3 = standing_mod.stats().snapshot()
+        assert s3["folds"] == s2["folds"]
+        assert sq.rows() == baseline
+    finally:
+        sq.close()
+
+
+def test_ticks_fold_only_the_delta():
+    """The incremental contract: after the first full fold, a tick pays
+    device folds only for NEW data — sealed hydrants never refold, and a
+    quiet tick folds nothing."""
+    rng = np.random.default_rng(3)
+    md, app, driver = _rig(max_rows_per_hydrant=100)
+    q = QUERIES[0]
+    sq = StandingQuery(q, [app])
+    try:
+        for _ in range(5):                   # 5 sealed hydrants
+            driver.add_batch(_batch(rng, 120))
+            app.persist_all()
+        sq.tick()
+        assert len(sq.world_segments()) >= 5
+        stats0 = standing_mod.stats().snapshot()
+
+        # quiet tick: zero folds
+        assert sq.tick() is None
+        stats1 = standing_mod.stats().snapshot()
+        assert stats1["folds"] == stats0["folds"]
+
+        # small append: exactly ONE fold (the live hydrant), regardless of
+        # how many sealed hydrants exist
+        driver.add_batch(_batch(rng, 10))
+        snap = sq.tick()
+        assert snap is not None
+        stats2 = standing_mod.stats().snapshot()
+        assert stats2["folds"] - stats1["folds"] == 1
+        assert sq.rows() == sq.rescan_rows()
+
+        # the tick right after a LIVE fold is quiet again: the stored
+        # high-water marker is the POST-compaction one the snapshot
+        # describes (snapshotting compacts the index, bumping its
+        # generation — a pre-compaction marker would refold the whole
+        # live hydrant here and spuriously emit)
+        assert sq.tick() is None
+        stats2b = standing_mod.stats().snapshot()
+        assert stats2b["folds"] == stats2["folds"]
+
+        # a persist that seals the already-folded snapshot costs NOTHING:
+        # the live fold is promoted to hydrant rank verbatim
+        app.persist_all()
+        sq.tick()
+        stats3 = standing_mod.stats().snapshot()
+        assert stats3["folds"] == stats2["folds"]
+        assert sq.rows() == sq.rescan_rows()
+    finally:
+        sq.close()
+
+
+def test_publish_cutover_exactly_once():
+    """Across the publish boundary every emission counts each row exactly
+    once: pre-cutover from the sink's incremental partials, post-cutover
+    from the published segment — never both, never neither."""
+    rng = np.random.default_rng(11)
+    md, app, driver = _rig()
+    q = TimeseriesQuery.of("rt", [DAY],
+                           [LongSumAggregator("rows", "rows")],
+                           granularity="all")
+    sq = StandingQuery(q, [app])
+    try:
+        driver.add_batch(_batch(rng, 300))
+        sq.tick()
+        assert sq.rows()[0]["result"]["rows"] == 300
+
+        c0 = standing_mod.stats().snapshot()["cutovers"]
+        assert driver.publish_all(None, {"partitions": {"0": 1}})
+        snap = sq.tick()
+        assert snap is not None
+        assert standing_mod.stats().snapshot()["cutovers"] == c0 + 1
+        assert sq.rows()[0]["result"]["rows"] == 300
+        # the world is now exactly the published segment
+        world = sq.world_segments()
+        assert len(world) == 1
+        assert sq.rows() == QueryExecutor().run(q, segments=world)
+
+        # appends after the cutover allocate a NEW sink alongside it
+        driver.add_batch(_batch(rng, 50))
+        sq.tick()
+        assert sq.rows()[0]["result"]["rows"] == 350
+        assert sq.rows() == sq.rescan_rows()
+    finally:
+        sq.close()
+
+
+def test_dropped_without_publish_removes_contribution():
+    rng = np.random.default_rng(13)
+    md, app, driver = _rig()
+    q = TimeseriesQuery.of("rt", [DAY],
+                           [LongSumAggregator("rows", "rows")],
+                           granularity="all")
+    sq = StandingQuery(q, [app])
+    try:
+        idents = driver.add_batch(_batch(rng, 100))
+        sq.tick()
+        assert sq.rows()[0]["result"]["rows"] == 100
+        app.drop(idents)                 # discarded task, no publish
+        sq.tick()
+        assert sq.rows() == []
+        assert sq.world_segments() == []
+    finally:
+        sq.close()
+
+
+def test_standing_program_compiles_once(monkeypatch):
+    """Repeated same-shape ticks serve from the jit cache: the standing
+    program compiles once, later folds only dispatch it (the TiLT
+    compile-once contract, asserted on the builder counter)."""
+    import collections
+
+    from druid_tpu.engine import grouping
+
+    monkeypatch.setattr(grouping, "_JIT_CACHE", collections.OrderedDict())
+    builds = []
+    real = grouping._build_device_fn
+
+    def counted(*a, **k):
+        builds.append(1)
+        return real(*a, **k)
+    monkeypatch.setattr(grouping, "_build_device_fn", counted)
+
+    rng = np.random.default_rng(17)
+    md, app, driver = _rig()
+    # fixed-cardinality dim values so hydrant dictionaries agree and the
+    # structure signature is stable across ticks
+    q = TimeseriesQuery.of("rt", [DAY],
+                           [LongSumAggregator("rows", "rows"),
+                            LongSumAggregator("v", "v")],
+                           granularity="hour")
+    sq = StandingQuery(q, [app])
+    try:
+        driver.add_batch(_batch(rng, 120))
+        sq.tick()
+        first = len(builds)
+        assert first >= 1
+        for _ in range(4):
+            driver.add_batch(_batch(rng, 120))
+            sq.tick()
+        assert len(builds) == first, \
+            "later same-shape ticks must not rebuild the program"
+        assert sq.rows() == sq.rescan_rows()
+    finally:
+        sq.close()
+
+
+def test_watermark_bucket_emission():
+    """standingEmit=bucket: appends inside the open granularity bucket do
+    not emit; the watermark crossing a bucket boundary seals it and emits;
+    late data into a sealed bucket emits a correction."""
+    md, app, driver = _rig()
+    q = TimeseriesQuery.of("rt", [DAY],
+                           [LongSumAggregator("rows", "rows")],
+                           granularity="hour",
+                           context={"standingEmit": "bucket"})
+    sq = StandingQuery(q, [app])
+    try:
+        def add_at(ms, n=5):
+            ts = [int(T0 + ms + i) for i in range(n)]
+            driver.add_batch(RowBatch(ts, {
+                "page": ["a"] * n, "value": [1] * n, "dvalue": [0.0] * n}))
+
+        add_at(10 * HOUR)
+        snap = sq.tick()                  # first data seals hour 10's start
+        assert snap is not None
+        assert snap.sealed_through == T0 + 10 * HOUR
+
+        add_at(10 * HOUR + 1000)          # same bucket: data, no emission
+        assert sq.tick() is None
+
+        add_at(11 * HOUR)                 # watermark crosses into hour 11
+        snap = sq.tick()
+        assert snap is not None
+        assert snap.sealed_through == T0 + 11 * HOUR
+        assert snap.rows == sq.rescan_rows()   # snapshots stay consistent
+
+        add_at(2 * HOUR)                  # LATE data into a sealed bucket
+        snap = sq.tick()
+        assert snap is not None and snap.rows == sq.rescan_rows()
+    finally:
+        sq.close()
+
+
+def test_eligibility_rejections():
+    md, app, driver = _rig()
+    with pytest.raises(StandingIneligible):
+        StandingQuery(ScanQuery.of("rt", [DAY]), [app])
+    with pytest.raises(StandingIneligible):
+        StandingQuery(
+            TimeseriesQuery.of("rt", [DAY], QSPECS,
+                               context={"bySegment": True}), [app])
+    with pytest.raises(StandingIneligible):
+        # unbounded bucket space: a century of minutes
+        StandingQuery(TimeseriesQuery.of(
+            "rt", [Interval.of("2000-01-01", "2100-01-01")], QSPECS,
+            granularity="minute"), [app])
+    with pytest.raises(StandingIneligible):
+        # ETERNITY at fine granularity must be a cheap rejection, never
+        # an attempt to materialize the bucket array (MemoryError/OOM on
+        # the subscribe endpoint)
+        StandingQuery(TimeseriesQuery.of(
+            "rt", [Interval.eternity()], QSPECS, granularity="minute"),
+            [app])
+    with pytest.raises(StandingIneligible):
+        # same for calendar granularities (counted by bounded walk)
+        StandingQuery(TimeseriesQuery.of(
+            "rt", [Interval.eternity()], QSPECS, granularity="month"),
+            [app])
+    with pytest.raises(ValueError):
+        StandingQuery(TimeseriesQuery.of("other_ds", [DAY], QSPECS), [app])
+
+
+def test_carry_bridge_across_live_generations():
+    """Successive live-hydrant snapshots hand their parked megakernel
+    carry grids forward (Segment.adopt_carries_from): the pool holds ONE
+    carry entry per program across ticks instead of accumulating one per
+    snapshot generation."""
+    from druid_tpu.data.segment import Segment, SegmentId
+    from druid_tpu.engine import megakernel
+
+    a = Segment(SegmentId("cb", DAY, "v1"),
+                np.asarray([T0, T0 + 1], dtype=np.int64), {}, {})
+    b = Segment(SegmentId("cb", DAY, "v1"),
+                np.asarray([T0, T0 + 1, T0 + 2], dtype=np.int64), {}, {})
+    sentinel = ("grid",)
+    a.device_cached(("megacarry", "sig-x"), lambda: sentinel)
+    b.adopt_carries_from(a)
+    assert b.carry_donor() is a
+    # the bridge pops the donor's entry exactly once (the donated-carry
+    # handoff: buffers must leave the pool before donation invalidates)
+    assert a.device_take(("megacarry", "sig-x")) is sentinel
+    assert a.device_take(("megacarry", "sig-x")) is None
+    # a collected donor degrades to None, never a dangling ref
+    del a
+    import gc
+    gc.collect()
+    assert b.carry_donor() is None
+
+
+def test_concurrent_reader_exactly_once_through_persist_publish():
+    """The persist/publish boundary race (ISSUE satellite): a reader
+    hammering the sink's query surface while persist_hydrant/publish_all
+    churn must count each row exactly once in EVERY observation — pre- and
+    post-handoff worlds both serve the full row set through the broker's
+    replica view."""
+    from druid_tpu.cluster import (Broker, DataNode, InventoryView,
+                                   descriptor_for)
+    from druid_tpu.cluster.realtime import RealtimeServer
+
+    rng = np.random.default_rng(23)
+    md = MetadataStore()
+    app = Appenderator("rt", SPECS, query_granularity="none",
+                       max_rows_per_hydrant=64)
+    view = InventoryView()
+    rts = RealtimeServer("rt-node", view)
+    rts.attach(app)
+    historical = DataNode("hist")
+    view.register(historical)
+
+    def handoff(pairs):
+        for desc, seg in pairs:
+            historical.load_segment(seg, desc)
+            view.announce(historical.name, desc)
+
+    driver = StreamAppenderatorDriver(
+        app, SegmentAllocator(md, "day"), md, handoff=handoff)
+    broker = Broker(view)
+
+    n = 600
+    driver.add_batch(_batch(rng, n))
+    q = TimeseriesQuery.of("rt", [DAY],
+                           [LongSumAggregator("rows", "rows")],
+                           granularity="all")
+
+    errors = []
+    counts = []
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                rows = broker.run(q)
+                counts.append(rows[0]["result"]["rows"] if rows else 0)
+        except Exception as e:            # pragma: no cover - must not
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        # churn the boundary the readers race
+        for _ in range(3):
+            app.persist_all()
+        assert driver.publish_all(None, {"partitions": {"0": 1}})
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        broker.stop()
+
+    assert errors == []
+    assert counts, "readers never completed a query"
+    bad = [c for c in counts if c != n]
+    assert not bad, f"row-count drift through the boundary: {set(bad)}"
+    # and the post-handoff world still serves exactly once
+    assert broker_count(broker, q) == n
+
+
+def broker_count(broker, q):
+    rows = broker.run(q)
+    return rows[0]["result"]["rows"] if rows else 0
+
+
+def test_standing_monitor_names_in_catalog():
+    from druid_tpu.obs.catalog import validate_emitted
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+
+    sink = InMemoryEmitter()
+    emitter = ServiceEmitter("t", "h", sink)
+    StandingMetricsMonitor().do_monitor(emitter)
+    names = {e.metric for e in sink.events}
+    assert names, "monitor emitted nothing"
+    assert validate_emitted(names) == []

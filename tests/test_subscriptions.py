@@ -1,0 +1,319 @@
+"""Subscription-hub tests (server/subscriptions.py): dedupe onto one
+standing program, long-poll + ETag/304 fan-out, refcounted teardown, the
+scheduler-flush-loop tick driver, and lifecycle leak hygiene."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from druid_tpu.cluster.metadata import MetadataStore
+from druid_tpu.engine import QueryExecutor
+from druid_tpu.ingest import (Appenderator, RowBatch, SegmentAllocator,
+                              StreamAppenderatorDriver)
+from druid_tpu.obs import dispatch as dispatch_mod
+from druid_tpu.query.aggregators import CountAggregator, LongSumAggregator
+from druid_tpu.query.model import TimeseriesQuery
+from druid_tpu.server.subscriptions import (SubscriptionHub,
+                                            SubscriptionMetricsMonitor,
+                                            UnknownSubscriptionError)
+from druid_tpu.utils.intervals import Interval
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+SPECS = [CountAggregator("rows"), LongSumAggregator("v", "value")]
+QSPECS = [LongSumAggregator("rows", "rows"), LongSumAggregator("v", "v")]
+DAY = Interval.of("2026-03-01", "2026-03-02")
+T0 = DAY.start
+
+
+def _batch(rng, n, off=0):
+    ts = [int(T0 + (off + i) * 1000) for i in range(n)]
+    return RowBatch(ts, {
+        "page": [f"p{int(x)}" for x in rng.integers(5, size=n)],
+        "value": [int(x) for x in rng.integers(10, size=n)]})
+
+
+def _rig():
+    md = MetadataStore()
+    app = Appenderator("rt", SPECS, query_granularity="none")
+    driver = StreamAppenderatorDriver(app, SegmentAllocator(md, "day"), md)
+    return md, app, driver
+
+
+def _query(granularity="all", **ctx):
+    return TimeseriesQuery.of("rt", [DAY], QSPECS, granularity=granularity,
+                              context=ctx or None)
+
+
+def test_identical_subscriptions_share_one_program_one_dispatch():
+    """THE fan-out acceptance: N structurally identical subscriptions run
+    ONE standing program — the tick's device dispatch count is independent
+    of N (dispatch-counter assertion)."""
+    rng = np.random.default_rng(0)
+    md, app, driver = _rig()
+    hub = SubscriptionHub(idle_timeout_s=0)
+    hub.attach(app)
+    try:
+        subs = [hub.subscribe(_query()) for _ in range(64)]
+        assert hub.active_subscriptions() == 64
+        assert hub.active_programs() == 1
+
+        driver.add_batch(_batch(rng, 400))
+        hub.tick()                        # warm: compiles + first fold
+        driver.add_batch(_batch(rng, 400, off=400))
+        d0 = dispatch_mod.count()
+        hub.tick()
+        fan64 = dispatch_mod.count() - d0
+        assert fan64 == 1, \
+            f"64 identical subscriptions cost {fan64} dispatches per tick"
+
+        # every subscriber sees the same rows/etag (one merge, N deliveries)
+        rows0, etag0, changed = hub.poll(subs[0][0], etag=subs[0][1])
+        assert changed and rows0[0]["result"]["rows"] == 800
+        for sid, etag in subs[1:]:
+            rows, new_etag, ch = hub.poll(sid, etag=etag)
+            assert ch and rows == rows0 and new_etag == etag0
+
+        # context differences do NOT split programs (structure signature
+        # excludes context); a different granularity DOES — and so does a
+        # different EMISSION POLICY (standingEmit is context, but changes
+        # what a program delivers: it must not dedupe across policies)
+        sid_ctx, _ = hub.subscribe(_query(queryId="abc"))
+        assert hub.active_programs() == 1
+        sid_g, _ = hub.subscribe(_query(granularity="hour"))
+        assert hub.active_programs() == 2
+        sid_b, _ = hub.subscribe(_query(granularity="hour",
+                                        standingEmit="bucket"))
+        assert hub.active_programs() == 3
+        hub.unsubscribe(sid_ctx)
+        hub.unsubscribe(sid_g)
+        hub.unsubscribe(sid_b)
+    finally:
+        hub.stop()
+    assert hub.active_subscriptions() == 0
+    assert hub.active_programs() == 0
+    assert app._listeners == []
+
+
+def test_long_poll_304_and_wakeup():
+    rng = np.random.default_rng(1)
+    md, app, driver = _rig()
+    hub = SubscriptionHub(idle_timeout_s=0)
+    hub.attach(app)
+    try:
+        sid, etag = hub.subscribe(_query())
+        # unchanged within the window → the 304 path
+        t0 = time.monotonic()
+        rows, new_etag, changed = hub.poll(sid, etag=etag, timeout_s=0.15)
+        assert not changed and rows is None and new_etag == etag
+        assert time.monotonic() - t0 >= 0.14
+
+        # a tick that emits wakes a parked long-poll before its deadline
+        got = {}
+
+        def parked():
+            got["r"] = hub.poll(sid, etag=etag, timeout_s=30.0)
+
+        t = threading.Thread(target=parked)
+        t.start()
+        time.sleep(0.05)
+        driver.add_batch(_batch(rng, 100))
+        hub.tick()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        rows, _, changed = got["r"]
+        assert changed and rows[0]["result"]["rows"] == 100
+
+        # an unsubscribe mid-poll raises, not hangs
+        def parked_dead():
+            with pytest.raises(UnknownSubscriptionError):
+                hub.poll(sid, etag=hub.poll(sid)[1], timeout_s=30.0)
+
+        t2 = threading.Thread(target=parked_dead)
+        t2.start()
+        time.sleep(0.05)
+        hub.unsubscribe(sid)
+        t2.join(timeout=10)
+        assert not t2.is_alive()
+    finally:
+        hub.stop()
+
+
+def test_idle_subscriptions_swept():
+    """A client that silently disconnected (stopped polling) is torn down
+    by the tick sweep — refcounted state cannot leak forever."""
+    md, app, driver = _rig()
+    hub = SubscriptionHub(idle_timeout_s=0.05)
+    hub.attach(app)
+    try:
+        sid, _ = hub.subscribe(_query())
+        assert hub.active_subscriptions() == 1
+        time.sleep(0.1)
+        hub.tick()
+        assert hub.active_subscriptions() == 0
+        assert hub.active_programs() == 0
+        with pytest.raises(UnknownSubscriptionError):
+            hub.poll(sid)
+    finally:
+        hub.stop()
+
+
+def test_scheduler_flush_loop_drives_ticks():
+    """drive_with(scheduler): the data-node scheduler's dispatcher loop is
+    the tick driver — appended data surfaces to a subscriber without
+    anyone calling hub.tick()."""
+    from druid_tpu.cluster.view import DataNode
+    from druid_tpu.server.scheduler import (DataNodeScheduler,
+                                            SchedulerConfig)
+
+    rng = np.random.default_rng(2)
+    md, app, driver = _rig()
+    node = DataNode("n0")
+    sched = DataNodeScheduler(node, SchedulerConfig()).start()
+    hub = SubscriptionHub(idle_timeout_s=0).drive_with(sched)
+    hub.attach(app)
+    try:
+        sid, etag = hub.subscribe(_query())
+        driver.add_batch(_batch(rng, 50))
+        rows, _, changed = hub.poll(sid, etag=etag, timeout_s=30.0)
+        assert changed and rows[0]["result"]["rows"] == 50
+    finally:
+        hub.stop()
+        sched.stop()
+    assert sched._tick_hooks == []
+
+
+def test_http_subscription_surface_end_to_end():
+    """POST subscribe → GET long-poll (200 + X-Druid-ETag, then 304 via
+    If-None-Match, then 200 again after new data) → DELETE teardown; an
+    ineligible query is a 400, an unknown id a 404."""
+    from druid_tpu.server import QueryHttpServer, QueryLifecycle
+
+    rng = np.random.default_rng(3)
+    md, app, driver = _rig()
+    hub = SubscriptionHub(idle_timeout_s=0)
+    hub.attach(app)
+    ex = QueryExecutor(app.query_segments())
+    srv = QueryHttpServer(QueryLifecycle(ex), subscription_hub=hub,
+                          port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        q = {"queryType": "timeseries", "dataSource": "rt",
+             "intervals": [str(DAY)], "granularity": "all",
+             "aggregations": [{"type": "longSum", "name": "rows",
+                               "fieldName": "rows"}]}
+        req = urllib.request.Request(
+            f"{base}/druid/v2/subscriptions", data=json.dumps(q).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        sub_id, etag = body["subscriptionId"], body["etag"]
+
+        # unconditional GET: current snapshot (empty world yet)
+        with urllib.request.urlopen(
+                f"{base}/druid/v2/subscriptions/{sub_id}") as r:
+            assert r.status == 200
+            assert r.headers["X-Druid-ETag"] == etag
+
+        # If-None-Match on the current etag: 304 within the window
+        req = urllib.request.Request(
+            f"{base}/druid/v2/subscriptions/{sub_id}?timeoutMs=100",
+            headers={"If-None-Match": etag})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 304
+
+        # new data: the same conditional GET now ships rows + a new etag
+        driver.add_batch(_batch(rng, 75))
+        hub.tick()
+        req = urllib.request.Request(
+            f"{base}/druid/v2/subscriptions/{sub_id}?timeoutMs=5000",
+            headers={"If-None-Match": etag})
+        with urllib.request.urlopen(req) as r:
+            rows = json.loads(r.read())
+            new_etag = r.headers["X-Druid-ETag"]
+        assert new_etag != etag
+        assert rows[0]["result"]["rows"] == 75
+
+        # ineligible query shape → 400
+        bad = dict(q, queryType="scan", columns=[])
+        req = urllib.request.Request(
+            f"{base}/druid/v2/subscriptions",
+            data=json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 400
+
+        # DELETE tears down; a later poll is a 404 (client re-subscribes)
+        req = urllib.request.Request(
+            f"{base}/druid/v2/subscriptions/{sub_id}", method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 202
+            assert json.loads(r.read())["active"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/druid/v2/subscriptions/{sub_id}")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+        hub.stop()
+
+
+def test_hub_stress_returns_to_baseline():
+    """Subscription-lifecycle leak hygiene under the leak witness:
+    subscribe/poll/tick/unsubscribe churn plus hub start/stop cycles leave
+    no thread, fd, or device-pool residue (the ISSUE's leakguard
+    satellite; DRUID_TPU_LEAK_WITNESS=1 additionally runs the whole suite
+    under the session witness)."""
+    import sys
+    sys.path.insert(0, str(REPO_ROOT))
+    from tools.druidlint.leakwitness import LeakWitness
+
+    rng = np.random.default_rng(4)
+
+    def cycle():
+        md, app, driver = _rig()
+        hub = SubscriptionHub(idle_timeout_s=0,
+                              tick_period_s=0.01).start()
+        hub.attach(app)
+        subs = [hub.subscribe(_query()) for _ in range(8)]
+        driver.add_batch(_batch(rng, 64))
+        for sid, etag in subs:
+            rows, _, changed = hub.poll(sid, etag=etag, timeout_s=10.0)
+            assert changed and rows
+        for sid, _ in subs[:4]:
+            hub.unsubscribe(sid)
+        hub.stop()                        # sweeps the rest
+        assert hub.active_subscriptions() == 0
+        assert app._listeners == []
+
+    w = LeakWitness(str(REPO_ROOT)).install()
+    try:
+        cycle()                           # warmup: lazy init + compiles
+        base = w.snapshot()
+        for _ in range(3):
+            cycle()
+        assert w.leaks(base, grace_s=10.0) == []
+    finally:
+        w.uninstall()
+
+
+def test_subscription_monitor_names_in_catalog():
+    from druid_tpu.obs.catalog import validate_emitted
+    from druid_tpu.utils.emitter import InMemoryEmitter, ServiceEmitter
+
+    hub = SubscriptionHub(idle_timeout_s=0)
+    try:
+        sink = InMemoryEmitter()
+        SubscriptionMetricsMonitor(hub).do_monitor(
+            ServiceEmitter("t", "h", sink))
+        names = {e.metric for e in sink.events}
+        assert names and validate_emitted(names) == []
+    finally:
+        hub.stop()
