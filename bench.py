@@ -7,7 +7,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
 "decoded_rate", "pack_ratio", "fused_rate", "staged_rate",
 "dispatch_count_fused", "dispatch_count_staged", "donated_tick_rate",
 "rle_rate", "packed_only_rate", "cascade_ratio", "code_domain_rate",
-"hll_log2m12_rate",
+"v1_load_rate", "v2_load_rate", "disk_ratio", "wire_bytes_v1",
+"wire_bytes_v2", "hll_log2m12_rate",
 "untraced_rate", "traced_rate", "trace_overhead"} — packed_* compare
 compressed-domain vs decoded staging on the cold-miss H2D path; fused_*
 compare the one-dispatch megakernel path vs the staged fill-wave path on
@@ -37,6 +38,7 @@ Environment:
   DRUID_TPU_BENCH_INIT_TIMEOUT    backend-init watchdog seconds (default 600)
   DRUID_TPU_BENCH_CASCADE_SEGMENTS  cascade-comparison segments (default 8)
   DRUID_TPU_BENCH_CASCADE_ROWS      rows PER SEGMENT there (default 8192)
+  DRUID_TPU_BENCH_SEGIO_ROWS        segment-io comparison rows (default 65536)
   DRUID_TPU_BENCH_CLIENTS         concurrent closed-loop clients (default 8)
   DRUID_TPU_BENCH_CLIENT_QUERIES  queries per client per mode (default 12)
   DRUID_TPU_BENCH_SCHED_ROWS      rows per segment in that mode (default 4096)
@@ -607,6 +609,79 @@ def _bench_cascade(iters: int):
     }
 
 
+def _bench_segment_io(iters: int):
+    """Segment format V1 vs V2 (storage/format_v2.py) on the RLE-friendly
+    rollup shape:
+
+      v1_load_rate / v2_load_rate  rows/s of a cold load_segment() from a
+                                   freshly persisted directory (V2 is mmap
+                                   + descriptor reconstruction — the block
+                                   codec never runs for eligible columns);
+      disk_ratio                   V1 on-disk bytes / V2 on-disk bytes;
+      wire_bytes_v1 / wire_bytes_v2  dumps_partials payload size for the
+                                   same AggregatePartials, raw (version-1)
+                                   vs compressed (version-2) wire mode.
+    """
+    import shutil
+    import tempfile
+
+    from druid_tpu.cluster import wire
+    from druid_tpu.cluster.view import DataNode
+    from druid_tpu.query.aggregators import (CountAggregator,
+                                             LongSumAggregator)
+    from druid_tpu.query.model import DefaultDimensionSpec, GroupByQuery
+    from druid_tpu.storage.format import load_segment, persist_segment
+    from druid_tpu.storage.format_v2 import persist_segment_v2
+
+    rows = int(os.environ.get("DRUID_TPU_BENCH_SEGIO_ROWS", 65536))
+    seg = cascade_segments(1, rows)[0]
+    tmp = tempfile.mkdtemp(prefix="bench-segio-")
+    try:
+        d1 = os.path.join(tmp, "v1")
+        d2 = os.path.join(tmp, "v2")
+        b1 = persist_segment(seg, d1)
+        b2 = persist_segment_v2(seg, d2)
+
+        def load_rate(d):
+            times = []
+            for _ in range(max(iters, 3)):
+                t = time.time()
+                s = load_segment(d)
+                times.append(time.time() - t)
+                del s  # V2 holds mmaps via its mapper; drop before rmtree
+            return rows / min(times)
+
+        r1 = load_rate(d1)
+        r2 = load_rate(d2)
+        log(f"segio-bench load: v1 {r1 / 1e6:.1f}M rows/s, "
+            f"v2 {r2 / 1e6:.1f}M rows/s "
+            f"(disk {b1} -> {b2} bytes, {b1 / b2:.2f}x)")
+
+        # wire: partials for a granularity-hour groupBy over the rollup
+        # shape — the per-bucket states are heavily repeated, the shape
+        # the wire rle/narrow encodings exist for
+        node = DataNode("bench-segio")
+        node.load_segment(seg)
+        query = GroupByQuery.of(
+            "cascade", [headline_interval()], [DefaultDimensionSpec("dimA")],
+            [CountAggregator("rows"), LongSumAggregator("c", "cnt")],
+            granularity="hour")
+        ap, served = node.run_partials(query, [str(seg.id)])
+        w1 = len(wire.dumps_partials(ap, served, compress=False))
+        w2 = len(wire.dumps_partials(ap, served, compress=True))
+        log(f"segio-bench wire: raw {w1} -> compressed {w2} bytes "
+            f"({w1 / max(w2, 1):.2f}x)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "v1_load_rate": round(r1, 0),
+        "v2_load_rate": round(r2, 0),
+        "disk_ratio": round(b1 / b2, 3),
+        "wire_bytes_v1": w1,
+        "wire_bytes_v2": w2,
+    }
+
+
 def _bench_hll(iters: int):
     """hyperUnique/cardinality at a NON-default register count (log2m=12;
     the ROADMAP-carried rider): per-core rate of a groupBy carrying a
@@ -1026,6 +1101,11 @@ def main():
         log(f"cascade-bench failed: {type(e).__name__}: {e}")
         casc = {"cascade_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        segio = _bench_segment_io(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"segio-bench failed: {type(e).__name__}: {e}")
+        segio = {"segio_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         hll = _bench_hll(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"hll-bench failed: {type(e).__name__}: {e}")
@@ -1066,6 +1146,7 @@ def main():
     out.update(filt)
     out.update(fused)
     out.update(casc)
+    out.update(segio)
     out.update(hll)
     out.update(traced)
     out.update(sched)

@@ -116,10 +116,18 @@ def build_historical(name: str, segments_dir=None, port: int = 8083,
     loaded = 0
     if segments_dir and os.path.isdir(segments_dir):
         from druid_tpu.storage.format import load_segment
+        from druid_tpu.storage.smoosh import CorruptSegmentError
         for entry in sorted(os.listdir(segments_dir)):
             d = os.path.join(segments_dir, entry)
             if os.path.isfile(os.path.join(d, "version.bin")):
-                node.load_segment(load_segment(d))
+                try:
+                    node.load_segment(load_segment(d))
+                except CorruptSegmentError as e:
+                    # skip-and-log: one damaged directory must not keep a
+                    # historical from serving its healthy segments
+                    print(f"skipping corrupt segment: {e}", file=sys.stderr,
+                          flush=True)
+                    continue
                 loaded += 1
     server = DataNodeServer(node, port=port).start()
     return node, server, loaded
@@ -303,6 +311,74 @@ def cmd_dump_segment(args) -> int:
     return 0
 
 
+def cmd_segment_inspect(args) -> int:
+    """Per-column storage forensics: encoding, descriptor, on-disk vs
+    logical (decoded-equivalent) bytes — V1 and format-V2 segments."""
+    import numpy as np
+    from druid_tpu.storage.format import (FORMAT_VERSION_V2,
+                                          read_format_version,
+                                          read_segment_meta)
+    from druid_tpu.storage.smoosh import SmooshedFileMapper
+    version = read_format_version(args.directory)
+    meta = read_segment_meta(args.directory)
+    n_rows = int(meta["n_rows"])
+    specs = (meta.get("v2") or {}).get("columns", {})
+    fmt = 2 if version == FORMAT_VERSION_V2 else 1
+
+    def logical(dtype_str):
+        try:
+            return n_rows * np.dtype(dtype_str).itemsize
+        except TypeError:
+            return None
+
+    _TYPE_DTYPE = {"long": "int64", "float": "float32", "double": "float64"}
+    columns = {}
+    with SmooshedFileMapper(args.directory) as mapper:
+        def size_of(*parts):
+            return sum(mapper.part_size(p) for p in parts if mapper.has(p))
+
+        for name in meta["dimensions"]:
+            spec = specs.get(name, {"enc": "block", "dtype": "int32"})
+            enc = spec["enc"]
+            parts = {"rle": (f"col.{name}.rle.values",
+                             f"col.{name}.rle.ends"),
+                     "pack": (f"col.{name}.pack",),
+                     "block": (f"dim.{name}.ids",)}[enc]
+            desc = {k: v for k, v in spec.items() if k not in ("enc",)}
+            columns[name] = {
+                "kind": "dimension", "enc": enc, "descriptor": desc,
+                "onDiskBytes": size_of(*parts),
+                "logicalBytes": logical(spec.get("dtype", "int32")),
+                "dictBytes": size_of(f"dim.{name}.dict"),
+                "bitmapBytes": size_of(f"dim.{name}.bitmaps")}
+        for name, tname in meta["metrics"].items():
+            dt = _TYPE_DTYPE.get(tname)
+            spec = specs.get(name, {"enc": "block", "dtype": dt})
+            enc = spec["enc"]
+            parts = {"rle": (f"col.{name}.rle.values",
+                             f"col.{name}.rle.ends"),
+                     "pack": (f"col.{name}.pack",),
+                     "lz4": (f"col.{name}.lz4",),
+                     "block": (f"met.{name}",)}[enc]
+            desc = {k: v for k, v in spec.items() if k not in ("enc",)}
+            columns[name] = {
+                "kind": "metric", "type": tname, "enc": enc,
+                "descriptor": desc, "onDiskBytes": size_of(*parts),
+                "logicalBytes": logical(spec.get("dtype", dt))}
+        time_disk = size_of("__time")
+    out = {"directory": args.directory, "format": fmt, "numRows": n_rows,
+           "columns": columns,
+           "time": {"onDiskBytes": time_disk, "logicalBytes": n_rows * 8}}
+    if fmt == 2:
+        out["staging"] = meta["v2"].get("staging")
+    disk = sum(c["onDiskBytes"] for c in columns.values()) + time_disk
+    logi = sum(c["logicalBytes"] or 0 for c in columns.values()) + n_rows * 8
+    out["totals"] = {"onDiskBytes": disk, "logicalBytes": logi,
+                     "ratio": round(logi / disk, 2) if disk else None}
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
 def cmd_validate_segment(args) -> int:
     """Load + self-check an on-disk segment (cli/ValidateSegments.java)."""
     from druid_tpu.storage.format import load_segment
@@ -394,6 +470,13 @@ def main(argv=None) -> int:
     s = sub.add_parser("validate-segment", help="check an on-disk segment")
     s.add_argument("directory")
     s.set_defaults(fn=cmd_validate_segment)
+
+    s = sub.add_parser("segment", help="segment storage tools")
+    seg_sub = s.add_subparsers(dest="segment_command", required=True)
+    si = seg_sub.add_parser(
+        "inspect", help="per-column encoding/descriptor/size report")
+    si.add_argument("directory")
+    si.set_defaults(fn=cmd_segment_inspect)
 
     s = sub.add_parser("version")
     s.set_defaults(fn=lambda a: (print(VERSION), 0)[1])

@@ -259,9 +259,15 @@ class DataNodeServer:
                 # when the query allows partials)
                 missing = [s for s in (payload.get("segments") or [])
                            if str(s) not in served]
+                # compressed payload mode: requester advertised support
+                # AND the query context did not opt out
+                ctx = (payload.get("query") or {}).get("context") or {}
+                compress = bool(payload.get("wireCompress")) \
+                    and ctx.get("wireCompress", True) is not False
                 self._reply_bytes(wire.dumps_partials(ap, served,
                                                       trace=spans,
-                                                      missing=missing))
+                                                      missing=missing,
+                                                      compress=compress))
 
             def _rows(self, payload):
                 (rows, served), spans = self._run(payload, rows_mode=True)
@@ -300,9 +306,11 @@ class DataNodeServer:
         from druid_tpu.engine.megakernel import MegakernelMonitor
         from druid_tpu.obs.dispatch import DispatchMonitor
         from druid_tpu.utils.emitter import MonitorScheduler
+        from druid_tpu.storage.format_v2 import SegmentLoadMonitor
         monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
                     FilterBitmapMonitor(), MegakernelMonitor(),
                     CodeDomainMonitor(), DispatchMonitor(),
+                    wire.WireStatsMonitor(), SegmentLoadMonitor(),
                     self._query_counts]
         if self._scheduler_config is not None:
             self.scheduler = DataNodeScheduler(
@@ -428,8 +436,12 @@ class RemoteDataNodeClient:
     MAX_RETRY_AFTER_SLEEP = 2.0
 
     def _post(self, path: str, query: Query, segment_ids: Sequence[str]):
+        # wireCompress advertises this client reads compressed tensor
+        # entries (wire VERSION_COMPRESSED) — the server only emits them
+        # when asked, so old clients keep receiving version-1 bytes
         body = json.dumps({"query": query.to_json(),
-                           "segments": [str(s) for s in segment_ids]},
+                           "segments": [str(s) for s in segment_ids],
+                           "wireCompress": True},
                           default=_json_value).encode()
         # ONE total budget across the shed retry: the context timeout is
         # the query's, not per-attempt

@@ -25,12 +25,17 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 MAGIC = b"DTPW"
 VERSION = 1
+#: compressed tensor entries (enc: narrow/rle); emitted only when the
+#: requester advertised support AND at least one tensor benefits, so a
+#: version-1 peer never sees bytes it cannot parse
+VERSION_COMPRESSED = 2
 
 # HTTP content type for partials payloads (the data plane's "smile")
 CONTENT_TYPE = "application/x-druid-tpu-partials"
@@ -38,6 +43,55 @@ CONTENT_TYPE = "application/x-druid-tpu-partials"
 
 class WireError(ValueError):
     pass
+
+
+class WireStats:
+    """Cumulative wire accounting: logical (raw little-endian) tensor bytes
+    vs bytes actually emitted after per-tensor compression."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.logical_bytes = 0
+        self.wire_bytes = 0
+        self.compressed_payloads = 0
+
+    def record(self, logical: int, wire: int, compressed: bool) -> None:
+        with self._lock:
+            self.logical_bytes += int(logical)
+            self.wire_bytes += int(wire)
+            if compressed:
+                self.compressed_payloads += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"logicalBytes": self.logical_bytes,
+                    "wireBytes": self.wire_bytes,
+                    "compressedPayloads": self.compressed_payloads}
+
+
+_WIRE_STATS = WireStats()
+
+
+def wire_stats() -> WireStats:
+    return _WIRE_STATS
+
+
+class WireStatsMonitor:
+    """Emits query/wire/{bytes,compressedBytes} per tick (deltas over the
+    tick window). Duck-typed Monitor — utils.emitter only requires
+    do_monitor."""
+
+    def __init__(self, source: Optional[WireStats] = None):
+        self.source = source or _WIRE_STATS
+        self._last = self.source.snapshot()
+
+    def do_monitor(self, emitter):
+        s = self.source.snapshot()
+        last, self._last = self._last, s
+        emitter.metric("query/wire/bytes",
+                       s["logicalBytes"] - last["logicalBytes"])
+        emitter.metric("query/wire/compressedBytes",
+                       s["wireBytes"] - last["wireBytes"])
 
 
 # ---------------------------------------------------------------------------
@@ -55,17 +109,87 @@ class _TensorTable:
     def add_opt(self, a: Optional[np.ndarray]) -> Optional[int]:
         return None if a is None else self.add(np.asarray(a))
 
-    def manifest_and_payload(self) -> Tuple[List[dict], bytes]:
-        manifest, chunks, off = [], [], 0
+    def manifest_and_payload(self, compress: bool = False
+                             ) -> Tuple[List[dict], bytes, int]:
+        """(manifest, payload, logical_bytes). With compress=True each
+        tensor additionally tries the bit-exact wire encodings (_wire_enc)
+        and ships the smallest form; entries then carry an "enc" key and
+        the payload needs a VERSION_COMPRESSED reader."""
+        manifest, chunks, off, logical = [], [], 0, 0
         for a in self.arrays:
             if a.dtype == object:
                 raise WireError("object arrays are not wire-serializable")
             data = a.tobytes()
-            manifest.append({"dtype": a.dtype.str, "shape": list(a.shape),
-                             "off": off, "len": len(data)})
+            logical += len(data)
+            entry = {"dtype": a.dtype.str, "shape": list(a.shape)}
+            if compress:
+                enc = _wire_enc(a, len(data))
+                if enc is not None:
+                    entry.update(enc[0])
+                    data = enc[1]
+            entry["off"], entry["len"] = off, len(data)
             off += len(data)
             chunks.append(data)
-        return manifest, b"".join(chunks)
+            manifest.append(entry)
+        return manifest, b"".join(chunks), logical
+
+
+def _int_view_dtype(dt: np.dtype) -> Optional[np.dtype]:
+    """Same-width integer view dtype for run comparison: floats compare as
+    bit patterns so -0.0 vs 0.0 and NaN payloads survive the round trip
+    EXACTLY (value comparison would merge/kill them)."""
+    if dt.kind in ("i", "u"):
+        return dt
+    if dt.kind == "f" and dt.itemsize in (4, 8):
+        return np.dtype(f"<i{dt.itemsize}")
+    if dt.kind == "b":
+        return np.dtype(np.uint8)
+    return None
+
+
+def _wire_enc(a: np.ndarray, raw_len: int
+              ) -> Optional[Tuple[dict, bytes]]:
+    """Best bit-exact wire encoding of `a`, or None to ship raw.
+
+    "rle":    1-D run tables (values + int32 lengths) over the integer bit
+              view — the dominant win for broker partials, whose per-key
+              state arrays are mostly constant runs on RLE-friendly data.
+    "narrow": integers recast to the smallest signed dtype holding
+              min/max (counts and dictionary ids rarely need 8 bytes).
+    """
+    if a.size < 16:
+        return None
+    best: Optional[Tuple[dict, bytes]] = None
+
+    vdt = _int_view_dtype(a.dtype)
+    if vdt is not None and a.ndim == 1:
+        v = a.view(vdt)
+        changes = np.flatnonzero(v[1:] != v[:-1])
+        n_runs = int(changes.shape[0]) + 1
+        rle_len = n_runs * (vdt.itemsize + 4)
+        if rle_len < raw_len:
+            starts = np.concatenate([[0], changes + 1])
+            values = v[starts]
+            lengths = np.diff(np.concatenate(
+                [starts, [v.shape[0]]])).astype(np.int32)
+            best = ({"enc": "rle", "runs": n_runs, "vdtype": vdt.str},
+                    values.tobytes() + lengths.tobytes())
+
+    if a.dtype.kind in ("i", "u"):
+        lo = int(a.min())
+        hi = int(a.max())
+        for sdt in (np.int8, np.int16, np.int32):
+            d = np.dtype(sdt)
+            if d.itemsize >= a.dtype.itemsize:
+                break
+            if np.iinfo(d).min <= lo and hi <= np.iinfo(d).max:
+                nlen = a.size * d.itemsize
+                if nlen < raw_len and (best is None
+                                       or nlen < len(best[1])):
+                    best = ({"enc": "narrow", "sdtype": d.str},
+                            a.astype(d).tobytes())
+                break
+    return best
 
 
 def _read_tensors(manifest: Sequence[dict], payload: memoryview
@@ -76,7 +200,30 @@ def _read_tensors(manifest: Sequence[dict], payload: memoryview
         if dt == object or dt.hasobject:
             raise WireError("object dtype in wire payload")
         buf = payload[m["off"]: m["off"] + m["len"]]
-        out.append(np.frombuffer(buf, dtype=dt).reshape(m["shape"]).copy())
+        enc = m.get("enc")
+        if enc == "rle":
+            vdt = np.dtype(m["vdtype"])
+            if vdt.hasobject:
+                raise WireError("object dtype in wire payload")
+            n_runs = int(m["runs"])
+            split = n_runs * vdt.itemsize
+            values = np.frombuffer(buf[:split], dtype=vdt)
+            lengths = np.frombuffer(buf[split:], dtype=np.int32)
+            if lengths.shape[0] != n_runs or int(lengths.sum()) < 0:
+                raise WireError("malformed rle tensor entry")
+            a = np.repeat(values, lengths).view(dt).reshape(m["shape"])
+            out.append(a.copy())
+        elif enc == "narrow":
+            sdt = np.dtype(m["sdtype"])
+            if sdt.hasobject:
+                raise WireError("object dtype in wire payload")
+            a = np.frombuffer(buf, dtype=sdt).astype(dt)
+            out.append(a.reshape(m["shape"]))
+        elif enc is None:
+            out.append(np.frombuffer(buf, dtype=dt)
+                       .reshape(m["shape"]).copy())
+        else:
+            raise WireError(f"unknown tensor encoding {enc!r}")
     return out
 
 
@@ -173,7 +320,8 @@ def rebuild_kernels(agg_jsons: Sequence[dict]):
 
 def dumps_partials(ap, served: Sequence[str] = (),
                    trace: Sequence[dict] = (),
-                   missing: Sequence[str] = ()) -> bytes:
+                   missing: Sequence[str] = (),
+                   compress: bool = False) -> bytes:
     """Serialize AggregatePartials (+ the served-segment-id set the node is
     acknowledging, and the node's finished trace spans — plain JSON dicts —
     so the broker can assemble one end-to-end trace per query; both ride in
@@ -181,7 +329,11 @@ def dumps_partials(ap, served: Sequence[str] = (),
     on the wire: segment ids the node was ASKED for but could not serve —
     the broker's degradation report composes from these, and a
     broker-of-brokers tier can propagate them without re-deriving the
-    requested set."""
+    requested set.
+
+    compress=True enables the bit-exact per-tensor wire encodings; emit
+    it only for peers that advertised support ("wireCompress") — the
+    payload then carries wire version 2 when any tensor benefits."""
     tt = _TensorTable()
     partials = []
     for p in ap.partials:
@@ -201,10 +353,14 @@ def dumps_partials(ap, served: Sequence[str] = (),
         "missing": sorted(str(s) for s in missing),
         "trace": list(trace),
     }
-    manifest, payload = tt.manifest_and_payload()
+    manifest, payload, logical = tt.manifest_and_payload(compress=compress)
     header["tensors"] = manifest
     hj = json.dumps(header).encode()
-    return MAGIC + struct.pack("<BI", VERSION, len(hj)) + hj + payload
+    any_enc = any("enc" in m for m in manifest)
+    version = VERSION_COMPRESSED if any_enc else VERSION
+    body = MAGIC + struct.pack("<BI", version, len(hj)) + hj + payload
+    _WIRE_STATS.record(logical, len(payload), any_enc)
+    return body
 
 
 class PartialsPayload(tuple):
@@ -231,7 +387,7 @@ def loads_partials(data: bytes):
     if bytes(mv[:4]) != MAGIC:
         raise WireError("bad magic")
     version, hlen = struct.unpack("<BI", mv[4:9])
-    if version != VERSION:
+    if version not in (VERSION, VERSION_COMPRESSED):
         raise WireError(f"unsupported wire version {version}")
     header = json.loads(bytes(mv[9: 9 + hlen]))
     tensors = _read_tensors(header["tensors"], mv[9 + hlen:])

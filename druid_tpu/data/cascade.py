@@ -636,16 +636,18 @@ def plan_column(segment, name: str) -> Optional[Tuple]:
     m = segment.metrics.get(name)
     if m is None:
         return None
-    vals = np.asarray(m.values)
-    if vals.ndim != 1:
-        return None
-    if np.issubdtype(vals.dtype, np.integer):
+    # plan from COLUMN METADATA, not np.asarray(m.values): lazy format-V2
+    # columns must be plannable without materializing decoded rows (the
+    # zero-host-decode load path)
+    from druid_tpu.data.segment import ValueType
+    t = getattr(m, "type", None)
+    if t is ValueType.LONG:
         if segment.staged_dtype(name) != np.int32:
             return None
         return _plan_rle(segment, name)
-    if vals.dtype in (np.float32, np.float64):
+    if t in (ValueType.FLOAT, ValueType.DOUBLE):
         return _plan_lz4(segment, name)
-    return None
+    return None                           # complex states: stage as-is
 
 
 def plan_columns(segment, columns: Sequence[str],
@@ -678,6 +680,19 @@ def plan_pair(segment, columns: Sequence[str],
     packs = packed_mod.plan_columns(
         segment, [c for c in columns if c not in claimed])
     return cascades, packs
+
+
+def descriptor_to_json(entries: Tuple) -> list:
+    """JSON form of a cascade/pack descriptor tuple (format V2 persists the
+    staging plan alongside the parts, so `segment inspect` and the loader
+    can show/validate exactly what was encoded)."""
+    return [list(e) for e in entries]
+
+
+def descriptor_from_json(obj) -> Tuple:
+    """Exact inverse of descriptor_to_json (tuples restored, so the result
+    is hashable and == the original plan_pair output)."""
+    return tuple(tuple(e) for e in obj)
 
 
 # ---------------------------------------------------------------------------
@@ -914,8 +929,8 @@ def _plan_run_kernel(k, segment) -> Optional[_RunKernel]:
         m = segment.metrics.get(f)
         if m is None:
             return _RunKernel(kernel=k)   # missing column sums to zeros
-        if not np.issubdtype(np.asarray(m.values).dtype, np.integer):
-            return None
+        if getattr(m, "type", None) is not ValueType.LONG:
+            return None                   # metadata check: lazy V2 columns
         return _RunKernel(kernel=k, cols=frozenset({f}))
     if isinstance(k, MinMaxKernel):
         f = k.spec.field
@@ -1115,19 +1130,29 @@ def _joint_runs(segment, pkey: Tuple[str, ...],
     the named columns (plus, when `bucket` = (first, period, B), the
     uniform-granularity bucket id), or None when too fine-grained to
     pay."""
+    def _col_change_starts(c) -> np.ndarray:
+        # RLE fast path: a column's change points ARE its run starts, so a
+        # column with (cached or format-V2-seeded) run tables contributes
+        # them directly — no row scan, no lazy-column materialization
+        info = column_run_info(segment, c)
+        if info is not None:
+            _, ends, nr = info
+            return ends[:nr - 1].astype(np.int64) if nr > 1 \
+                else np.zeros(0, dtype=np.int64)
+        col = segment.dims.get(c)
+        v = col.ids if col is not None else segment.metrics[c].values
+        return (np.flatnonzero(v[1:] != v[:-1]) + 1).astype(np.int64)
+
     def _compute():
         n = segment.n_rows
-        b = np.zeros(n, dtype=bool)
-        b[0] = True
-        for c in pkey:
-            col = segment.dims.get(c)
-            v = col.ids if col is not None else segment.metrics[c].values
-            b[1:] |= v[1:] != v[:-1]
+        chunks = [np.zeros(1, dtype=np.int64)]
+        chunks.extend(_col_change_starts(c) for c in pkey)
         if bucket is not None:
             first, period, _ = bucket
             bid = (segment.time_ms - first) // period
-            b[1:] |= bid[1:] != bid[:-1]
-        starts = np.flatnonzero(b).astype(np.int32)
+            chunks.append(
+                (np.flatnonzero(bid[1:] != bid[:-1]) + 1).astype(np.int64))
+        starts = np.unique(np.concatenate(chunks)).astype(np.int32)
         lengths = np.diff(np.concatenate(
             [starts, [n]])).astype(np.int32)
         return starts, lengths, int(starts.shape[0])
@@ -1142,6 +1167,25 @@ def _joint_runs(segment, pkey: Tuple[str, ...],
     if nr > cap or nr * RUN_DOMAIN_MIN_ROWS_PER_RUN > segment.n_rows:
         return None
     return starts, lengths, nr
+
+
+def _values_at_starts(segment, name: str, starts: np.ndarray, dt):
+    """Per-run value of a run-constant column at the joint-partition run
+    starts. Columns with run tables (cached, or format-V2-seeded on a lazy
+    column) answer via searchsorted over the tables — the mmap-to-HBM path
+    never touches decoded rows; everything else gathers from the host
+    column. The table path only serves int32-staged columns: rle_encode
+    narrows run values to int32, which is exact only there."""
+    if dt == np.int32:
+        info = column_run_info(segment, name)
+        if info is not None:
+            rv, ends, nr = info
+            idx = np.searchsorted(ends[:nr], starts, side="right")
+            return rv[np.minimum(idx, nr - 1)].astype(np.int32)
+    col = segment.dims.get(name)
+    v = (col.ids if col is not None
+         else segment.metrics[name].values)[starts]
+    return v.astype(dt) if v.dtype != dt else v
 
 
 def try_run_domain(segment, intervals, granularity, spec, kernels, flt,
@@ -1181,13 +1225,8 @@ def try_run_domain(segment, intervals, granularity, spec, kernels, flt,
         arrays["__runbucket"] = _staged("__runbucket", bid, fill=-1)
     cols = set(pkey)
     for c in cols:
-        col = segment.dims.get(c)
-        if col is not None:
-            arrays[c] = _staged(c, col.ids[starts])
-        else:
-            dt = segment.staged_dtype(c)
-            v = segment.metrics[c].values[starts]
-            arrays[c] = _staged(c, v.astype(dt) if v.dtype != dt else v)
+        dt = np.int32 if c in segment.dims else segment.staged_dtype(c)
+        arrays[c] = _staged(c, _values_at_starts(segment, c, starts, dt))
 
     aux: List[np.ndarray] = []
     for d in spec.dims:

@@ -179,10 +179,12 @@ def plan_column(segment, name: str) -> Optional[Tuple[int, int]]:
     m = segment.metrics.get(name)
     if m is None:
         return None
-    vals = np.asarray(m.values)
-    if vals.ndim != 1 or not np.issubdtype(vals.dtype, np.integer):
-        # 2-D complex states (HLL registers et al.) stage as-is: the
-        # packer and both decoders are 1-D tile-planar only
+    # metadata check (not np.asarray(m.values)): format-V2 lazy columns
+    # plan without materializing decoded rows. Non-LONG metrics — floats
+    # and 2-D complex states (HLL registers et al.) — stage as-is: the
+    # packer and both decoders are 1-D integer tile-planar only.
+    t = getattr(m, "type", None)
+    if t is None or getattr(t, "value", None) != "long":
         return None
     if segment.staged_dtype(name) != np.int32:
         return None

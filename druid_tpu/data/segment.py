@@ -151,6 +151,19 @@ class ComplexColumn:
         return ColumnCapabilities(ValueType.COMPLEX)
 
 
+class _ShapeStub:
+    """Stands in for a padded host array during staging when the encoder
+    needs only its shape/dtype (cascade rle/lz4 columns encode from cached
+    run/token tables; persisted format-V2 pack words upload directly).
+    Keeps lazy columns lazy: the decoded rows are never built."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, n: int, dtype):
+        self.shape = (n,)
+        self.dtype = np.dtype(dtype)
+
+
 @dataclass
 class DeviceBlock:
     """A segment staged on device as padded dense arrays (all length `padded_rows`).
@@ -322,14 +335,51 @@ class Segment:
         valid[: self.n_rows] = True
         arrays["__valid"] = valid
         dictionaries: Dict[str, Dictionary] = {}
+        packwords: Dict[str, np.ndarray] = {}
+
+        def _cascade_stub(name: str):
+            # rle/lz4 encoders read only cached run/token tables plus the
+            # padded shape — never the decoded rows, so lazy format-V2
+            # columns stage without a host decode
+            c = cascade_for.get(name)
+            return c is not None and c[1] in ("rle", "lz4")
+
+        def _pack_hint(col_obj, name: str):
+            # persisted pack words (format V2) upload as-is when the plan
+            # and padded shape match what was written at persist time
+            if perm is not None:
+                return None
+            hint = getattr(col_obj, "_v2_pack", None)
+            p = pack_for.get(name)
+            if hint is not None and p is not None \
+                    and tuple(hint[1:]) == (p[0], p[1], pad_n):
+                return hint[0]
+            return None
+
         for name in columns:
             if name in self.dims:
                 col = self.dims[name]
-                arrays[name] = _pad(col.ids)
                 dictionaries[name] = col.dictionary
+                if _cascade_stub(name):
+                    arrays[name] = _ShapeStub(pad_n, np.int32)
+                    continue
+                words = _pack_hint(col, name)
+                if words is not None:
+                    packwords[name] = words
+                    arrays[name] = _ShapeStub(pad_n, np.int32)
+                    continue
+                arrays[name] = _pad(col.ids)
             elif name in self.metrics:
                 m = self.metrics[name]
                 dt = self.staged_dtype(name)
+                if _cascade_stub(name):
+                    arrays[name] = _ShapeStub(pad_n, dt)
+                    continue
+                words = _pack_hint(m, name)
+                if words is not None:
+                    packwords[name] = words
+                    arrays[name] = _ShapeStub(pad_n, dt)
+                    continue
                 vals = m.values if m.values.dtype == dt \
                     else m.values.astype(dt)
                 arrays[name] = _pad(vals)
@@ -341,7 +391,7 @@ class Segment:
         put = (lambda a: jax.device_put(a, device)) if device is not None \
             else jax.device_put
 
-        def _stage(name: str, v: np.ndarray):
+        def _stage(name: str, v):
             c = cascade_for.get(name)
             if c is not None:
                 return cascade_mod.encode_column(self, name, c, v, put)
@@ -349,9 +399,10 @@ class Segment:
             if p is None:
                 return put(v)
             w, base = p
-            words = packed_mod.pack_padded(v, w, base)
-            return packed_mod.PackedColumn(put(words), w, base, v.shape[0],
-                                           str(v.dtype))
+            words = packwords[name] if name in packwords \
+                else packed_mod.pack_padded(v, w, base)
+            return packed_mod.PackedColumn(put(np.asarray(words)), w, base,
+                                           v.shape[0], str(v.dtype))
 
         return DeviceBlock(
             segment_id=self.id, n_rows=self.n_rows, padded_rows=pad_n,
@@ -428,7 +479,11 @@ class Segment:
             if -(2**31) <= lo and hi < 2**31:
                 return np.int32
             return np.int64
-        return m.values.dtype
+        if m.type in (ValueType.FLOAT, ValueType.DOUBLE):
+            # from type metadata, not m.values.dtype: lazy format-V2
+            # columns answer without materializing
+            return np.dtype(m.type.numpy_dtype)
+        return m.values.dtype             # complex states
 
     def aux_cached(self, key: Tuple, fn):
         """Memoize derived host arrays (e.g. calendar bucket ids, fused
@@ -443,11 +498,15 @@ class Segment:
         return value
 
     def size_bytes(self) -> int:
+        # logical_nbytes hint first: lazy format-V2 columns report decoded
+        # size without materializing (it equals .nbytes by construction)
         n = self.time_ms.nbytes
         for d in self.dims.values():
-            n += d.ids.nbytes
+            hint = getattr(d, "logical_nbytes", None)
+            n += hint if hint is not None else d.ids.nbytes
         for m in self.metrics.values():
-            n += m.values.nbytes
+            hint = getattr(m, "logical_nbytes", None)
+            n += hint if hint is not None else m.values.nbytes
         return int(n)
 
     def __repr__(self):

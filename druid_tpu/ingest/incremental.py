@@ -588,7 +588,9 @@ class IncrementalIndex:
 
     def persist(self, directory: str, version: str = "v0",
                 partition: int = 0) -> Segment:
-        from druid_tpu.storage.format import persist_segment
+        # format V2 unless DRUID_TPU_SEGMENT_FORMAT=1: ingest pays the
+        # cascade encodings once here, load/staging reuses them verbatim
+        from druid_tpu.storage.format_v2 import persist_segment_auto
         seg = self.to_segment(version, partition)
-        persist_segment(seg, directory)
+        persist_segment_auto(seg, directory)
         return seg

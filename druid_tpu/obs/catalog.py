@@ -311,6 +311,35 @@ METRICS = {
                 "CASCADE-encoded pool entries only (RLE/delta/FOR/LZ4 — "
                 "data/cascade.py; 1.0 when nothing cascade-encoded is "
                 "resident)"},
+    # ---- segment load (storage/format_v2.py) ---------------------------
+    "segment/load/time": {
+        "unit": "ms/period", "dims": (),
+        "site": "storage/format_v2.py",
+        "help": "wall time spent loading segments from disk since the "
+                "last tick (format V2: mmap + descriptor reconstruction, "
+                "no column decode)"},
+    "segment/load/bytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "storage/format_v2.py",
+        "help": "logical (decoded-equivalent) bytes of segments loaded "
+                "since the last tick"},
+    "segment/load/compressedBytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "storage/format_v2.py",
+        "help": "on-disk bytes of segments loaded since the last tick "
+                "(ratio to segment/load/bytes = storage compression)"},
+    # ---- broker <-> data node wire (cluster/wire.py) -------------------
+    "query/wire/bytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "cluster/wire.py",
+        "help": "logical (raw little-endian) tensor bytes of partials "
+                "payloads serialized since the last tick"},
+    "query/wire/compressedBytes": {
+        "unit": "bytes/period", "dims": (),
+        "site": "cluster/wire.py",
+        "help": "tensor bytes actually emitted after per-tensor wire "
+                "compression (equals query/wire/bytes when peers do not "
+                "advertise wireCompress)"},
     # ---- coordination (coordination/latch.py) --------------------------
     "coordination/leader/transitions": {
         "unit": "count", "dims": ("service", "node", "event", "term",

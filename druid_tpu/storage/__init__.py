@@ -1,11 +1,15 @@
 from druid_tpu.storage.codec import (compress_array, decompress_array,
                                      default_codec, LZ4, NONE, ZLIB)
 from druid_tpu.storage.format import (load_segment, persist_segment,
-                                      read_segment_meta)
-from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
+                                      read_format_version, read_segment_meta)
+from druid_tpu.storage.format_v2 import (persist_segment_auto,
+                                         persist_segment_v2)
+from druid_tpu.storage.smoosh import (CorruptSegmentError, FileSmoosher,
+                                      SmooshedFileMapper)
 
 __all__ = [
     "compress_array", "decompress_array", "default_codec", "LZ4", "NONE",
-    "ZLIB", "load_segment", "persist_segment", "read_segment_meta",
-    "FileSmoosher", "SmooshedFileMapper",
+    "ZLIB", "load_segment", "persist_segment", "persist_segment_auto",
+    "persist_segment_v2", "read_format_version", "read_segment_meta",
+    "CorruptSegmentError", "FileSmoosher", "SmooshedFileMapper",
 ]

@@ -92,10 +92,12 @@ class LocalDeepStorage(DeepStorage):
         return os.path.join(self.base_dir, descriptor.datasource, safe)
 
     def push(self, segment, descriptor):
-        from druid_tpu.storage.format import persist_segment
+        # format V2 by default (DRUID_TPU_SEGMENT_FORMAT=1 pins V1): the
+        # pushed files keep their cascade form from disk to wire to HBM
+        from druid_tpu.storage.format_v2 import persist_segment_auto
         d = self._dir(descriptor)
         os.makedirs(d, exist_ok=True)
-        persist_segment(segment, d)
+        persist_segment_auto(segment, d)
         size = sum(os.path.getsize(os.path.join(d, f)) for f in os.listdir(d))
         return SegmentDescriptor(
             descriptor.datasource, descriptor.interval, descriptor.version,

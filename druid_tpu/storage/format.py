@@ -35,10 +35,26 @@ from druid_tpu.data.dictionary import Dictionary
 from druid_tpu.data.segment import (ComplexColumn, NumericColumn, Segment,
                                     SegmentId, StringDimColumn, ValueType)
 from druid_tpu.storage import codec as codecs
-from druid_tpu.storage.smoosh import FileSmoosher, SmooshedFileMapper
+from druid_tpu.storage.smoosh import (CorruptSegmentError, FileSmoosher,
+                                      SmooshedFileMapper)
 from druid_tpu.utils.intervals import Interval
 
 FORMAT_VERSION = 3  # v3: value-encoding byte in column parts (delta longs)
+FORMAT_VERSION_V2 = 4  # "segment format V2": cascade-form column parts
+
+
+def read_format_version(directory: str) -> int:
+    """The version.bin tag that routes load_segment between V1 (block-codec
+    columns, eager decode) and V2 (cascade-form parts, lazy columns)."""
+    path = os.path.join(directory, "version.bin")
+    if not os.path.exists(path):
+        raise CorruptSegmentError(directory, "missing version.bin")
+    with open(path, "rb") as f:
+        raw = f.read(4)
+    if len(raw) != 4:
+        raise CorruptSegmentError(directory, "truncated version.bin")
+    (version,) = struct.unpack("<I", raw)
+    return version
 
 
 def _encode_dictionary(d: Dictionary) -> bytes:
@@ -240,12 +256,21 @@ def load_segment(directory: str,
     native batch LZ4 (multi-threaded); bitmap indexes attach lazily.
 
     Reference analog: IndexIO.loadIndex (segment/IndexIO.java:116)."""
-    with open(os.path.join(directory, "version.bin"), "rb") as f:
-        (version,) = struct.unpack("<I", f.read(4))
+    version = read_format_version(directory)
+    if version == FORMAT_VERSION_V2:
+        from druid_tpu.storage.format_v2 import load_segment_v2
+        return load_segment_v2(directory, columns=columns)
     if version != FORMAT_VERSION:
-        raise ValueError(f"unknown segment format version {version}")
+        raise CorruptSegmentError(
+            directory, f"unknown segment format version {version}")
     mapper = SmooshedFileMapper(directory)
-    meta = json.loads(bytes(mapper.part("index.json")))
+    try:
+        meta = json.loads(bytes(mapper.part("index.json")))
+    except (ValueError, KeyError) as e:
+        if isinstance(e, CorruptSegmentError):
+            raise
+        raise CorruptSegmentError(directory, f"bad index.json: {e}",
+                                  part="index.json") from None
     seg_id = SegmentId(meta["datasource"],
                        Interval(meta["interval"][0], meta["interval"][1]),
                        meta["version"], meta["partition"])
@@ -282,5 +307,14 @@ def decompress_part(mapper: SmooshedFileMapper, name: str) -> np.ndarray:
 
 
 def read_segment_meta(directory: str) -> dict:
+    """index.json of a persisted segment — both V1 and V2 carry the same
+    identity/schema keys (V2 adds a "v2" section with the cascade
+    descriptors). Raises CorruptSegmentError on any structural damage."""
     with SmooshedFileMapper(directory) as mapper:
-        return json.loads(bytes(mapper.part("index.json")))
+        try:
+            return json.loads(bytes(mapper.part("index.json")))
+        except (ValueError, KeyError) as e:
+            if isinstance(e, CorruptSegmentError):
+                raise
+            raise CorruptSegmentError(directory, f"bad index.json: {e}",
+                                      part="index.json") from None

@@ -17,6 +17,20 @@ DEFAULT_CHUNK_SIZE = 1 << 31  # 2GB, like the reference's mmap limit
 META_FILE = "meta.smoosh"
 
 
+class CorruptSegmentError(ValueError):
+    """A segment directory failed structural validation: bad magic/version,
+    truncated chunk file, malformed meta line, or a part whose offsets fall
+    outside its chunk. Carries the directory and (when known) the part name
+    so historical load can log exactly what is broken and move on instead
+    of dying on a raw ValueError/struct.error traceback."""
+
+    def __init__(self, path: str, detail: str, part: Optional[str] = None):
+        self.path = path
+        self.part = part
+        where = f"{path}[{part}]" if part else path
+        super().__init__(f"corrupt segment {where}: {detail}")
+
+
 def _chunk_name(i: int) -> str:
     return f"chunk_{i:05d}.bin"
 
@@ -93,16 +107,36 @@ class SmooshedFileMapper:
     def __init__(self, directory: str):
         self.directory = directory
         self._parts: Dict[str, Tuple[int, int, int]] = {}
-        with open(os.path.join(directory, META_FILE)) as f:
+        meta_path = os.path.join(directory, META_FILE)
+        if not os.path.exists(meta_path):
+            raise CorruptSegmentError(directory, f"missing {META_FILE}")
+        with open(meta_path) as f:
             header = f.readline().strip().split(",")
-            if header[0] != "v1":
-                raise ValueError(f"unknown smoosh version {header[0]!r}")
-            n_chunks = int(header[2])
+            if len(header) != 3 or header[0] != "v1":
+                raise CorruptSegmentError(
+                    directory, f"bad smoosh header {','.join(header)!r}")
+            try:
+                n_chunks = int(header[2])
+            except ValueError:
+                raise CorruptSegmentError(
+                    directory, f"bad smoosh chunk count {header[2]!r}") \
+                    from None
             for line in f:
                 if not line.strip():
                     continue
-                name, chunk, start, end = line.rsplit(",", 3)
-                self._parts[name] = (int(chunk), int(start), int(end))
+                try:
+                    name, chunk, start, end = line.rsplit(",", 3)
+                    chunk, start, end = int(chunk), int(start), int(end)
+                except ValueError:
+                    raise CorruptSegmentError(
+                        directory,
+                        f"malformed meta line {line.strip()!r}") from None
+                if not (0 <= chunk < n_chunks and 0 <= start <= end):
+                    raise CorruptSegmentError(
+                        directory,
+                        f"part offsets out of range ({chunk},{start},{end})",
+                        part=name)
+                self._parts[name] = (chunk, start, end)
         self._maps: List[Optional[mmap.mmap]] = [None] * n_chunks
         self._files: List[Optional[object]] = [None] * n_chunks
 
@@ -113,12 +147,32 @@ class SmooshedFileMapper:
         return name in self._parts
 
     def part(self, name: str) -> memoryview:
+        if name not in self._parts:
+            raise CorruptSegmentError(self.directory, "part missing from "
+                                      f"{META_FILE}", part=name)
         chunk, start, end = self._parts[name]
         if self._maps[chunk] is None:
-            fh = open(os.path.join(self.directory, _chunk_name(chunk)), "rb")
+            path = os.path.join(self.directory, _chunk_name(chunk))
+            try:
+                fh = open(path, "rb")
+            except OSError as e:
+                raise CorruptSegmentError(
+                    self.directory, f"missing chunk file: {e}",
+                    part=name) from None
+            try:
+                m = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+            except (OSError, ValueError) as e:   # zero-length/unmappable
+                fh.close()
+                raise CorruptSegmentError(
+                    self.directory, f"unmappable chunk file {path}: {e}",
+                    part=name) from None
             self._files[chunk] = fh
-            self._maps[chunk] = mmap.mmap(fh.fileno(), 0,
-                                          access=mmap.ACCESS_READ)
+            self._maps[chunk] = m
+        if end > len(self._maps[chunk]):
+            raise CorruptSegmentError(
+                self.directory,
+                f"truncated chunk {chunk}: part needs bytes "
+                f"[{start},{end}) of {len(self._maps[chunk])}", part=name)
         return memoryview(self._maps[chunk])[start:end]
 
     def part_size(self, name: str) -> int:

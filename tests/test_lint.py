@@ -106,6 +106,11 @@ VIOLATIONS = {
         "import pickle\n"
         "def decode(b):\n"
         "    return pickle.loads(b)\n"),
+    "wire-decoded-rows": (
+        "druid_tpu/cluster/wire.py",
+        "import numpy as np\n"
+        "def enc(col):\n"
+        "    return np.asarray(col.values).tolist()\n"),
     "swallowed-exception": (
         "druid_tpu/cluster/anything.py",
         "def f():\n"
@@ -325,9 +330,9 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 
 
 def test_rule_registry_is_complete():
-    """All project rules (seven control-plane incl. metric-name + seven
-    tracecheck + four raceguard + five leakguard) plus the
-    unused-suppression audit are registered with severities."""
+    """All project rules (eight control-plane incl. metric-name and
+    wire-decoded-rows + seven tracecheck + four raceguard + five leakguard)
+    plus the unused-suppression audit are registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

@@ -243,6 +243,47 @@ def test_pickle_outside_wire_modules_ok():
         "import pickle\n", "druid_tpu/storage/format.py")
 
 
+# ---- wire-decoded-rows ----------------------------------------------------
+
+@pytest.mark.parametrize("src", [
+    "import numpy as np\ndef enc(col):\n    return np.asarray(col.values)\n",
+    "import numpy as np\ndef enc(col):\n    return np.asarray(col.ids)\n",
+    "def enc(col):\n    return col.values.tolist()\n",
+    "def enc(self, name):\n    return self.metrics[name].values.tolist()\n",
+])
+def test_wire_decoded_rows_flagged(src):
+    assert "wire-decoded-rows" in rules_hit(src, WIRE)
+
+
+def test_wire_decoded_rows_in_format_v2():
+    assert "wire-decoded-rows" in rules_hit(
+        "import numpy as np\ndef f(col):\n    return np.asarray(col.ids)\n",
+        "druid_tpu/storage/format_v2.py")
+
+
+def test_wire_decoded_rows_benign_asarray_ok():
+    src = """
+    import numpy as np
+    def enc(spec):
+        return np.asarray(spec.bucket_starts)
+    """
+    assert "wire-decoded-rows" not in rules_hit(src, WIRE)
+
+
+def test_wire_decoded_rows_outside_wire_modules_ok():
+    assert "wire-decoded-rows" not in rules_hit(
+        "import numpy as np\ndef f(col):\n    return np.asarray(col.values)\n",
+        "druid_tpu/storage/format.py")
+
+
+def test_wire_decoded_rows_suppressible():
+    src = ("import numpy as np\n"
+           "def compat(col):\n"
+           "    return np.asarray(col.values)"
+           "  # druidlint: disable=wire-decoded-rows\n")
+    assert "wire-decoded-rows" not in rules_hit(src, WIRE)
+
+
 # ---- swallowed-exception --------------------------------------------------
 
 def test_silent_pass_flagged():

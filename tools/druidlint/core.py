@@ -105,10 +105,12 @@ _DEFAULT_CONFIG = {
     # mutations must thread a fencing term
     "duty-modules": ["druid_tpu/cluster/coordinator.py",
                      "druid_tpu/indexing/overlord.py"],
-    # no-executable-deserialization: modules that face the wire
+    # no-executable-deserialization + wire-decoded-rows: modules that face
+    # the wire / carry the compressed data path end to end
     "wire-modules": ["druid_tpu/cluster/wire.py",
                      "druid_tpu/cluster/cache.py",
-                     "druid_tpu/server/*"],
+                     "druid_tpu/server/*",
+                     "druid_tpu/storage/format_v2.py"],
     # host-device-sync: modules whose traced functions are device code
     "device-modules": ["druid_tpu/engine/*", "druid_tpu/parallel/*"],
     # lock-scope: modules exempted because the lock EXISTS to serialize the

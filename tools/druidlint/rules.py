@@ -284,6 +284,46 @@ def check_no_executable_deserialization(ctx: ModuleContext
                       f"reduce hooks are pickle's code-execution vector")
 
 
+# ---- wire-decoded-rows ----------------------------------------------------
+
+_COLUMN_ATTRS = {"values", "ids"}
+
+
+def _is_column_chain(node: ast.AST) -> bool:
+    """True for attribute chains ending in a column-rows accessor
+    (`col.values`, `self.metrics[name].ids`, …)."""
+    return isinstance(node, ast.Attribute) and node.attr in _COLUMN_ATTRS
+
+
+@rule("wire-decoded-rows", "error",
+      "decoded column rows materialized in a compressed-path module")
+def check_wire_decoded_rows(ctx: ModuleContext) -> Iterable[Finding]:
+    """Modules on the compressed data path (config `wire-modules` — the
+    wire codec and the format-V2 loader) must not materialize decoded
+    column rows: `np.asarray(col.values)` / `col.ids.tolist()` silently
+    re-decodes what the cascade format exists to keep compressed, turning
+    a zero-copy path into a full-column host decode. Explicit V1-compat /
+    lazy-materialization paths carry an inline
+    `# druidlint: disable=wire-decoded-rows`."""
+    if not ctx.path_matches(ctx.config.wire_modules):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "asarray" \
+                and _terminal(func.value) in ("np", "numpy") \
+                and node.args and _is_column_chain(node.args[0]):
+            yield ctx.finding(
+                node, f"np.asarray({_dotted(node.args[0])}) materializes "
+                      f"decoded rows on the compressed path")
+        elif isinstance(func, ast.Attribute) and func.attr == "tolist" \
+                and _is_column_chain(func.value):
+            yield ctx.finding(
+                node, f"{_dotted(func.value)}.tolist() materializes "
+                      f"decoded rows on the compressed path")
+
+
 # ---- swallowed-exception --------------------------------------------------
 
 _BROAD_TYPES = {"Exception", "BaseException"}
