@@ -1,0 +1,12 @@
+"""Single-source runtime configuration surface.
+
+`druid_tpu.config.flags` is the catalog of every ``DRUID_TPU_*``
+environment flag the package reads. Code keeps reading flags wherever it
+needs them (a latch at import, a live probe in a version negotiation) —
+but every such read must name a flag declared here, and druidlint's
+`flag-name` rule enforces it the same way `metric-name` enforces the
+metrics catalog.
+"""
+from druid_tpu.config.flags import FLAGS, Flag, flags_table_markdown
+
+__all__ = ["FLAGS", "Flag", "flags_table_markdown"]

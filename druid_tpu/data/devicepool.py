@@ -31,7 +31,9 @@ from druid_tpu.utils.emitter import Monitor
 
 
 def _default_budget() -> int:
-    env = os.environ.get("DRUID_TPU_DEVICE_POOL_BYTES")
+    # capacity bound only: the budget sizes the pool and its eviction,
+    # it never reaches a traced program (catalog: live, no key_member)
+    env = os.environ.get("DRUID_TPU_DEVICE_POOL_BYTES")  # druidlint: disable=env-flag-latch
     if env:
         try:
             return int(env)

@@ -537,10 +537,13 @@ class SegmentBuilder:
                 metrics: Dict[str, float]):
         for name in dims:
             if name not in self._dim_values:
-                self._dim_values[name] = [NULL] * self._n
+                # null backfill for a newly-seen dim: _n is the shared
+                # row count, identical for every column by construction
+                self._dim_values[name] = [NULL] * self._n  # druidlint: disable=unkeyed-trace-input
         for name in metrics:
             if name not in self._metric_values:
-                self._metric_values[name] = [0] * self._n
+                # same backfill invariant as the dim columns above
+                self._metric_values[name] = [0] * self._n  # druidlint: disable=unkeyed-trace-input
                 self._metric_types.setdefault(
                     name, ValueType.LONG if isinstance(metrics[name], int)
                     else ValueType.DOUBLE)

@@ -502,7 +502,11 @@ def _run_batch(chunk: List[_Plan]) -> Optional[List[SegmentPartial]]:
         if fn is None:
             fn = _build_batched_fn(ref.spec, ref.kds, ref.filter_node,
                                    ref.kernels, ref.vc_plans, K)
-            _JIT_CACHE[sig] = fn
+            # kds STRUCTURE is a pure function of spec.dims plus the
+            # packs/cascades folded into sig; the per-segment id arrays
+            # inside kds enter the traced fn as runtime arguments, never
+            # as trace constants
+            _JIT_CACHE[sig] = fn  # druidlint: disable=unkeyed-trace-input
             while len(_JIT_CACHE) > _JIT_CACHE_CAP:
                 _JIT_CACHE.popitem(last=False)
         else:

@@ -472,7 +472,10 @@ def unify_query_dims(segs: Sequence[Segment], kds_per_seg,
                                    dtype=np.int32)
                 new_ids = remap[kd.host_ids]
                 slot.clear()
-                slot[udig] = new_ids
+                # the slot was fetched per (segment, kd.ids_key) via
+                # aux_cached, so segment/kd state is pinned per slot;
+                # udig keys the one free variable (the window's union)
+                slot[udig] = new_ids  # druidlint: disable=unkeyed-trace-input
             kds[j] = KeyDim(kd.column, max(len(union), 1), None,
                             host_ids=new_ids,
                             ids_key=("unidim",) + tuple(kd.ids_key)

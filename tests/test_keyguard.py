@@ -1,0 +1,657 @@
+"""keyguard unit battery: every cache-key soundness rule must fire on its
+positive shape, stay quiet on the keyed/pure/latched shapes, honor
+per-line suppressions, and the REAL tree must stay gated — deleting a
+descriptor from `_structure_sig`'s fold has to light the param-flow rule
+up. The dynamic keywitness machinery gets its own unit section.
+
+Pattern mirrors tests/test_leakguard.py: check_source with a root-less
+config analyzes each snippet standalone through the real rule registry,
+so suppression/baseline behavior is exactly the shipped one.
+"""
+import collections
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.druidlint.core import LintConfig, check_source  # noqa: E402
+from tools.druidlint.keywitness import (KeyWitness, RecordingCache,  # noqa: E402
+                                        _fp, fingerprint_args)
+
+
+def cfg(*rules) -> LintConfig:
+    c = LintConfig(rules=list(rules) if rules else [])
+    c.root = "/nonexistent-keyguard-root"
+    return c
+
+
+def findings_of(source: str, rule: str, path: str = "druid_tpu/mod.py",
+                config: LintConfig = None):
+    c = config if config is not None else cfg(rule)
+    return [f for f in check_source(source, path, c) if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# unkeyed-trace-input: build-on-miss cache sites
+# ---------------------------------------------------------------------------
+
+def test_unkeyed_build_input_fires():
+    src = """\
+_JIT_CACHE = {}
+
+def run(spec, extra):
+    sig = f"s={spec}"
+    fn = _JIT_CACHE.get(sig)
+    if fn is None:
+        fn = _build(spec, extra)
+        _JIT_CACHE[sig] = fn
+    return fn
+"""
+    got = findings_of(src, "unkeyed-trace-input")
+    assert len(got) == 1
+    assert "extra" in got[0].message
+    assert "no dataflow into the key" in got[0].message
+
+
+def test_fully_keyed_build_is_quiet():
+    src = """\
+_JIT_CACHE = {}
+
+def run(spec, extra):
+    sig = f"s={spec}|e={extra}"
+    fn = _JIT_CACHE.get(sig)
+    if fn is None:
+        fn = _build(spec, extra)
+        _JIT_CACHE[sig] = fn
+    return fn
+"""
+    assert findings_of(src, "unkeyed-trace-input") == []
+
+
+def test_unconditional_registry_store_is_quiet():
+    # a checked-then-raise registry is not a build-on-miss cache: the
+    # insert is not control-dependent on the miss probe
+    src = """\
+_REG = {}
+
+def register(name, obj, owner):
+    if name in _REG:
+        raise ValueError(name)
+    _REG[name] = _wrap(obj, owner)
+"""
+    assert findings_of(src, "unkeyed-trace-input") == []
+
+
+def test_per_call_dict_is_quiet():
+    src = """\
+def fold(rows, extra):
+    acc = {}
+    for r in rows:
+        k = r.key
+        got = acc.get(k)
+        if got is None:
+            acc[k] = _merge(r, extra)
+    return acc
+"""
+    assert findings_of(src, "unkeyed-trace-input") == []
+
+
+def test_setdefault_build_with_unkeyed_input_fires():
+    src = """\
+_HOOKS = {}
+
+def register(key, hook, ctx):
+    return _HOOKS.setdefault(key, _make_hook(hook, ctx))
+"""
+    got = findings_of(src, "unkeyed-trace-input")
+    assert len(got) == 1
+    assert "hook" in got[0].message and "ctx" in got[0].message
+
+
+def test_pool_get_or_build_lambda_inputs_must_be_keyed():
+    src = """\
+def stage(pool, owner, key, cols, layout):
+    return pool.get_or_build(owner, key, lambda: _build(cols, layout))
+"""
+    got = findings_of(src, "unkeyed-trace-input")
+    assert len(got) == 1
+    assert "cols" in got[0].message and "layout" in got[0].message
+
+
+def test_pool_get_or_build_keyed_lambda_is_quiet():
+    src = """\
+def stage(pool, owner, cols, layout):
+    key = (tuple(cols), layout)
+    return pool.get_or_build(owner, key, lambda: _build(cols, layout))
+"""
+    assert findings_of(src, "unkeyed-trace-input") == []
+
+
+def test_unkeyed_trace_input_suppression():
+    src = """\
+_JIT_CACHE = {}
+
+def run(spec, extra):
+    sig = f"s={spec}"
+    fn = _JIT_CACHE.get(sig)
+    if fn is None:
+        fn = _build(spec, extra)
+        _JIT_CACHE[sig] = fn  # druidlint: disable=unkeyed-trace-input
+    return fn
+"""
+    assert findings_of(src, "unkeyed-trace-input") == []
+
+
+# ---------------------------------------------------------------------------
+# unkeyed-trace-input: key-function param → return flow
+# ---------------------------------------------------------------------------
+
+def _key_fn_cfg(qual="make_sig"):
+    c = cfg("unkeyed-trace-input")
+    c.keyguard_key_fns = [f"druid_tpu/mod.py::{qual}"]
+    return c
+
+
+def test_key_fn_dropped_param_fires():
+    src = """\
+def make_sig(spec, packs, cascades):
+    return f"s={spec}|c={cascades}"
+"""
+    got = findings_of(src, "unkeyed-trace-input", config=_key_fn_cfg())
+    assert len(got) == 1
+    assert "'packs'" in got[0].message
+
+
+def test_key_fn_all_params_flow_is_quiet():
+    src = """\
+def make_sig(spec, packs, cascades):
+    parts = [f"s={spec}"]
+    parts.append(f"p={packs}")
+    return "|".join(parts) + f"|c={cascades}"
+"""
+    assert findings_of(src, "unkeyed-trace-input",
+                       config=_key_fn_cfg()) == []
+
+
+def test_key_fn_underscore_params_exempt():
+    src = """\
+def make_sig(spec, _debug):
+    return f"s={spec}"
+"""
+    assert findings_of(src, "unkeyed-trace-input",
+                       config=_key_fn_cfg()) == []
+
+
+def test_real_structure_sig_mutation_is_caught():
+    """The acceptance gate: delete the pack descriptor from the REAL
+    `_structure_sig`'s fold and keyguard must notice — with the stock
+    source staying clean under the same config."""
+    path = "druid_tpu/engine/grouping.py"
+    src = (REPO_ROOT / path).read_text()
+    assert 'f"packs={packs}",' in src
+    mutated = src.replace('f"packs={packs}",', "")
+    c = cfg("unkeyed-trace-input")
+    c.keyguard_key_fns = [f"{path}::_structure_sig"]
+    got = findings_of(mutated, "unkeyed-trace-input", path=path, config=c)
+    assert any("'packs'" in f.message and "_structure_sig" in f.message
+               for f in got)
+    c2 = cfg("unkeyed-trace-input")
+    c2.keyguard_key_fns = [f"{path}::_structure_sig"]
+    assert findings_of(src, "unkeyed-trace-input", path=path,
+                       config=c2) == []
+
+
+# ---------------------------------------------------------------------------
+# impure-eligibility
+# ---------------------------------------------------------------------------
+
+def _elig_cfg(qual="eligible"):
+    c = cfg("impure-eligibility")
+    c.keyguard_eligibility = [f"druid_tpu/mod.py::{qual}"]
+    return c
+
+
+def test_env_read_in_eligibility_fires():
+    src = """\
+import os
+
+def eligible(col):
+    if os.environ.get("DRUID_TPU_FAST") == "1":
+        return True
+    return col.cardinality < 1000
+"""
+    got = findings_of(src, "impure-eligibility", config=_elig_cfg())
+    assert len(got) == 1
+    assert "os.environ" in got[0].message
+
+
+def test_clock_read_via_same_module_callee_fires():
+    src = """\
+import time
+
+def _warm():
+    return time.monotonic() > 100.0
+
+def eligible(col):
+    return _warm() and col.cardinality < 1000
+"""
+    got = findings_of(src, "impure-eligibility", config=_elig_cfg())
+    assert len(got) == 1
+    assert "time.monotonic" in got[0].message
+    assert "via _warm" in got[0].message
+
+
+def test_pure_eligibility_is_quiet():
+    src = """\
+def eligible(col, spec):
+    return col.cardinality < 1000 and len(spec.dims) <= 4
+"""
+    assert findings_of(src, "impure-eligibility", config=_elig_cfg()) == []
+
+
+def test_unconfigured_function_is_quiet():
+    src = """\
+import os
+
+def helper(col):
+    return os.environ.get("DRUID_TPU_FAST") == "1"
+"""
+    assert findings_of(src, "impure-eligibility", config=_elig_cfg()) == []
+
+
+def test_impure_eligibility_suppression():
+    src = """\
+import os
+
+def eligible(col):
+    return os.environ.get("DRUID_TPU_FAST") == "1"  # druidlint: disable=impure-eligibility
+"""
+    assert findings_of(src, "impure-eligibility", config=_elig_cfg()) == []
+
+
+# ---------------------------------------------------------------------------
+# env-flag-latch (against a synthetic on-disk catalog)
+# ---------------------------------------------------------------------------
+
+_CATALOG_SRC = """\
+class Flag:
+    def __init__(self, default="", semantics="latch", doc="",
+                 key_member=False):
+        pass
+
+FLAGS = {
+    "DRUID_TPU_LATCHED": Flag(default="", semantics="latch", doc="x"),
+    "DRUID_TPU_LIVE_KEYED": Flag(default="", semantics="live", doc="x",
+                                 key_member=True),
+    "DRUID_TPU_LIVE_UNKEYED": Flag(default="", semantics="live", doc="x"),
+}
+"""
+
+
+def _latch_cfg(tmp_path, *extra_rules):
+    (tmp_path / "flags.py").write_text(_CATALOG_SRC)
+    c = cfg("env-flag-latch", *extra_rules)
+    c.root = str(tmp_path)
+    c.flags_catalog = "flags.py"
+    c.keyguard_plan_modules = ["druid_tpu/*"]
+    return c
+
+
+def test_latch_flag_read_in_function_fires(tmp_path):
+    src = """\
+import os
+
+def plan(col):
+    return os.environ.get("DRUID_TPU_LATCHED") == "1"
+"""
+    got = findings_of(src, "env-flag-latch", config=_latch_cfg(tmp_path))
+    assert len(got) == 1
+    assert "declared 'latch' but read inside plan()" in got[0].message
+
+
+def test_latch_flag_read_at_import_is_quiet(tmp_path):
+    src = """\
+import os
+
+_FAST = os.environ.get("DRUID_TPU_LATCHED") == "1"
+
+def plan(col):
+    return _FAST
+"""
+    assert findings_of(src, "env-flag-latch",
+                       config=_latch_cfg(tmp_path)) == []
+
+
+def test_live_unkeyed_flag_read_in_function_fires(tmp_path):
+    src = """\
+import os
+
+def plan(col):
+    return os.environ.get("DRUID_TPU_LIVE_UNKEYED") == "1"
+"""
+    got = findings_of(src, "env-flag-latch", config=_latch_cfg(tmp_path))
+    assert len(got) == 1
+    assert "not a declared key member" in got[0].message
+
+
+def test_live_key_member_read_in_function_is_quiet(tmp_path):
+    src = """\
+import os
+
+def plan(col):
+    return os.environ.get("DRUID_TPU_LIVE_KEYED") == "1"
+"""
+    assert findings_of(src, "env-flag-latch",
+                       config=_latch_cfg(tmp_path)) == []
+
+
+def test_live_flag_read_at_import_fires(tmp_path):
+    src = """\
+import os
+
+_V = os.environ.get("DRUID_TPU_LIVE_KEYED")
+"""
+    got = findings_of(src, "env-flag-latch", config=_latch_cfg(tmp_path))
+    assert len(got) == 1
+    assert "read at import time" in got[0].message
+
+
+def test_module_outside_plan_scope_is_quiet(tmp_path):
+    src = """\
+import os
+
+def plan(col):
+    return os.environ.get("DRUID_TPU_LATCHED") == "1"
+"""
+    c = _latch_cfg(tmp_path)
+    c.keyguard_plan_modules = ["druid_tpu/engine/*"]
+    assert findings_of(src, "env-flag-latch", path="druid_tpu/mod.py",
+                       config=c) == []
+
+
+# ---------------------------------------------------------------------------
+# flag-name (undeclared DRUID_TPU_* reads)
+# ---------------------------------------------------------------------------
+
+def _flag_name_cfg(tmp_path):
+    (tmp_path / "flags.py").write_text(_CATALOG_SRC)
+    c = cfg("flag-name")
+    c.root = str(tmp_path)
+    c.flags_catalog = "flags.py"
+    c.flag_modules = ["druid_tpu/*"]
+    return c
+
+
+def test_undeclared_flag_read_fires(tmp_path):
+    src = """\
+import os
+
+_V = os.environ.get("DRUID_TPU_NO_SUCH_FLAG")
+"""
+    got = findings_of(src, "flag-name", config=_flag_name_cfg(tmp_path))
+    assert len(got) == 1
+    assert "DRUID_TPU_NO_SUCH_FLAG" in got[0].message
+    assert "not declared" in got[0].message
+
+
+def test_declared_flag_read_is_quiet(tmp_path):
+    src = """\
+import os
+
+_V = os.environ.get("DRUID_TPU_LATCHED")
+"""
+    assert findings_of(src, "flag-name",
+                       config=_flag_name_cfg(tmp_path)) == []
+
+
+def test_catalog_file_itself_is_exempt(tmp_path):
+    c = _flag_name_cfg(tmp_path)
+    c.flag_modules = ["*"]
+    src = """\
+import os
+
+_V = os.environ.get("DRUID_TPU_NO_SUCH_FLAG")
+"""
+    assert findings_of(src, "flag-name", path="flags.py", config=c) == []
+
+
+def test_real_catalog_covers_every_tree_read():
+    """Every DRUID_TPU_* read in the real tree is declared — the shipped
+    config's flag-name burn stays clean (CLI equivalent lives in
+    test_lint.py; this pins the catalog/tree agreement directly)."""
+    from tools.druidlint.keyguard import flag_catalog
+    from tools.druidlint.core import load_config
+    c = load_config(REPO_ROOT)
+    catalog = flag_catalog(str(REPO_ROOT), c.flags_catalog)
+    assert len(catalog) >= 10
+    import re
+    pat = re.compile(r"DRUID_TPU_[A-Z0-9_]+")
+    read = set()
+    for p in (REPO_ROOT / "druid_tpu").rglob("*.py"):
+        read |= set(pat.findall(p.read_text()))
+    assert read <= set(catalog), f"undeclared flags: {read - set(catalog)}"
+
+
+def test_readme_flags_table_in_sync():
+    from druid_tpu.config.flags import flags_table_markdown
+    readme = (REPO_ROOT / "README.md").read_text()
+    assert flags_table_markdown() in readme, (
+        "README flags table is stale — regenerate it with "
+        "druid_tpu.config.flags.flags_table_markdown()")
+
+
+# ---------------------------------------------------------------------------
+# keywitness: fingerprints, collision detection, install/uninstall
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_is_structural_not_data():
+    a = np.zeros(64, np.int64)
+    b = np.arange(128, dtype=np.int64)      # different data AND length
+    assert _fp(a, shapes=False) == _fp(b, shapes=False) == "arr(int64,1)"
+    assert _fp(a, shapes=True) != _fp(b, shapes=True)
+
+
+def test_fingerprint_canonicalizes_dicts_and_objects():
+    # insertion order is canonicalized away; scalar VALUES stay (a build
+    # arg like K or n_intervals is structure)
+    assert _fp({"b": 1, "a": 2}, shapes=False) \
+        == _fp({"a": 2, "b": 1}, shapes=False)
+    assert _fp({"a": 1}, shapes=False) != _fp({"a": 2}, shapes=False)
+
+    class Spec:
+        def __init__(self, n):
+            self.dims = ["d"] * n
+            self.mode = "hash"
+
+    assert _fp(Spec(1), shapes=False) == _fp(Spec(1), shapes=False)
+    # structure (list arity) differs → fingerprints differ
+    assert _fp(Spec(1), shapes=False) != _fp(Spec(2), shapes=False)
+    # no raw addresses ever leak into a fingerprint
+    assert " at 0x" not in fingerprint_args(Spec(1), object())
+
+
+def test_fingerprint_excludes_presentation_and_aux_fields():
+    """Output-column names are host-side presentation and uniform bucket
+    scalars ride aux as runtime arrays — one compiled program serving
+    both sides of each pair is the engine design, not a collision."""
+    class GroupSpec:                # matches the _FP_EXCLUDE registry row
+        def __init__(self, off):
+            self.bucket_mode = "uniform"
+            self.uniform_first_offset = off
+            self.uniform_period = 86400000
+
+    assert _fp(GroupSpec(0), shapes=False) \
+        == _fp(GroupSpec(-86400000), shapes=False)
+
+    class Kern:
+        def __init__(self, name, field):
+            self.name = name
+            self.field = field
+
+    # the output label is excluded everywhere...
+    assert _fp(Kern("ls", "metLong"), shapes=False) \
+        == _fp(Kern("sumLong", "metLong"), shapes=False)
+    # ...but input-SELECTING fields stay structural
+    assert _fp(Kern("s", "metLong"), shapes=False) \
+        != _fp(Kern("s", "metDouble"), shapes=False)
+
+
+def test_fingerprint_canonicalizes_sequences_and_enums():
+    import enum as enum_mod
+
+    # list vs tuple cannot shape a built program (closure iteration,
+    # never pytree leaves) — fingerprint them identically
+    assert _fp([1, "x"], shapes=False) == _fp((1, "x"), shapes=False)
+
+    class Mode(enum_mod.Enum):
+        LONG = 3
+
+    # enums print as type.member, never recursing into EnumMeta
+    assert _fp(Mode.LONG, shapes=False) == "Mode.LONG"
+
+
+def test_handback_prime_does_not_claim_parked_fingerprint():
+    """The nested-witness hand-back re-inserts warm keys; a dangling
+    parked fingerprint (an inner-span build both wrappers saw but only
+    the inner cache recorded) must NOT be claimed by those re-inserts —
+    that mis-attributes one build's structure to an unrelated key."""
+    w = KeyWitness(str(REPO_ROOT))
+    cache = RecordingCache(w, "c")
+    w._park_pending("c", "fpA")
+    cache["k1"] = "v1"                       # real insert claims fpA
+    w._park_pending("c", "fpB-from-inner-span")   # left dangling
+    cache._prime([("k1", "v1")])             # hand-back iteration
+    assert w.collisions == []
+    assert w._take_pending("c") == "fpB-from-inner-span"
+
+
+def test_same_key_same_fingerprint_is_not_a_collision():
+    w = KeyWitness(str(REPO_ROOT))
+    w.record("c", ("k",), "fp1")
+    w.record("c", ("k",), "fp1")
+    w.record("c", ("other",), "fp2")
+    assert w.collisions == []
+
+
+def test_same_key_different_fingerprint_is_a_collision():
+    w = KeyWitness(str(REPO_ROOT))
+    w.record("c", ("k",), "fp1")
+    w.record("c", ("k",), "fp2")
+    assert len(w.collisions) == 1
+    assert "different input structure" in w.collisions[0]
+
+
+def test_fingerprint_table_outlives_eviction():
+    """key→structure is a time-invariant contract: a key rebuilt after
+    cache eviction must reproduce its FIRST build's fingerprint."""
+    w = KeyWitness(str(REPO_ROOT))
+    cache = RecordingCache(w, "c")
+    w._park_pending("c", "fp1")
+    cache["k"] = object()
+    del cache["k"]                           # evicted
+    w._park_pending("c", "fp2")              # rebuild, different structure
+    cache["k"] = object()
+    assert len(w.collisions) == 1
+
+
+def test_install_uninstall_restores_engine_globals():
+    import druid_tpu.engine.grouping as grouping
+    orig_builder = grouping._build_device_fn
+    orig_cache_type = type(grouping._JIT_CACHE)
+    w = KeyWitness(str(REPO_ROOT)).install()
+    try:
+        assert grouping._build_device_fn is not orig_builder
+        assert isinstance(grouping._JIT_CACHE, RecordingCache)
+        assert grouping._JIT_CACHE._witness is w
+    finally:
+        w.uninstall()
+    assert grouping._build_device_fn is orig_builder
+    # restores the pre-install cache type — the session-wide witness's
+    # RecordingCache when DRUID_TPU_KEY_WITNESS=1, a plain dict otherwise
+    assert type(grouping._JIT_CACHE) is orig_cache_type
+    if isinstance(grouping._JIT_CACHE, RecordingCache):
+        assert grouping._JIT_CACHE._witness is not w
+    assert issubclass(orig_cache_type, dict)
+
+
+def test_uninstall_preserves_warm_entries():
+    import druid_tpu.engine.grouping as grouping
+    w = KeyWitness(str(REPO_ROOT)).install()
+    try:
+        grouping._JIT_CACHE["warm-key"] = "warm-value"
+    finally:
+        w.uninstall()
+    try:
+        assert grouping._JIT_CACHE.get("warm-key") == "warm-value"
+    finally:
+        grouping._JIT_CACHE.pop("warm-key", None)
+
+
+def test_install_over_warm_cache():
+    """Mid-suite installs see already-populated jit caches; wrapping must
+    carry the warm entries into the RecordingCache without recording them
+    as builds (OrderedDict.__init__ routes through __setitem__)."""
+    import druid_tpu.engine.grouping as grouping
+    grouping._JIT_CACHE["pre-warm"] = "pre-value"
+    try:
+        w = KeyWitness(str(REPO_ROOT)).install()
+        try:
+            assert grouping._JIT_CACHE.get("pre-warm") == "pre-value"
+            assert w.collisions == []
+            assert not any(c.get("build") for c in w.counts.values())
+        finally:
+            w.uninstall()
+        assert grouping._JIT_CACHE.get("pre-warm") == "pre-value"
+    finally:
+        grouping._JIT_CACHE.pop("pre-warm", None)
+
+
+def test_nested_witness_hands_back_to_outer():
+    """A per-test witness inside the session-wide one must restore the
+    OUTER witness's recording cache on uninstall, entries intact."""
+    import druid_tpu.engine.grouping as grouping
+    outer = KeyWitness(str(REPO_ROOT)).install()
+    try:
+        inner = KeyWitness(str(REPO_ROOT)).install()
+        grouping._JIT_CACHE["nested-key"] = "v"
+        inner.uninstall()
+        assert isinstance(grouping._JIT_CACHE, RecordingCache)
+        assert grouping._JIT_CACHE._witness is outer
+        assert collections.OrderedDict.get(
+            grouping._JIT_CACHE, "nested-key") == "v"
+    finally:
+        outer.uninstall()
+    # fully unwound from THIS test's witnesses (under the session-wide
+    # witness the cache legitimately remains its RecordingCache)
+    if isinstance(grouping._JIT_CACHE, RecordingCache):
+        assert grouping._JIT_CACHE._witness is not outer
+    grouping._JIT_CACHE.pop("nested-key", None)
+
+
+def test_pool_recording_scoped_to_install_time_singleton():
+    """Only the production pool singleton is witnessed: isolated test
+    pools deliberately churn toy keys (eviction/accounting tests) and
+    must not register collisions."""
+    from druid_tpu.data import devicepool
+
+    class Owner:                             # weakref-able owner stand-in
+        pass
+
+    keep = [Owner(), Owner()]                # alive across the accesses
+    w = KeyWitness(str(REPO_ROOT)).install()
+    try:
+        side = devicepool.DeviceSegmentPool(budget_bytes=1 << 30)
+        tok = side.register_owner(keep[0])
+        side.get_or_build(tok, ("k",), lambda: np.zeros(8, np.int64))
+        assert w.fingerprints == {}          # side pool: unrecorded
+        prod_tok = w._prod_pool.register_owner(keep[1])
+        w._prod_pool.get_or_build(
+            prod_tok, ("kw-test",), lambda: np.zeros(8, np.int64))
+        assert any(label == "devicepool.get_or_build"
+                   for label, _ in w.fingerprints)
+    finally:
+        w.uninstall()

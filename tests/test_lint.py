@@ -24,8 +24,8 @@ from tools.druidlint.core import split_by_baseline  # noqa: E402
 
 def test_tree_is_clean_and_fast():
     """`python -m tools.druidlint --all --fail-on-new` — the UNIFIED gate:
-    all four analyzer families (druidlint/tracecheck/raceguard/leakguard)
-    in one process over the shared program/cache pass — exits 0 on the
+    all five analyzer families (druidlint/tracecheck/raceguard/leakguard/
+    keyguard) in one process over the shared program/cache pass — exits 0 on the
     shipped tree under a single wall-clock budget. The first run may be
     cold (fresh checkout: no .druidlint-cache.json — the whole-program
     index alone costs several seconds); the budget is enforced on the
@@ -44,11 +44,12 @@ def test_tree_is_clean_and_fast():
     assert proc.returncode == 0, (
         f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
     assert elapsed < 10.0, (
-        f"unified gate took {elapsed:.1f}s (budget 10s for all four "
+        f"unified gate took {elapsed:.1f}s (budget 10s for all five "
         f"families together)")
     payload = json.loads(proc.stdout)
     assert set(payload["families"]) == {"druidlint", "tracecheck",
-                                        "raceguard", "leakguard"}
+                                        "raceguard", "leakguard",
+                                        "keyguard"}
     for name, info in payload["families"].items():
         assert info["rules"] > 0, f"family {name} registered no rules"
         assert info["findings"] == 0
@@ -304,16 +305,55 @@ VIOLATIONS = {
         "        pass\n"
         "    def stop(self):\n"
         "        pass\n"),
+    # ---- keyguard rules (entries may list EXTRA files: the env-flag
+    # rules read the on-disk flags catalog next to the violating module)
+    "unkeyed-trace-input": (
+        "druid_tpu/engine/cachey.py",
+        "_JIT_CACHE = {}\n"
+        "def run(spec, extra):\n"
+        "    sig = f's={spec}'\n"
+        "    fn = _JIT_CACHE.get(sig)\n"
+        "    if fn is None:\n"
+        "        fn = _build(spec, extra)\n"
+        "        _JIT_CACHE[sig] = fn\n"
+        "    return fn\n"),
+    "impure-eligibility": (
+        # the default config pins standing.py::check_eligible
+        "druid_tpu/engine/standing.py",
+        "import os\n"
+        "def check_eligible(query):\n"
+        "    return os.environ.get('DRUID_TPU_STANDING') != '0'\n"),
+    "env-flag-latch": (
+        "druid_tpu/engine/flaggy.py",
+        "import os\n"
+        "def plan(col):\n"
+        "    return os.environ.get('DRUID_TPU_LATCHY') == '1'\n",
+        ("druid_tpu/config/flags.py",
+         "class Flag:\n"
+         "    def __init__(self, default='', semantics='latch', doc='',\n"
+         "                 key_member=False):\n"
+         "        pass\n"
+         "FLAGS = {\n"
+         "    'DRUID_TPU_LATCHY': Flag(default='', semantics='latch',\n"
+         "                             doc='x'),\n"
+         "}\n")),
+    "flag-name": (
+        # no catalog file in the synthetic root: every read is undeclared
+        "druid_tpu/engine/flaggy.py",
+        "import os\n"
+        "def plan(col):\n"
+        "    return os.environ.get('DRUID_TPU_NO_SUCH_FLAG') == '1'\n"),
 }
 
 
 @pytest.mark.parametrize("rule_name", sorted(VIOLATIONS))
 def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
     """Introducing a violation of each rule makes the CLI exit non-zero."""
-    rel, source = VIOLATIONS[rule_name]
-    target = tmp_path / rel
-    target.parent.mkdir(parents=True, exist_ok=True)
-    target.write_text(source)
+    rel, source, *extra = VIOLATIONS[rule_name]
+    for erel, esrc in ((rel, source), *extra):
+        target = tmp_path / erel
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(esrc)
     empty_baseline = tmp_path / "baseline.json"
     empty_baseline.write_text(json.dumps({"version": 1, "findings": []}))
     proc = subprocess.run(
@@ -330,9 +370,10 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 
 
 def test_rule_registry_is_complete():
-    """All project rules (eight control-plane incl. metric-name and
-    wire-decoded-rows + seven tracecheck + four raceguard + five leakguard)
-    plus the unused-suppression audit are registered with severities."""
+    """All project rules (nine control-plane incl. metric-name,
+    wire-decoded-rows and flag-name + seven tracecheck + four raceguard
+    + five leakguard + three keyguard) plus the unused-suppression audit
+    are registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

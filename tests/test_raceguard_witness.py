@@ -13,6 +13,7 @@ instance locks are wrapped at construction time; module-level locks
 imported earlier in the session stay raw (the subgraph assertion is over
 whatever was observed, so unwrapped locks only shrink the sample, never
 falsify it)."""
+import os
 import sys
 import threading
 import time
@@ -24,6 +25,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 from tools.druidlint.core import load_config  # noqa: E402
+from tools.druidlint.keywitness import KeyWitness  # noqa: E402
 from tools.druidlint.lockwitness import LockWitness, WitnessLock  # noqa: E402
 from tools.druidlint.raceguard import analyze_tree  # noqa: E402
 
@@ -241,6 +243,7 @@ def stress_run():
     # module-level locks (jit caches, native registry) predate this
     # install — re-wrap them so the sweep sees the compile-cache edges
     witness.rewrap_module_locks()
+    key_witness = None
     try:
         from druid_tpu.cluster.broker import Broker
         from druid_tpu.cluster.view import (DataNode, InventoryView,
@@ -265,6 +268,13 @@ def stress_run():
         assert isinstance(pool._lock, WitnessLock)
         witness.watch(pool, ("_resident", "_hits", "_misses", "_evictions",
                              "_evicted_bytes", "_budget"), pool._lock)
+
+        # the key-churn leg: a per-test KeyWitness rides the same stress.
+        # Installed AFTER the pool swap above so it binds the stress pool
+        # as its witnessed singleton (real segment keys flow through it;
+        # the tiny budget forces evict→rebuild, which must reproduce each
+        # key's first structural fingerprint).
+        key_witness = KeyWitness(str(REPO_ROOT)).install()
 
         gen = DataGenerator((ColumnSpec("d", "string", cardinality=5),
                              ColumnSpec("m", "long", low=0, high=10)),
@@ -383,6 +393,41 @@ def stress_run():
             except Exception as e:          # pragma: no cover - must not
                 errors.append(e)
 
+        def key_churn(rounds):
+            # keyguard's dynamic leg: descriptor variety (each agg combo
+            # is its own structure sig) plus live key-member flag flips —
+            # DRUID_TPU_PALLAS shifts select_strategy, and the selected
+            # strategy is folded into _structure_sig, so a flip must mint
+            # NEW jit-cache keys, never alias builds under old ones
+            try:
+                variants = []
+                for aggs in (
+                        [{"type": "longSum", "name": "s",
+                          "fieldName": "m"}],
+                        [{"type": "doubleSum", "name": "s",
+                          "fieldName": "m"}],
+                        [{"type": "count", "name": "c"},
+                         {"type": "longMax", "name": "x",
+                          "fieldName": "m"}]):
+                    variants.append(dict(group_q, aggregations=aggs))
+                    variants.append(dict(ts_q, aggregations=aggs))
+                prev = os.environ.get("DRUID_TPU_PALLAS")
+                try:
+                    for i in range(rounds):
+                        if i % 2:
+                            os.environ["DRUID_TPU_PALLAS"] = "interpret"
+                        else:
+                            os.environ.pop("DRUID_TPU_PALLAS", None)
+                        for q in variants:
+                            broker.run_json(q)
+                finally:
+                    if prev is None:
+                        os.environ.pop("DRUID_TPU_PALLAS", None)
+                    else:
+                        os.environ["DRUID_TPU_PALLAS"] = prev
+            except Exception as e:          # pragma: no cover - must not
+                errors.append(e)
+
         def churn_loop():
             # segment churn: dropped generations GC while queries run,
             # driving the finalizer path concurrently with eviction
@@ -405,27 +450,34 @@ def stress_run():
                    threading.Thread(target=sched_loop, args=(6,)),
                    threading.Thread(target=subscribe_loop, args=(4,)),
                    threading.Thread(target=subscribe_loop, args=(4,)),
+                   threading.Thread(target=key_churn, args=(2,)),
                    threading.Thread(target=tick_loop, daemon=True),
                    threading.Thread(target=ingest_loop, daemon=True),
                    threading.Thread(target=churn_loop, daemon=True)]
         for t in workers:
             t.start()
-        for t in workers[:8]:
+        for t in workers[:9]:
             t.join(timeout=300)
         stop.set()
         scheduler.stop()
         hub.stop()
-        for t in workers[8:]:
+        for t in workers[9:]:
             t.join(timeout=10)
 
-        yield witness, errors, pool, emitter
+        yield witness, errors, pool, emitter, key_witness
         dp_mod._POOL = old_pool
     finally:
-        witness.uninstall()
+        # inner-out: the key witness was installed after (and may wrap)
+        # the session-wide one's hooks; restore before the lock witness
+        try:
+            if key_witness is not None:
+                key_witness.uninstall()
+        finally:
+            witness.uninstall()
 
 
 def test_stress_completes_without_errors(stress_run):
-    witness, errors, pool, emitter = stress_run
+    witness, errors, pool, emitter, _ = stress_run
     assert errors == []
     s = pool.snapshot()
     assert s.hits + s.misses > 0, "the pool was never exercised"
@@ -453,6 +505,16 @@ def test_stress_no_unguarded_pool_mutation(stress_run):
 
 
 def test_stress_emitted_pool_metrics(stress_run):
-    *_, emitter = stress_run
+    emitter = stress_run[3]
     names = {e.metric for e in emitter.sink.events}
     assert "segment/devicePool/residentBytes" in names
+
+
+def test_stress_key_witness_no_collisions(stress_run):
+    """The key-churn leg: descriptor variety + live-flag flips churned
+    the jit caches while eviction churn forced pool rebuilds — every
+    same-key build must reproduce its first structural fingerprint."""
+    *_, kw = stress_run
+    assert kw.collisions == []
+    builds = sum(c.get("build", 0) for c in kw.counts.values())
+    assert builds > 0, "the key churn never drove a witnessed cache build"

@@ -19,10 +19,11 @@ from tools.druidlint.core import (family_of, lint_paths, load_baseline,
                                   load_config, registered_rules,
                                   save_baseline, split_by_baseline)
 
-#: the four analyzer families --all asserts are all registered and runs in
+#: the five analyzer families --all asserts are all registered and runs in
 #: ONE process over ONE shared program/cache pass (tier-1 used to pay the
 #: whole-program index once per analyzer CLI invocation)
-_ALL_FAMILIES = ("druidlint", "tracecheck", "raceguard", "leakguard")
+_ALL_FAMILIES = ("druidlint", "tracecheck", "raceguard", "leakguard",
+                 "keyguard")
 
 
 def main(argv=None) -> int:
@@ -50,10 +51,11 @@ def main(argv=None) -> int:
                     help="print the raceguard lock-order graph as graphviz "
                          "DOT (cycle members red) and exit")
     ap.add_argument("--all", action="store_true", dest="all_families",
-                    help="unified gate: assert all four analyzer families "
-                         "(druidlint/tracecheck/raceguard/leakguard) are "
-                         "registered, run them in one process over the "
-                         "shared caches, and report findings per family")
+                    help="unified gate: assert all five analyzer families "
+                         "(druidlint/tracecheck/raceguard/leakguard/"
+                         "keyguard) are registered, run them in one process "
+                         "over the shared caches, and report findings per "
+                         "family")
     args = ap.parse_args(argv)
 
     if args.all_families and args.only:

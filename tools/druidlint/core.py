@@ -80,13 +80,15 @@ def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import tracecheck as _tracecheck  # noqa: F401
     from tools.druidlint import raceguard as _raceguard  # noqa: F401
     from tools.druidlint import leakguard as _leakguard  # noqa: F401
+    from tools.druidlint import keyguard as _keyguard  # noqa: F401
     return dict(_RULES)
 
 
 #: analyzer family of a rule, derived from the registering module — the
 #: unified `--all` runner groups findings and timings by this
 _FAMILIES = {"rules": "druidlint", "tracecheck": "tracecheck",
-             "raceguard": "raceguard", "leakguard": "leakguard"}
+             "raceguard": "raceguard", "leakguard": "leakguard",
+             "keyguard": "keyguard"}
 
 
 def family_of(r: Rule) -> str:
@@ -148,6 +150,32 @@ _DEFAULT_CONFIG = {
     "metric-modules": ["druid_tpu/*"],
     # metric-name: the single-source metrics catalog (METRICS dict literal)
     "metrics-catalog": "druid_tpu/obs/catalog.py",
+    # flag-name: modules whose literal DRUID_TPU_* env reads must name a
+    # flag declared in the flags catalog
+    "flag-modules": ["druid_tpu/*"],
+    # flag-name + keyguard env-flag-latch: the single-source flags
+    # catalog (FLAGS dict literal of Flag(...) declarations)
+    "flags-catalog": "druid_tpu/config/flags.py",
+    # keyguard env-flag-latch: plan/build modules where a DRUID_TPU_*
+    # read must match its declared latch/live semantics
+    "keyguard-plan-modules": ["druid_tpu/engine/*", "druid_tpu/data/*",
+                              "druid_tpu/parallel/*"],
+    # keyguard unkeyed-trace-input: canonical key-derivation functions
+    # ("path::qual"); every parameter must flow into the returned key
+    "keyguard-key-fns": ["druid_tpu/engine/grouping.py::_structure_sig",
+                         "druid_tpu/parallel/distributed.py::_sharded_sig",
+                         "druid_tpu/engine/filters.py::bitmap_pool_key",
+                         "druid_tpu/cluster/cache.py::query_cache_key",
+                         "druid_tpu/cluster/cache.py::result_level_key",
+                         "druid_tpu/data/cascade.py::plan_pair"],
+    # keyguard impure-eligibility: eligibility/planning predicates
+    # ("path::qual") that must stay pure functions of descriptors
+    "keyguard-eligibility": ["druid_tpu/engine/standing.py::check_eligible",
+                             "druid_tpu/data/cascade.py::plan_columns",
+                             "druid_tpu/data/cascade.py::plan_pair",
+                             "druid_tpu/data/cascade.py::run_domain_probe",
+                             "druid_tpu/data/packed.py::plan_columns",
+                             "druid_tpu/cluster/view.py::*.fusable"],
     # unused-suppression audit (CLI --report-unused-suppressions)
     "report-unused-suppressions": False,
 }
@@ -188,6 +216,17 @@ class LintConfig:
     metric_modules: List[str] = field(
         default_factory=lambda: list(_DEFAULT_CONFIG["metric-modules"]))
     metrics_catalog: str = _DEFAULT_CONFIG["metrics-catalog"]
+    flag_modules: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["flag-modules"]))
+    flags_catalog: str = _DEFAULT_CONFIG["flags-catalog"]
+    keyguard_plan_modules: List[str] = field(
+        default_factory=lambda: list(
+            _DEFAULT_CONFIG["keyguard-plan-modules"]))
+    keyguard_key_fns: List[str] = field(
+        default_factory=lambda: list(_DEFAULT_CONFIG["keyguard-key-fns"]))
+    keyguard_eligibility: List[str] = field(
+        default_factory=lambda: list(
+            _DEFAULT_CONFIG["keyguard-eligibility"]))
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
     #: (set by load_config/lint_paths, not a pyproject key)

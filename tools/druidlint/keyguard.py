@@ -1,0 +1,977 @@
+"""keyguard: whole-program cache-key soundness analysis.
+
+Every compressed-execution layer in this tree specializes a device
+program on some descriptor, and every specialization is a cache-key
+obligation: the jit caches, the device-segment pool, the plan digests
+and the dedupe keys must each distinguish every input that changes the
+built value. The invariant has been hand-enforced since PR 9, and the
+review history shows what a missed key member costs (a silently-shared
+log2m program, two subscribers with different emission policies sharing
+one standing program). keyguard makes the obligation machine-checked,
+riding raceguard's whole-program index (same module set, binder and
+mtime/size cache signature) the way leakguard does.
+
+Three rules on the shared registry/baseline/suppression machinery:
+
+  * `unkeyed-trace-input` — at every build-on-miss cache site
+    (``CACHE[sig] = build(...)`` guarded by a ``.get``/``in`` miss
+    check, ``CACHE.setdefault(sig, build(...))``, and
+    ``pool.get_or_build(owner, key, lambda: ...)``), the build's input
+    chains must each have dataflow into the key expression. Also checks
+    configured key-derivation functions (`keyguard-key-fns`,
+    "path::qual" entries): every parameter must flow into the returned
+    signature — deleting one descriptor from `_structure_sig`'s fold is
+    caught here.
+  * `impure-eligibility` — functions named in `keyguard-eligibility`
+    (packed/cascade eligibility, standing `check_eligible`, broker
+    `fusable`) must be pure functions of column stats, descriptors and
+    query structure: no os.environ, clock, random or device-pool reads
+    at query time (own statements plus same-module callees, two deep).
+  * `env-flag-latch` — a ``DRUID_TPU_*`` read inside plan/build modules
+    (`keyguard-plan-modules`) must match its declared semantics in the
+    flags catalog (druid_tpu/config/flags.py): latch flags are read at
+    import only, live flags are read at call time only and must be
+    declared key members — a mid-process flag flip must never alias a
+    cached program.
+
+The dataflow is over *dotted chains* (``ref.kds``, ``mesh.shape``),
+expanded transitively through local assignments on both the key and the
+build side, so ``sig = _structure_sig(spec, ...)`` keys and
+``fn = _build(...)`` inserts resolve to their real inputs. A build
+chain is covered when some key chain equals it or is a dotted prefix of
+it in either direction (keying on ``x.key`` covers inserting ``x``).
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import fnmatch
+from pathlib import Path
+from types import SimpleNamespace
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.druidlint.core import Finding, ModuleContext, rule
+from tools.druidlint.raceguard import ModuleInfo, Program, _program_for
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+Chain = Tuple[str, ...]
+
+#: receiver methods treated as "writes into the receiver" by the
+#: chain/param dataflow (x.append(e) makes e reach x)
+_MUTATORS = {"append", "add", "update", "extend", "insert", "appendleft",
+             "setdefault"}
+
+#: receiver methods that mark an instance attribute as mutable state
+#: (beyond _MUTATORS: removal also proves the attr changes over time)
+_STATE_MUTATORS = _MUTATORS | {"pop", "popitem", "clear", "remove",
+                               "discard"}
+
+#: builtins whose result carries no content fingerprint of their
+#: arguments — `K = len(chunk)` does NOT put `chunk` into a key
+_SIZE_ONLY = {"len", "bool", "type", "isinstance", "any", "all"}
+
+#: constructors recognized as fresh per-call dicts (alongside literal
+#: displays) — a local accumulator, not a cross-call cache
+_DICT_CTORS = {"dict", "OrderedDict", "defaultdict", "Counter"}
+
+_TIME_FNS = {"time", "monotonic", "perf_counter", "time_ns",
+             "process_time"}
+
+#: pool-probe terminals: reading (or populating) device-pool state from
+#: an eligibility predicate makes eligibility depend on what happens to
+#: be resident — two identical queries would plan differently
+_POOL_PROBES = {"device_contains", "device_take", "peek",
+                "resident_bytes", "stats", "get_or_build"}
+
+
+# ---------------------------------------------------------------------------
+# Flags catalog (AST-parsed, never imported — same pattern as the
+# metric-name rule's METRICS catalog)
+# ---------------------------------------------------------------------------
+
+#: parsed catalogs keyed by absolute path; value = ((mtime_ns, size), {..})
+_FLAG_CACHE: Dict[str, Tuple[Tuple[int, int], Dict[str, dict]]] = {}
+
+
+def flag_catalog(root: str, rel: str) -> Dict[str, dict]:
+    """{env name: {"semantics", "key_member", "default"}} parsed from the
+    FLAGS dict literal (config `flags-catalog`). A missing or unparseable
+    catalog declares nothing — env-flag-latch then stays silent and the
+    flag-name rule flags every read, so the gate fails loudly."""
+    p = Path(root) / rel
+    try:
+        st = p.stat()
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        return {}
+    hit = _FLAG_CACHE.get(str(p))
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    try:
+        tree = ast.parse(p.read_text())
+    except (OSError, SyntaxError):
+        return {}
+    out: Dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "FLAGS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            decl = {"semantics": "latch", "key_member": False,
+                    "default": ""}
+            if isinstance(v, ast.Call):
+                for kw in v.keywords:
+                    if kw.arg in decl and isinstance(kw.value,
+                                                     ast.Constant):
+                        decl[kw.arg] = kw.value.value
+            out[k.value] = decl
+    _FLAG_CACHE[str(p)] = (key, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dotted-chain extraction + local dataflow
+# ---------------------------------------------------------------------------
+
+def _chain_of(node: ast.AST) -> Optional[Chain]:
+    """('ref', 'kds') for a pure dotted expression, None otherwise."""
+    if isinstance(node, ast.Name):
+        return (node.id,)
+    if isinstance(node, ast.Attribute):
+        base = _chain_of(node.value)
+        return base + (node.attr,) if base is not None else None
+    return None
+
+
+def _chains_in(node: ast.AST,
+               bound: Iterable[str] = ()) -> Set[Chain]:
+    """Maximal dotted chains read by an expression. Callee names are not
+    data (``len(x)`` yields only ``x``; ``mod.helper(x)`` yields ``mod``
+    via the receiver, which the module-binding exemption then drops), and
+    comprehension targets/lambda params resolve to their iterators."""
+    out: Set[Chain] = set()
+
+    def visit(n: ast.AST, shadowed: Set[str]) -> None:
+        ch = _chain_of(n)
+        if ch is not None:
+            if ch[0] not in shadowed:
+                out.add(ch)
+            return
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name) and n.func.id in _SIZE_ONLY:
+                return        # len(x) etc. carry no content of x
+            if isinstance(n.func, ast.Attribute):
+                visit(n.func.value, shadowed)
+            for a in n.args:
+                visit(a, shadowed)
+            for kw in n.keywords:
+                visit(kw.value, shadowed)
+            return
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            targets: Set[str] = set()
+            for g in n.generators:
+                visit(g.iter, shadowed | targets)
+                targets |= {x.id for x in ast.walk(g.target)
+                            if isinstance(x, ast.Name)}
+                for cond in g.ifs:
+                    visit(cond, shadowed | targets)
+            inner = shadowed | targets
+            if isinstance(n, ast.DictComp):
+                visit(n.key, inner)
+                visit(n.value, inner)
+            else:
+                visit(n.elt, inner)
+            return
+        if isinstance(n, ast.Lambda):
+            a = n.args
+            params = {x.arg for x in (*a.posonlyargs, *a.args,
+                                      *a.kwonlyargs)}
+            for extra in (a.vararg, a.kwarg):
+                if extra is not None:
+                    params.add(extra.arg)
+            visit(n.body, shadowed | params)
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c, shadowed)
+
+    visit(node, set(bound))
+    return out
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Every node in `fn`'s body except nested def/class bodies (their
+    locals are a different scope; nested lambdas stay in — they close
+    over this scope)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (*_FUNC_DEFS, ast.ClassDef)):
+                continue
+            stack.append(c)
+
+
+def _local_defs(own: List[ast.AST]) -> Dict[str, List[Tuple[ast.AST,
+                                                             bool]]]:
+    """name → [(value node, elementwise)] for everything ever assigned
+    or accumulated into it in this function. `elementwise` marks
+    bindings where the name holds an ELEMENT of the value (loop targets,
+    tuple unpacking, .append args): attribute projections carry through
+    (``for s in segments`` makes ``s.id`` resolve to ``segments.id``)."""
+    out: Dict[str, List[Tuple[ast.AST, bool]]] = {}
+
+    def put(name: str, node: ast.AST, elementwise: bool) -> None:
+        out.setdefault(name, []).append((node, elementwise))
+
+    def put_target(t: ast.AST, node: ast.AST, elementwise: bool) -> None:
+        if isinstance(t, ast.Name):
+            put(t.id, node, elementwise)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                put_target(e, node, True)
+        elif isinstance(t, ast.Starred):
+            put_target(t.value, node, True)
+
+    for n in own:
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                put_target(t, n.value, False)
+        elif isinstance(n, ast.AugAssign) and isinstance(n.target,
+                                                         ast.Name):
+            put(n.target.id, n.value, False)
+        elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                and isinstance(n.target, ast.Name):
+            put(n.target.id, n.value, False)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            put_target(n.target, n.iter, True)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            put_target(n.optional_vars, n.context_expr, False)
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _MUTATORS:
+            recv = _chain_of(n.func.value)
+            if recv is not None and len(recv) == 1:
+                for a in n.args:
+                    put(recv[0], a, True)
+                for kw in n.keywords:
+                    put(recv[0], kw.value, True)
+    return out
+
+
+def _resolve_chain(chain: Chain,
+                   defs: Dict[str, List[Tuple[ast.AST, bool]]],
+                   seen: frozenset) -> Set[Chain]:
+    """Ground forms of `chain` by SUBSTITUTING locally-assigned roots
+    with what they were assigned from — ``ref.kds`` with
+    ``ref = chunk[0]`` becomes ``chunk.kds``, keeping projections
+    distinct (accumulating ancestors instead would collapse ``ref.kds``
+    and ``ref.spec`` into one origin and hide unkeyed inputs). Cycles
+    (self-referential accumulators) return the chain unresolved; the
+    caller drops still-local roots."""
+    root, rest = chain[0], chain[1:]
+    entries = defs.get(root)
+    if not entries or root in seen:
+        return {chain}
+    out: Set[Chain] = set()
+    nxt = seen | {root}
+    for node, elementwise in entries:
+        base = _chain_of(node)
+        if base is not None:
+            out |= _resolve_chain(base + rest, defs, nxt)
+            continue
+        keep_rest = elementwise or isinstance(node, ast.Subscript)
+        for c in _chains_in(node):
+            out |= _resolve_chain(c + rest if keep_rest else c,
+                                  defs, nxt)
+    return out or {chain}
+
+
+def _resolve_set(seeds: Set[Chain],
+                 defs: Dict[str, List[Tuple[ast.AST, bool]]]) \
+        -> Set[Chain]:
+    out: Set[Chain] = set()
+    for c in seeds:
+        out |= _resolve_chain(c, defs, frozenset())
+    return out
+
+
+def _covers(key_chains: Set[Chain], b: Chain) -> bool:
+    """A key chain covers build chain `b` when equal or a dotted prefix
+    in either direction (keying on `x.key` covers inserting `x`; keying
+    on `mesh` covers reading `mesh.shape`)."""
+    for k in key_chains:
+        m = min(len(k), len(b))
+        if m and k[:m] == b[:m]:
+            return True
+    return False
+
+
+def _exempt_roots(mi: Optional[ModuleInfo], tree: ast.AST) -> Set[str]:
+    """Root names that are never trace-affecting data: imports, module
+    functions/classes, and module constants (every toplevel assignment a
+    literal). Module vars assigned non-constant expressions — latched
+    flags, descriptor tables — stay checkable."""
+    roots: Set[str] = set()
+    if mi is not None:
+        roots |= set(mi.imports)
+        for name, kind in mi.globals.items():
+            if kind and kind[0] in ("func", "class"):
+                roots.add(name)
+    const: Set[str] = set()
+    nonconst: Set[str] = set()
+    for stmt in getattr(tree, "body", []):
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if isinstance(value, ast.Constant):
+            const.update(names)
+        else:
+            nonconst.update(names)
+    roots |= const - nonconst
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# unkeyed-trace-input: cache sites
+# ---------------------------------------------------------------------------
+
+def _fmt(ch: Chain) -> str:
+    return ".".join(ch)
+
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+#: (rebind chains, content-mutation chains) — the no-mutation default
+_NO_MUT: Tuple[Set[Chain], Set[Chain]] = (set(), set())
+
+
+def _checkable(ch: Chain, cont: Optional[Chain],
+               defs: Dict[str, List[Tuple[ast.AST, bool]]],
+               exempt: Set[str],
+               self_mut: Tuple[Set[Chain], Set[Chain]]) -> bool:
+    root = ch[0]
+    if root == "cls" or ch == ("self",):
+        return False
+    if root == "self":
+        # frozen construction state (only ever assigned in __init__)
+        # cannot alias two builds — only live instance state counts.
+        # A REBIND (self.x = ..., outside __init__) taints every chain
+        # through x in either prefix direction; an in-place CONTENT
+        # mutation (self.x[k] = / self.x.append) taints only reads of
+        # the container itself — pool.add(row) never moves pool.name
+        rel = ch[1:]
+        rebind, content = self_mut
+        live = rel in content or any(
+            m[:len(rel)] == rel or rel[:len(m)] == m for m in rebind)
+        if not live:
+            return False
+    if root in defs:      # unresolved cycle (self-referential local)
+        return False
+    if root in exempt or root in _BUILTINS or root.startswith("__"):
+        return False
+    if cont is not None and len(ch) >= len(cont) \
+            and ch[:len(cont)] == cont:
+        return False          # the cache itself (double-check reads)
+    return True
+
+
+def _self_rel(node: ast.AST) -> Optional[Tuple[Chain, bool]]:
+    """(chain after 'self', is_content_mutation) for a self.* store
+    target: plain attribute targets rebind, subscript stores mutate
+    contents in place."""
+    content = isinstance(node, ast.Subscript)
+    if content:
+        node = node.value
+    ch = _chain_of(node)
+    if ch is not None and len(ch) >= 2 and ch[0] == "self":
+        return ch[1:], content
+    return None
+
+
+def _mutated_attrs(cls: ast.ClassDef) -> Tuple[Set[Chain], Set[Chain]]:
+    """Self-relative chains mutated OUTSIDE __init__/__new__, split into
+    (rebound, content-mutated) — the state whose value can differ
+    between two builds under the same key."""
+    rebind: Set[Chain] = set()
+    content: Set[Chain] = set()
+    for m in ast.walk(cls):
+        if not isinstance(m, _FUNC_DEFS) \
+                or m.name in ("__init__", "__new__"):
+            continue
+        for n in ast.walk(m):
+            targets: List[ast.AST] = []
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+            elif isinstance(n, ast.Delete):
+                targets = list(n.targets)
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _STATE_MUTATORS:
+                recv = _chain_of(n.func.value)
+                if recv is not None and len(recv) >= 2 \
+                        and recv[0] == "self":
+                    content.add(recv[1:])
+                continue
+            for t in targets:
+                got = _self_rel(t)
+                if got is not None:
+                    (content if got[1] else rebind).add(got[0])
+    return rebind, content
+
+
+def _class_map(tree: ast.AST) -> Dict[int, ast.ClassDef]:
+    """id(function node) → nearest enclosing ClassDef."""
+    out: Dict[int, ast.ClassDef] = {}
+
+    def walk(node: ast.AST, cls: Optional[ast.ClassDef]) -> None:
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, ast.ClassDef):
+                walk(c, c)
+            else:
+                if isinstance(c, _FUNC_DEFS) and cls is not None:
+                    out[id(c)] = cls
+                walk(c, cls)
+
+    walk(tree, None)
+    return out
+
+
+def _if_context(fn: ast.AST) -> Tuple[Dict[int, List[ast.expr]],
+                                      List[ast.expr]]:
+    """(id(stmt) → enclosing If tests, tests that guard an early return).
+    Both forms of the build-on-miss shape leave their miss check here:
+    the insert nested under ``if hit is None:`` or a hit path that
+    returns early above an unconditional build."""
+    enclosing: Dict[int, List[ast.expr]] = {}
+    ret_tests: List[ast.expr] = []
+
+    def walk(body: List[ast.stmt], tests: List[ast.expr]) -> None:
+        for s in body:
+            enclosing[id(s)] = tests
+            if isinstance(s, ast.Return):
+                ret_tests.extend(tests)
+            if isinstance(s, ast.If):
+                walk(s.body, tests + [s.test])
+                walk(s.orelse, tests + [s.test])
+            elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                walk(s.body, tests)
+                walk(s.orelse, tests)
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                walk(s.body, tests)
+            elif isinstance(s, ast.Try):
+                for b in (s.body, s.orelse, s.finalbody):
+                    walk(b, tests)
+                for h in s.handlers:
+                    walk(h.body, tests)
+            elif isinstance(s, ast.Match):
+                for case in s.cases:
+                    walk(case.body, tests)
+
+    walk(list(getattr(fn, "body", [])), [])
+    return enclosing, ret_tests
+
+
+def _scan_fn_sites(path: str, fn: ast.AST, exempt: Set[str],
+                   self_mut: Tuple[Set[Chain], Set[Chain]], add) -> None:
+    own = list(_own_nodes(fn))
+    defs = _local_defs(own)
+    # nested defs are code, not data — their closures read this scope's
+    # locals, which the chain dataflow already tracks by name
+    exempt = exempt | {n.name for n in own
+                       if isinstance(n, (*_FUNC_DEFS, ast.ClassDef))}
+
+    # miss-check evidence: container chain → key expressions it was
+    # probed with (.get(k) / k in C). An insert only counts as a cache
+    # site when the SAME container was miss-checked with the SAME key
+    # expression — that is the build-on-miss shape; registries and
+    # merge-dicts probed with other keys stay out
+    checked: Dict[Chain, Set[str]] = {}
+    local_dicts: Set[str] = set()
+    missvars: Set[str] = set()     # names holding a miss-probe result
+    for n in own:
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "get" and n.args:
+            ch = _chain_of(n.func.value)
+            if ch is not None:
+                checked.setdefault(ch, set()).add(ast.dump(n.args[0]))
+        elif isinstance(n, ast.Compare) \
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in n.ops):
+            for cmp in n.comparators:
+                ch = _chain_of(cmp)
+                if ch is not None:
+                    checked.setdefault(ch, set()).add(ast.dump(n.left))
+        elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+            value = n.value
+            if isinstance(value, (ast.Dict, ast.DictComp)) \
+                    or (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)
+                        and value.func.id in _DICT_CTORS):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                local_dicts |= {t.id for t in targets
+                                if isinstance(t, ast.Name)}
+    for n in own:
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            value, targets = n.value, n.targets
+        elif isinstance(n, ast.NamedExpr):
+            value, targets = n.value, [n.target]
+        if isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Attribute) \
+                and value.func.attr == "get" \
+                and _chain_of(value.func.value) in checked:
+            missvars |= {t.id for t in targets if isinstance(t, ast.Name)}
+
+    enclosing, ret_tests = _if_context(fn)
+
+    def _mentions(test: ast.expr, cont: Chain) -> bool:
+        for ch in _chains_in(test):
+            if ch[:len(cont)] == cont or ch[0] in missvars:
+                return True
+        return False
+
+    def _miss_guarded(site: ast.AST, cont: Chain) -> bool:
+        """The insert is control-dependent on the miss check — nested
+        under an If that tests the container/probe result, or downstream
+        of a hit path that returned early on one. Unconditional stores
+        (registries, last-write-wins maps) are not build-on-miss caches."""
+        return any(_mentions(t, cont)
+                   for t in enclosing.get(id(site), ())) \
+            or any(_mentions(t, cont) for t in ret_tests)
+
+    sites = []   # (anchor, container-chain, key expr, build chains)
+    for n in own:
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                cont = _chain_of(t.value)
+                if cont is None \
+                        or ast.dump(t.slice) not in checked.get(cont, ()):
+                    continue     # no build-on-miss evidence: not a cache
+                if not _miss_guarded(n, cont):
+                    continue     # unconditional store: registry, not cache
+                if len(cont) == 1 and cont[0] in local_dicts:
+                    continue     # per-call dict, dies with the frame
+                if isinstance(n.value, ast.Constant):
+                    continue     # sentinel insert
+                raw = _chains_in(n.value)
+                if any(len(c) >= len(cont) and c[:len(cont)] == cont
+                       for c in raw):
+                    continue     # d[k] = d.get(k, 0) + v — accumulator
+                sites.append((n, cont, t.slice, raw))
+        elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr == "setdefault" and len(n.args) >= 2:
+                cont = _chain_of(n.func.value)
+                if cont is None:
+                    continue
+                if len(cont) == 1 and cont[0] in local_dicts:
+                    continue
+                if isinstance(n.args[1], ast.Constant):
+                    continue
+                sites.append((n, cont, n.args[0],
+                              _chains_in(n.args[1])))
+            elif n.func.attr == "get_or_build" and len(n.args) >= 3:
+                cont = _chain_of(n.func.value)
+                builder = n.args[2]
+                if isinstance(builder, ast.Name):
+                    # look through `build = lambda: ...` locals; any
+                    # other callable value is opaque (caller-supplied)
+                    for s in own:
+                        if isinstance(s, ast.Assign) \
+                                and isinstance(s.value, ast.Lambda) \
+                                and any(isinstance(t, ast.Name)
+                                        and t.id == builder.id
+                                        for t in s.targets):
+                            builder = s.value
+                            break
+                if not isinstance(builder, ast.Lambda):
+                    continue
+                a = builder.args
+                params = {x.arg for x in (*a.posonlyargs, *a.args,
+                                          *a.kwonlyargs)}
+                sites.append((n, cont, n.args[1],
+                              _chains_in(builder.body, params)))
+
+    for anchor, cont, key_expr, raw_build in sites:
+        raw_key = _chains_in(key_expr)
+        if not raw_key:
+            continue    # constant-keyed default-fill, not a keyed cache
+        key_chains = raw_key | _resolve_set(raw_key, defs)
+        build_chains = _resolve_set(raw_build, defs)
+        uncovered = sorted(
+            _fmt(b) for b in build_chains
+            if _checkable(b, cont, defs, exempt, self_mut)
+            and not _covers(key_chains, b))
+        if uncovered:
+            name = _fmt(cont) if cont is not None else "cache"
+            add("unkeyed-trace-input", path, anchor.lineno,
+                anchor.col_offset,
+                f"cache '{name}': build input(s) "
+                f"{', '.join(uncovered)} have no dataflow into the key "
+                f"— two different builds can alias under one cached "
+                f"entry; key them or suppress with the invariant that "
+                f"keeps them equal per key")
+
+
+# ---------------------------------------------------------------------------
+# unkeyed-trace-input: key-derivation functions (param → return flow)
+# ---------------------------------------------------------------------------
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _param_flow_missing(fn: ast.AST) -> List[str]:
+    """Parameters of a key function with no dataflow into any return —
+    the produced signature cannot distinguish their values."""
+    a = fn.args
+    params = [x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            params.append(extra.arg)
+    params = [p for p in params
+              if p not in ("self", "cls") and not p.startswith("_")]
+    own = list(_own_nodes(fn))
+    needed: Set[str] = set()
+    for n in own:
+        if isinstance(n, ast.Return) and n.value is not None:
+            needed |= _names_in(n.value)
+    changed = True
+    while changed:
+        changed = False
+        for n in own:
+            src, dsts = None, []
+            if isinstance(n, ast.Assign):
+                src, dsts = n.value, n.targets
+            elif isinstance(n, ast.AugAssign):
+                src, dsts = n.value, [n.target]
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                src, dsts = n.value, [n.target]
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                src, dsts = n.iter, [n.target]
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _MUTATORS:
+                recv = _chain_of(n.func.value)
+                if recv is not None and recv[0] in needed:
+                    new: Set[str] = set()
+                    for x in n.args:
+                        new |= _names_in(x)
+                    for kw in n.keywords:
+                        new |= _names_in(kw.value)
+                    if not new <= needed:
+                        needed |= new
+                        changed = True
+                continue
+            else:
+                continue
+            dst_names: Set[str] = set()
+            for d in dsts:
+                dst_names |= _names_in(d)
+            if dst_names & needed:
+                new = _names_in(src)
+                if not new <= needed:
+                    needed |= new
+                    changed = True
+    return [p for p in params if p not in needed]
+
+
+def _qual_funcs(tree: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, _FUNC_DEFS):
+                q = prefix + c.name
+                out[q] = c
+                walk(c, q + ".")
+            elif isinstance(c, ast.ClassDef):
+                walk(c, prefix + c.name + ".")
+            else:
+                walk(c, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _match_entries(path: str, entries: List[str]) -> List[str]:
+    quals = []
+    for e in entries:
+        if "::" not in e:
+            continue
+        p, q = e.split("::", 1)
+        if fnmatch.fnmatch(path, p) or path == p:
+            quals.append(q)
+    return quals
+
+
+def _scan_key_fns(path: str, tree: ast.AST, entries: List[str],
+                  add) -> None:
+    mine = _match_entries(path, entries)
+    if not mine:
+        return
+    funcs = _qual_funcs(tree)
+    for qual, fn in sorted(funcs.items()):
+        if not any(fnmatch.fnmatch(qual, pat) for pat in mine):
+            continue
+        for p in _param_flow_missing(fn):
+            add("unkeyed-trace-input", path, fn.lineno, fn.col_offset,
+                f"key function '{qual}': parameter '{p}' has no "
+                f"dataflow into the returned signature — the key "
+                f"cannot distinguish values of it")
+
+
+# ---------------------------------------------------------------------------
+# impure-eligibility
+# ---------------------------------------------------------------------------
+
+def _impurity(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        ch = _chain_of(node.func) or ()
+        if ch[-2:] == ("environ", "get") or (ch and ch[-1] == "getenv"):
+            return "reads os.environ at query time"
+        if len(ch) == 2 and ch[0] == "time" and ch[1] in _TIME_FNS:
+            return f"calls time.{ch[1]}() at query time"
+        if len(ch) == 2 and ch[0] == "random":
+            return f"calls random.{ch[1]}() at query time"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _POOL_PROBES:
+            recv = _chain_of(node.func.value) or ()
+            if any("pool" in seg.lower() for seg in recv):
+                return f"probes device-pool state ({node.func.attr})"
+    elif isinstance(node, ast.Subscript):
+        ch = _chain_of(node.value) or ()
+        if ch and ch[-1] == "environ" \
+                and isinstance(node.ctx, ast.Load):
+            return "reads os.environ at query time"
+    return None
+
+
+def _scan_eligibility(path: str, tree: ast.AST, entries: List[str],
+                      add) -> None:
+    mine = _match_entries(path, entries)
+    if not mine:
+        return
+    funcs = _qual_funcs(tree)
+    top = {q: f for q, f in funcs.items() if "." not in q}
+    for qual, fn in sorted(funcs.items()):
+        if not any(fnmatch.fnmatch(qual, pat) for pat in mine):
+            continue
+        layer, seen, gathered = [fn], {fn}, [fn]
+        for _ in range(2):        # own stmts + same-module callees, 2 deep
+            nxt = []
+            for f in layer:
+                for n in _own_nodes(f):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Name):
+                        callee = top.get(n.func.id)
+                        if callee is not None and callee not in seen:
+                            seen.add(callee)
+                            nxt.append(callee)
+                            gathered.append(callee)
+            layer = nxt
+        for f in gathered:
+            for n in _own_nodes(f):
+                why = _impurity(n)
+                if why is None:
+                    continue
+                via = "" if f is fn else f"(via {f.name}) "
+                add("impure-eligibility", path, n.lineno, n.col_offset,
+                    f"eligibility function '{qual}' {via}{why} — "
+                    f"eligibility must be a pure function of "
+                    f"descriptors/stats/query structure, or two "
+                    f"identical queries plan differently")
+
+
+# ---------------------------------------------------------------------------
+# env-flag-latch
+# ---------------------------------------------------------------------------
+
+def _env_read(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """(flag name, node) for a literal DRUID_TPU_* environment read."""
+    if isinstance(node, ast.Call):
+        ch = _chain_of(node.func) or ()
+        if (ch[-2:] == ("environ", "get")
+                or (ch and ch[-1] == "getenv")) \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and node.args[0].value.startswith("DRUID_TPU_"):
+            return node.args[0].value, node
+    elif isinstance(node, ast.Subscript) \
+            and isinstance(node.ctx, ast.Load):
+        ch = _chain_of(node.value) or ()
+        sl = node.slice
+        if ch and ch[-1] == "environ" and isinstance(sl, ast.Constant) \
+                and isinstance(sl.value, str) \
+                and sl.value.startswith("DRUID_TPU_"):
+            return sl.value, node
+    return None
+
+
+def _scan_env_latch(path: str, tree: ast.AST, catalog: Dict[str, dict],
+                    add) -> None:
+    if not catalog:
+        return
+    owned: Dict[int, str] = {}     # id(node) → enclosing function name
+    for fn in (n for n in ast.walk(tree) if isinstance(n, _FUNC_DEFS)):
+        for n in ast.walk(fn):
+            if n is not fn:
+                owned.setdefault(id(n), fn.name)
+    for n in ast.walk(tree):
+        got = _env_read(n)
+        if got is None:
+            continue
+        name, node = got
+        decl = catalog.get(name)
+        if decl is None:
+            continue               # undeclared: the flag-name rule's job
+        infn = owned.get(id(node))
+        sem, km = decl["semantics"], decl["key_member"]
+        if sem == "latch" and infn is not None:
+            add("env-flag-latch", path, node.lineno, node.col_offset,
+                f"{name} is declared 'latch' but read inside "
+                f"{infn}() — a mid-process flip would alias cached "
+                f"programs; latch it into a module global at import, "
+                f"or declare it live with key_member=True")
+        elif sem == "live" and infn is None:
+            add("env-flag-latch", path, node.lineno, node.col_offset,
+                f"{name} is declared 'live' but read at import time — "
+                f"fix the catalog semantics or move the read to call "
+                f"time")
+        elif sem == "live" and infn is not None and not km:
+            add("env-flag-latch", path, node.lineno, node.col_offset,
+                f"live flag {name} read inside {infn}() is not a "
+                f"declared key member — its value must join every "
+                f"cache/plan key (key_member=True in the catalog) or "
+                f"the read must be latched")
+
+
+# ---------------------------------------------------------------------------
+# Orchestration + rule shims
+# ---------------------------------------------------------------------------
+
+def _config_key(config) -> tuple:
+    p = Path(config.root) / config.flags_catalog
+    try:
+        st = p.stat()
+        cat = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        cat = None
+    return (tuple(config.keyguard_key_fns),
+            tuple(config.keyguard_eligibility),
+            tuple(config.keyguard_plan_modules),
+            config.flags_catalog, cat)
+
+
+def keyguard_findings(prog: Program, config) \
+        -> Dict[str, Dict[str, List[Tuple[int, int, str]]]]:
+    """rule → path → [(line, col, message)], computed once per program
+    per effective keyguard config (the program object is memoized across
+    runs on its file signature; the keyguard keys are not part of that
+    signature, so the memo carries its own)."""
+    key = _config_key(config)
+    got = getattr(prog, "_keyguard_findings", None)
+    if got is not None and got[0] == key:
+        return got[1]
+    findings: Dict[str, Dict[str, List[Tuple[int, int, str]]]] = {}
+
+    def add(rule_name: str, path: str, line: int, col: int,
+            message: str) -> None:
+        findings.setdefault(rule_name, {}).setdefault(path, []) \
+            .append((line, col, message))
+
+    catalog = flag_catalog(config.root, config.flags_catalog)
+    for path, mi in sorted(prog.modules.items()):
+        tree = mi.tree
+        exempt = _exempt_roots(mi, tree)
+        cmap = _class_map(tree)
+        mut_sets: Dict[int, Tuple[Set[Chain], Set[Chain]]] = {}
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, _FUNC_DEFS)):
+            cls = cmap.get(id(fn))
+            if cls is None:
+                self_mut = _NO_MUT
+            else:
+                if id(cls) not in mut_sets:
+                    mut_sets[id(cls)] = _mutated_attrs(cls)
+                self_mut = mut_sets[id(cls)]
+            _scan_fn_sites(path, fn, exempt, self_mut, add)
+        _scan_key_fns(path, tree, list(config.keyguard_key_fns), add)
+        _scan_eligibility(path, tree,
+                          list(config.keyguard_eligibility), add)
+        if any(fnmatch.fnmatch(path, pat)
+               for pat in config.keyguard_plan_modules):
+            _scan_env_latch(path, tree, catalog, add)
+    prog._keyguard_findings = (key, findings)
+    return findings
+
+
+def _emit(ctx: ModuleContext, rule_name: str) -> Iterable[Finding]:
+    if not ctx.path_matches(ctx.config.raceguard_modules):
+        return
+    prog = _program_for(ctx)
+    data = keyguard_findings(prog, ctx.config)
+    for line, col, message in sorted(
+            data.get(rule_name, {}).get(ctx.path, ())):
+        yield ctx.finding(SimpleNamespace(lineno=line, col_offset=col),
+                          message)
+
+
+@rule("unkeyed-trace-input", "error",
+      "cache build input with no dataflow into the cache key")
+def check_unkeyed_trace_input(ctx: ModuleContext) -> Iterable[Finding]:
+    """At every build-on-miss cache site (dict caches with a .get/`in`
+    miss check, .setdefault builds, pool.get_or_build), every input the
+    build reads must have dataflow into the key expression — an unkeyed
+    trace input lets two different builds alias under one cached entry.
+    Also enforces, for the key functions configured in
+    `keyguard-key-fns`, that every parameter flows into the returned
+    signature (deleting a descriptor from `_structure_sig`'s fold is
+    caught here)."""
+    yield from _emit(ctx, "unkeyed-trace-input")
+
+
+@rule("impure-eligibility", "error",
+      "eligibility predicate reads mutable runtime state")
+def check_impure_eligibility(ctx: ModuleContext) -> Iterable[Finding]:
+    """Eligibility/planning predicates configured in
+    `keyguard-eligibility` (packed/cascade eligibility, standing
+    check_eligible, broker fusable) must be pure functions of column
+    stats, descriptors and query structure. An os.environ, clock,
+    random or device-pool read at query time makes two identical
+    queries plan differently — and the resulting descriptors key every
+    downstream cache."""
+    yield from _emit(ctx, "impure-eligibility")
+
+
+@rule("env-flag-latch", "error",
+      "DRUID_TPU_* read in plan/build code violates its declared "
+      "latch/live semantics")
+def check_env_flag_latch(ctx: ModuleContext) -> Iterable[Finding]:
+    """Inside plan/build modules (`keyguard-plan-modules`), every
+    DRUID_TPU_* environment read must match its declared semantics in
+    the flags catalog (config `flags-catalog`): latch flags are read
+    once at import into a module global; live flags are read at call
+    time and must be declared key members (their value joins every
+    cache/plan key). A mid-process flip of an unlatched, unkeyed flag
+    aliases cached programs built under the old value."""
+    yield from _emit(ctx, "env-flag-latch")

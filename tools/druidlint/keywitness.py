@@ -1,0 +1,345 @@
+"""keywitness: a dynamic witness for keyguard's cache-key soundness rules.
+
+keyguard proves statically that every build input has dataflow into its
+cache key — but a dataflow edge is not an EQUALITY: `sig` can fold
+`spec.dims` and still collapse two distinct descriptor sets into one
+string. The witness closes that loop by observing reality: it wraps the
+build-on-miss caches the engine actually runs (grouping/batching jit
+caches, the sharded-fn cache, the device segment pool) and records, for
+every build, a canonical structural FINGERPRINT of the build inputs next
+to the cache key it was stored under. Two builds under the SAME key with
+DIFFERENT fingerprints is a key collision — exactly the silent aliasing
+the static rule exists to prevent, caught in vivo.
+
+Mechanics:
+  * install() swaps each cache's module-global OrderedDict for a
+    recording subclass (hit/insert counters; `release_device_caches`
+    uses .clear(), so wrappers survive engine cache drops) and wraps the
+    module-global builder functions (`_build_device_fn`,
+    `_build_batched_fn`, `_build_sharded_fn`). A builder call computes
+    the fingerprint of its arguments and parks it thread-locally; the
+    insert that immediately follows (same thread, under the cache lock)
+    claims it for its key. DeviceSegmentPool.get_or_build is wrapped
+    directly: every access fingerprints the returned entry's pytree
+    structure under the (owner,)+key identity — a key whose resident
+    value changes structure between accesses aliased two stagings.
+  * Fingerprints are STRUCTURAL, never data: arrays contribute
+    (dtype, ndim) for builder arguments (per-segment id arrays arrive
+    as runtime arguments, their lengths legitimately vary under one
+    key) and (dtype, shape) for pool values (a staged block's shapes
+    are fixed per key). No device sync, no host reads. Fields that are
+    non-structural by the engine's own contract are excluded
+    (_FP_EXCLUDE): druid output-column `name`s (applied host-side;
+    the traced program is positional) and scalars that ride aux as
+    device arrays (uniform bucket offset/period, dim cardinality,
+    const-sum value) — one program serving different values of those
+    is the design.
+  * Only the process-wide pool SINGLETON (devicepool._POOL at install
+    time) is witnessed: tests construct isolated pools with synthetic
+    owner tokens and deliberately rebuild toy keys at different sizes
+    to exercise eviction accounting — out-of-contract by design.
+  * The fingerprint table OUTLIVES cache eviction on purpose: a key
+    rebuilt after LRU eviction must reproduce the fingerprint its first
+    build recorded — key→structure is a time-invariant contract, not a
+    cache-lifetime one.
+
+Session mode mirrors lockwitness/leakwitness: DRUID_TPU_KEY_WITNESS=1
+installs a process-wide singleton from tests/conftest.py and fails the
+run on any collision in pytest_unconfigure. The raceguard stress test
+drives a dedicated key-churn leg through the same witness.
+
+Test-only: nothing in druid_tpu imports this module.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: process-wide session witness (see session_witness)
+_SESSION: Optional["KeyWitness"] = None
+
+#: wrapped caches: (module name, cache global, builder global, label)
+_JIT_SITES = (
+    ("druid_tpu.engine.grouping", "_JIT_CACHE", "_build_device_fn",
+     "grouping._JIT_CACHE"),
+    ("druid_tpu.engine.batching", "_JIT_CACHE", "_build_batched_fn",
+     "batching._JIT_CACHE"),
+    ("druid_tpu.parallel.distributed", "_FN_CACHE", "_build_sharded_fn",
+     "distributed._FN_CACHE"),
+)
+
+_POOL_LABEL = "devicepool.get_or_build"
+
+
+def session_witness(root: Optional[str] = None) -> Optional["KeyWitness"]:
+    """Process-wide singleton install (same double-conftest rationale as
+    lockwitness.session_witness). First call (with `root`) installs;
+    later calls return the same witness."""
+    global _SESSION
+    if _SESSION is None and root is not None:
+        _SESSION = KeyWitness(root).install()
+    return _SESSION
+
+
+def end_session_witness() -> Optional["KeyWitness"]:
+    """Uninstall and detach the session witness (reporting hook)."""
+    global _SESSION
+    w, _SESSION = _SESSION, None
+    if w is not None:
+        w.uninstall()
+    return w
+
+
+# ---------------------------------------------------------------------------
+# Structural fingerprints
+# ---------------------------------------------------------------------------
+
+#: fields excluded from structural fingerprints, per class name ("*"
+#: applies everywhere). Two kinds of field live here, both NON-structural
+#: by the engine's own contract:
+#:   * presentation — `name` is the druid output-column label, applied
+#:     host-side when SegmentPartial.states is assembled; the traced
+#:     program is positional, so one program serving two output names is
+#:     the design, not a collision (`field`/`column` attrs, which SELECT
+#:     inputs, stay in).
+#:   * aux-riding values — scalars the builder ships as device arrays
+#:     (grouping._assemble_aux): uniform bucket offset/period, dim
+#:     cardinality, the constant-sum value. Their VALUES are runtime
+#:     data under one compiled program. Scalars that ARE trace constants
+#:     (K, n_intervals, chunk_rows, mm_base, num_total) stay in.
+_FP_EXCLUDE: Dict[str, frozenset] = {
+    "*": frozenset({"name"}),
+    "GroupSpec": frozenset({"uniform_first_offset", "uniform_period"}),
+    "KeyDim": frozenset({"cardinality"}),
+    "SumKernel": frozenset({"const_value"}),
+    # `round` is applied in HllKernel.finalize_array, host-side np.rint
+    # on the already-materialized registers — the device program is
+    # identical either way
+    "CardinalityAggregator": frozenset({"round"}),
+    "HyperUniqueAggregator": frozenset({"round"}),
+}
+
+
+def _fp(obj, shapes: bool, depth: int = 8) -> str:
+    """Canonical structural fingerprint. Deterministic within a process,
+    data-free: arrays contribute dtype + ndim (or full shape when
+    `shapes`), objects contribute class name + sorted field structure
+    minus the _FP_EXCLUDE presentation/aux fields. Lists and tuples
+    canonicalize to one spelling — builder args are consumed by python
+    closure construction, never as pytree leaves, so the container
+    flavor cannot shape the built program."""
+    if depth <= 0:
+        return f"<{type(obj).__name__}>"
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return repr(obj)
+    if isinstance(obj, enum.Enum):
+        return f"{type(obj).__name__}.{obj.name}"
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        dim = tuple(obj.shape) if shapes else getattr(obj, "ndim", "?")
+        return f"arr({obj.dtype},{dim})"
+    if isinstance(obj, (tuple, list, set, frozenset)):
+        is_set = isinstance(obj, (set, frozenset))
+        items = sorted(obj, key=repr) if is_set else obj
+        body = ",".join(_fp(x, shapes, depth - 1) for x in items)
+        return f"{'set' if is_set else 'seq'}[{body}]"
+    if isinstance(obj, dict):
+        body = ",".join(
+            f"{k!r}:{_fp(v, shapes, depth - 1)}"
+            for k, v in sorted(obj.items(), key=lambda kv: repr(kv[0])))
+        return f"dict[{body}]"
+    fields = getattr(obj, "_fields", None)          # namedtuples
+    if fields is None and hasattr(obj, "__dict__"):
+        fields = sorted(vars(obj))
+    if fields:
+        skip = _FP_EXCLUDE["*"] | _FP_EXCLUDE.get(type(obj).__name__,
+                                                  frozenset())
+        body = ",".join(
+            f"{f}={_fp(getattr(obj, f, None), shapes, depth - 1)}"
+            for f in fields if f not in skip)
+        return f"{type(obj).__name__}({body})"
+    r = repr(obj)
+    return r if " at 0x" not in r else f"<{type(obj).__name__}>"
+
+
+def fingerprint_args(*args, shapes: bool = False) -> str:
+    return ";".join(_fp(a, shapes) for a in args)
+
+
+# ---------------------------------------------------------------------------
+# Recording cache
+# ---------------------------------------------------------------------------
+
+class RecordingCache(collections.OrderedDict):
+    """Drop-in OrderedDict that reports gets/inserts to the witness. An
+    insert claims the thread's parked builder fingerprint (the build and
+    the insert run back-to-back on one thread, under the cache's lock)."""
+
+    def __init__(self, witness: "KeyWitness", label: str, items=()):
+        self._witness = witness
+        self._label = label
+        super().__init__()
+        self._prime(items)
+
+    def _prime(self, items) -> None:
+        """Adopt warm entries WITHOUT the recording __setitem__: carried
+        entries are not builds, and claiming a parked fingerprint here
+        would mis-attribute some in-flight build's structure to an
+        unrelated warm key (the nested-witness hand-back does exactly
+        this iteration)."""
+        for k, v in items:
+            collections.OrderedDict.__setitem__(self, k, v)
+
+    def get(self, key, default=None):
+        got = super().get(key, default)
+        self._witness._count("hit" if got is not default else "miss",
+                            self._label)
+        return got
+
+    def __setitem__(self, key, value):
+        fp = self._witness._take_pending(self._label)
+        if fp is not None:
+            self._witness.record(self._label, key, fp)
+        super().__setitem__(key, value)
+
+
+class KeyWitness:
+    """Holds observed state for one install()/uninstall() span."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._meta = threading.Lock()
+        self._tls = threading.local()
+        #: (cache label, key) → first observed build fingerprint
+        self.fingerprints: Dict[Tuple[str, object], str] = {}
+        #: same-key/different-fingerprint observations
+        self.collisions: List[str] = []
+        #: per-label event counters: builds / hits / misses
+        self.counts: Dict[str, Dict[str, int]] = {}
+        self._installed = False
+        self._saved: List[Tuple[object, str, object]] = []
+        #: the production pool singleton captured at install(); accesses
+        #: through any OTHER pool instance (test fixtures) are unrecorded
+        self._prod_pool: Optional[object] = None
+
+    # ---- recording ------------------------------------------------------
+    def _count(self, kind: str, label: str) -> None:
+        with self._meta:
+            self.counts.setdefault(label, {})[kind] = \
+                self.counts.setdefault(label, {}).get(kind, 0) + 1
+
+    def _park_pending(self, label: str, fp: str) -> None:
+        pend = getattr(self._tls, "pending", None)
+        if pend is None:
+            pend = self._tls.pending = {}
+        pend[label] = fp
+
+    def _take_pending(self, label: str) -> Optional[str]:
+        pend = getattr(self._tls, "pending", None)
+        return None if pend is None else pend.pop(label, None)
+
+    def record(self, label: str, key, fp: str) -> None:
+        """One observed build of `key` in cache `label` from inputs with
+        structural fingerprint `fp`."""
+        self._count("build", label)
+        with self._meta:
+            old = self.fingerprints.get((label, key))
+            if old is None:
+                self.fingerprints[(label, key)] = fp
+            elif old != fp:
+                # show a window AROUND the first divergence — the common
+                # prefix is usually hundreds of identical dataclass fields
+                i = next((j for j, (a, b) in enumerate(zip(old, fp))
+                          if a != b), min(len(old), len(fp)))
+                lo = max(0, i - 60)
+                self.collisions.append(
+                    f"{label} key {key!r}: two builds with different "
+                    f"input structure — diverge at char {i}: "
+                    f"first ...{old[lo:i + 160]!r}, "
+                    f"now ...{fp[lo:i + 160]!r}")
+
+    # ---- install/uninstall ---------------------------------------------
+    def install(self) -> "KeyWitness":
+        if self._installed:
+            return self
+        import importlib
+        witness = self
+        for mod_name, cache_attr, builder_attr, label in _JIT_SITES:
+            mod = importlib.import_module(mod_name)
+            real_builder: Callable = getattr(mod, builder_attr)
+
+            def make_wrapper(real=real_builder, lbl=label):
+                def wrapped(*args, **kwargs):
+                    witness._park_pending(
+                        lbl, fingerprint_args(*args, shapes=False)
+                        + (f";{sorted(kwargs)}" if kwargs else ""))
+                    return real(*args, **kwargs)
+                return wrapped
+
+            self._saved.append((mod, builder_attr, real_builder))
+            setattr(mod, builder_attr, make_wrapper())
+            cache = getattr(mod, cache_attr)
+            self._saved.append((mod, cache_attr, cache))
+            setattr(mod, cache_attr,
+                    RecordingCache(witness, label, cache.items()))
+
+        from druid_tpu.data import devicepool
+        real_gob = devicepool.DeviceSegmentPool.get_or_build
+        # bind the singleton NOW: fixtures monkeypatch devicepool._POOL to
+        # fresh pools, so a call-time re-read would witness those too
+        self._prod_pool = devicepool._POOL
+
+        def get_or_build(pool_self, owner, key, build):
+            value = real_gob(pool_self, owner, key, build)
+            if pool_self is witness._prod_pool:
+                witness.record(_POOL_LABEL, (owner,) + tuple(key),
+                               _fp(value, shapes=True))
+            return value
+
+        self._saved.append(
+            (devicepool.DeviceSegmentPool, "get_or_build", real_gob))
+        devicepool.DeviceSegmentPool.get_or_build = get_or_build
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for obj, attr, original in reversed(self._saved):
+            current = getattr(obj, attr, None)
+            if isinstance(current, RecordingCache) and current is not original:
+                if isinstance(original, RecordingCache):
+                    # nested witness (per-test inside the session-wide
+                    # one): hand warm entries back to the OUTER witness's
+                    # recording cache, keeping its observation intact.
+                    # _prime, not update — update records each warm key
+                    # as an insert and would claim the outer witness's
+                    # parked fingerprint (left dangling because inner-span
+                    # builds ran through BOTH builder wrappers but only
+                    # the inner cache saw the insert)
+                    warm = collections.OrderedDict(current)
+                    original.clear()
+                    original._prime(warm.items())
+                else:
+                    # hand the warm entries back to a plain dict — witness
+                    # removal must not cold-start the engine caches
+                    original = collections.OrderedDict(current)
+            setattr(obj, attr, original)
+        self._saved.clear()
+        self._installed = False
+
+    def __enter__(self) -> "KeyWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ---- reporting ------------------------------------------------------
+    def summary(self) -> str:
+        with self._meta:
+            builds = sum(c.get("build", 0) for c in self.counts.values())
+            hits = sum(c.get("hit", 0) for c in self.counts.values())
+            return (f"{len(self.fingerprints)} distinct cache key(s) "
+                    f"witnessed, {builds} build(s), {hits} hit(s), "
+                    f"{len(self.collisions)} collision(s)")

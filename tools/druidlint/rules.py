@@ -311,17 +311,24 @@ def check_wire_decoded_rows(ctx: ModuleContext) -> Iterable[Finding]:
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if isinstance(func, ast.Attribute) and func.attr == "asarray" \
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("asarray", "frombuffer") \
                 and _terminal(func.value) in ("np", "numpy") \
                 and node.args and _is_column_chain(node.args[0]):
             yield ctx.finding(
-                node, f"np.asarray({_dotted(node.args[0])}) materializes "
-                      f"decoded rows on the compressed path")
-        elif isinstance(func, ast.Attribute) and func.attr == "tolist" \
+                node, f"np.{func.attr}({_dotted(node.args[0])}) "
+                      f"materializes decoded rows on the compressed path")
+        elif isinstance(func, ast.Attribute) \
+                and func.attr in ("tolist", "astype") \
                 and _is_column_chain(func.value):
             yield ctx.finding(
-                node, f"{_dotted(func.value)}.tolist() materializes "
+                node, f"{_dotted(func.value)}.{func.attr}() materializes "
                       f"decoded rows on the compressed path")
+        elif isinstance(func, ast.Name) and func.id == "bytes" \
+                and node.args and _is_column_chain(node.args[0]):
+            yield ctx.finding(
+                node, f"bytes({_dotted(node.args[0])}) copies decoded "
+                      f"rows to host bytes on the compressed path")
 
 
 # ---- swallowed-exception --------------------------------------------------
@@ -612,6 +619,36 @@ def check_metric_name(ctx: ModuleContext) -> Iterable[Finding]:
                     node, f"metric {name!r} is not declared in {cat_rel} — "
                           f"add it to METRICS (name, unit, dims, site) or "
                           f"fix the name drift")
+
+
+# ---- flag-name ------------------------------------------------------------
+
+
+@rule("flag-name", "error",
+      "DRUID_TPU_* env read not declared in the config/flags.py catalog")
+def check_flag_name(ctx: ModuleContext) -> Iterable[Finding]:
+    """Every literal ``DRUID_TPU_*`` environment read in modules matching
+    config `flag-modules` must name a flag declared in the single flags
+    catalog (config `flags-catalog`, default druid_tpu/config/flags.py) —
+    a typoed flag read silently falls back to its default forever; the
+    catalog makes the flag set a reviewed, single-source surface (the
+    `metric-name` pattern). The catalog also carries the latch/live
+    semantics keyguard's `env-flag-latch` rule enforces. Non-literal
+    names are not checkable and pass."""
+    if not ctx.path_matches(ctx.config.flag_modules):
+        return
+    cat_rel = ctx.config.flags_catalog
+    if ctx.path == cat_rel:
+        return
+    from tools.druidlint.keyguard import _env_read, flag_catalog
+    declared = flag_catalog(ctx.config.root, cat_rel)
+    for node in ast.walk(ctx.tree):
+        got = _env_read(node)
+        if got is not None and got[0] not in declared:
+            yield ctx.finding(
+                got[1], f"flag {got[0]!r} is not declared in {cat_rel} — "
+                        f"add a Flag(default, semantics, doc) entry to "
+                        f"FLAGS or fix the name drift")
 
 
 # ---- unused-suppression ---------------------------------------------------
