@@ -17,9 +17,16 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
+import threading
 
 VERSION = "druid-tpu-0.1"
+
+#: process-wide stop signal for the duty loops below. The loops park on
+#: it (stop-responsive bounded waits) instead of time.sleep: SIGINT still
+#: interrupts the wait on the main thread, and anything that sets the
+#: event (tests, an embedding process) ends the duty loop within one
+#: iteration — no thread ever parks un-wakeably.
+_STOP = threading.Event()
 
 
 def _scheduler_from_config(cfg):
@@ -91,12 +98,13 @@ def cmd_server(args) -> int:
 
     period = cfg.get_float("coordinator.period", 10.0)
     try:
-        while True:
+        while not _STOP.is_set():
             coordinator.run_once()
-            time.sleep(period)
+            _STOP.wait(period)
     except KeyboardInterrupt:
-        lc.stop()
-        return 0
+        pass
+    lc.stop()
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -139,11 +147,12 @@ def cmd_historical(args) -> int:
     print(f"historical [{args.name}] listening on :{server.port} "
           f"({loaded} segments preloaded)", flush=True)
     try:
-        while True:
-            time.sleep(3600)
+        while not _STOP.wait(3600):
+            pass
     except KeyboardInterrupt:
-        server.stop()
-        return 0
+        pass
+    server.stop()
+    return 0
 
 
 def build_broker(data_node_urls, port: int = 8082, query_slots: int = 0,
@@ -189,14 +198,15 @@ def cmd_broker(args) -> int:
     print(f"broker listening on :{http.port} "
           f"({len(urls)} data node(s))", flush=True)
     try:
-        while True:
+        while not _STOP.is_set():
             view.check_liveness(failures_required=3)
             _reregister_missing(view, urls)
             view.sync_all()
-            time.sleep(args.sync_period)
+            _STOP.wait(args.sync_period)
     except KeyboardInterrupt:
-        http.stop()
-        return 0
+        pass
+    http.stop()
+    return 0
 
 
 def cmd_coordinator(args) -> int:
@@ -234,14 +244,14 @@ def cmd_coordinator(args) -> int:
           flush=True)
     from druid_tpu.cluster import StaleTermError
     try:
-        while True:
+        while not _STOP.is_set():
             try:
                 stats = coord.run_once()
             except StaleTermError as e:
                 # deposed mid-cycle: the successor holds the term now —
                 # drop back to standby and keep heartbeating, don't die
                 print(f"deposed mid-cycle, standing by: {e}", flush=True)
-                time.sleep(args.period)
+                _STOP.wait(args.period)
                 continue
             if not stats.skipped_not_leader:
                 _reregister_missing(view, args.data_node or [])
@@ -250,12 +260,13 @@ def cmd_coordinator(args) -> int:
                 print(f"cycle: assigned={stats.assigned} "
                       f"dropped={stats.dropped} "
                       f"dead={stats.nodes_removed}", flush=True)
-            time.sleep(args.period)
+            _STOP.wait(args.period)
     except KeyboardInterrupt:
-        if leader is not None:
-            leader.stop()       # release the lease for fast failover
-        coord.stop()
-        return 0
+        pass
+    if leader is not None:
+        leader.stop()           # release the lease for fast failover
+    coord.stop()
+    return 0
 
 
 def cmd_router(args) -> int:
@@ -273,11 +284,12 @@ def cmd_router(args) -> int:
     print(f"router listening on :{http.port} "
           f"(tiers: {', '.join(sorted(tiers))})", flush=True)
     try:
-        while True:
-            time.sleep(3600)
+        while not _STOP.wait(3600):
+            pass
     except KeyboardInterrupt:
-        http.stop()
-        return 0
+        pass
+    http.stop()
+    return 0
 
 
 def cmd_dump_segment(args) -> int:

@@ -119,6 +119,11 @@ class Broker:
     surface (run / run_json / datasources / segments_of) so SqlExecutor can
     plan and execute cluster-wide."""
 
+    #: ceiling on one wave's park between completions: a query with no
+    #: timeout context must still re-check liveness each quantum instead
+    #: of parking a request thread on the pool indefinitely
+    MAX_WAVE_POLL_S = 60.0
+
     def __init__(self, view: InventoryView,
                  cache: Optional[LruCache] = None,
                  cache_config: Optional[CacheConfig] = None,
@@ -533,7 +538,9 @@ class Broker:
         hedged: Set[str] = set()
 
         def collect(f):
-            call, result, served, exc = f.result()
+            # collect() only ever receives futures from wait_futures'
+            # `done` set — result() returns immediately, it cannot park
+            call, result, served, exc = f.result()  # druidlint: disable=unbounded-blocking-call
             if exc is None:
                 if result is not None and not (served & claimed):
                     claimed.update(served)
@@ -673,15 +680,18 @@ class Broker:
 
     def _wave_timeout(self, live, futures, hedged: Set[str],
                       deadline: Deadline,
-                      hedges_left: int) -> Optional[float]:
+                      hedges_left: int) -> float:
         """How long the wave may block before something needs attention:
         the earliest un-hedged straggler's hedge deadline, bounded by the
-        query deadline. None = wait for the next completion (no timeout
-        context, hedging exhausted) — exactly the old pool.map wait."""
-        cands = []
-        rem = deadline.remaining_ms()
+        query deadline — and ALWAYS by MAX_WAVE_POLL_S: with no timeout
+        context and hedging exhausted the wave re-arms each quantum
+        instead of parking on the pool until the last straggler answers
+        (every in-flight call carries its own connect/read timeout, so
+        the re-armed wait is a liveness re-check, not a busy loop)."""
+        cands = [self.MAX_WAVE_POLL_S]
+        rem = deadline.remaining()
         if rem is not None:
-            cands.append(rem / 1000.0)
+            cands.append(rem)
         if hedges_left > 0:
             now = time.monotonic()
             for f in live:
@@ -690,8 +700,6 @@ class Broker:
                     delay = self.resilience.hedge_delay_s(self.view,
                                                           c.server)
                     cands.append(c.started + delay - now)
-        if not cands:
-            return None
         return max(0.005, min(cands))
 
     def _issue_hedges(self, live, futures, hedged: Set[str],

@@ -445,7 +445,7 @@ class RemoteDataNodeClient:
                           default=_json_value).encode()
         # ONE total budget across the shed retry: the context timeout is
         # the query's, not per-attempt
-        deadline = time.monotonic() + self._timeout_for(query)
+        deadline = Deadline.after_s(self._timeout_for(query))
         for attempt in (0, 1):
             req = urllib.request.Request(
                 self.base_url + path, data=body,
@@ -453,8 +453,7 @@ class RemoteDataNodeClient:
                 method="POST")
             try:
                 with urllib.request.urlopen(
-                        req, timeout=max(0.1, deadline - time.monotonic())
-                        ) as r:
+                        req, timeout=max(0.1, deadline.remaining())) as r:
                     return r.headers.get_content_type(), r.read()
             except urllib.error.HTTPError as e:
                 detail = e.read().decode(errors="replace")
@@ -483,7 +482,7 @@ class RemoteDataNodeClient:
                         self.MAX_RETRY_AFTER_SLEEP)
                     if attempt == 0 \
                             and retry_after <= self.MAX_RETRY_AFTER_SLEEP \
-                            and time.monotonic() + sleep_s < deadline:
+                            and sleep_s < deadline.remaining():
                         time.sleep(sleep_s)
                         continue
                     raise QueryCapacityError(

@@ -95,6 +95,13 @@ FLAGS = {
             "design — the chosen version is persisted as the format "
             "byte readers negotiate on, never a trace input "
             "(storage/format_v2.py)."),
+    "DRUID_TPU_STALL_WITNESS": Flag(
+        default="", semantics="latch",
+        doc="Test-only: 1 arms the suite-wide stall witness "
+            "(tools/druidlint/stallwitness.py) from tests/conftest.py — "
+            "every blocking park issued from a druid_tpu call site is "
+            "timed, and an untimed park outside a shutdown scope fails "
+            "the session."),
     "DRUID_TPU_STANDING": Flag(
         default="1", semantics="latch",
         doc="Standing-query incremental maintenance opt-out; 0 "

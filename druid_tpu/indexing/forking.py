@@ -446,6 +446,36 @@ class ForkingTaskRunner:
             self.processes[task_id] = proc
         return proc
 
+    #: one bounded park quantum on a live peon; the monitor re-checks
+    #: shutdown between quanta instead of parking on wait() forever
+    PROC_WAIT_POLL_S = 1.0
+    #: grace between SIGTERM and SIGKILL when shutdown interrupts a peon
+    PROC_KILL_GRACE_S = 5.0
+
+    def _await_proc(self, proc) -> None:
+        """Park on the peon in bounded quanta. A shutdown observed between
+        quanta escalates terminate → (after PROC_KILL_GRACE_S) kill, so
+        the monitor thread can never outlive stop() on a wedged peon —
+        the one pre-known stall in the tree (a bare proc.wait() here
+        parked the monitor for as long as the peon cared to run)."""
+        while True:
+            try:
+                proc.wait(timeout=self.PROC_WAIT_POLL_S)
+                return
+            except subprocess.TimeoutExpired:
+                pass
+            if self._shutdown:
+                break
+        proc.terminate()
+        try:
+            proc.wait(timeout=self.PROC_KILL_GRACE_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=self.PROC_KILL_GRACE_S)
+            except subprocess.TimeoutExpired:
+                pass        # unkillable (kernel-stuck): do not hang stop()
+
     def _monitor(self, task_id: str) -> None:
         while True:
             # snapshot the attempt count under the lock once; unlocked
@@ -454,7 +484,7 @@ class ForkingTaskRunner:
                 self.attempts[task_id] += 1
                 attempt = self.attempts[task_id]
             proc = self._fork(task_id, attempt)
-            proc.wait()
+            self._await_proc(proc)
             reported = self.actions.status(task_id)
             if reported is not None and reported.state in ("SUCCESS",
                                                            "FAILED"):

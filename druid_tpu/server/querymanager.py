@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Callable, Dict, List, Optional
+
+from druid_tpu.server.deadline import (Deadline,  # noqa: F401 (re-export)
+                                       context_timeout_ms)
 
 
 class QueryInterruptedError(RuntimeError):
@@ -65,15 +67,6 @@ def cancel_path_id(path: str) -> Optional[str]:
                                       "rows") else None
 
 
-def context_timeout_ms(query) -> Optional[float]:
-    """The query's timeout in ms (context key "timeout"; 0 = unlimited)."""
-    t = query.context_map.get("timeout")
-    if t is None:
-        return None
-    t = float(t)
-    return None if t <= 0 else t
-
-
 def context_priority(query) -> int:
     """Context "priority" (QueryContexts.getPriority) — tagged on query
     metrics/request logs; lane scheduling can build on it."""
@@ -81,30 +74,6 @@ def context_priority(query) -> int:
         return int(query.context_map.get("priority", 0))
     except (TypeError, ValueError):
         return 0
-
-
-class Deadline:
-    """Monotonic deadline; None = unlimited."""
-
-    def __init__(self, timeout_ms: Optional[float]):
-        self._end = None if timeout_ms is None \
-            else time.monotonic() + timeout_ms / 1000.0
-
-    @staticmethod
-    def for_query(query) -> "Deadline":
-        return Deadline(context_timeout_ms(query))
-
-    def remaining_ms(self) -> Optional[float]:
-        if self._end is None:
-            return None
-        return max(0.0, (self._end - time.monotonic()) * 1000.0)
-
-    def expired(self) -> bool:
-        return self._end is not None and time.monotonic() >= self._end
-
-    def check(self) -> None:
-        if self.expired():
-            raise QueryTimeoutError("query timed out")
 
 
 class QueryToken:
@@ -186,6 +155,11 @@ class QueryScheduler:
         self._waiters: List[tuple] = []   # (-priority, seq, event, lane)
         self._seq = 0
 
+    #: longest single park while queued without a caller timeout: the wait
+    #: re-arms after each quantum, so a lost wakeup degrades to one poll
+    #: period instead of a handler thread parked forever
+    MAX_ADMISSION_POLL_S = 30.0
+
     def _admissible(self, lane: Optional[str]) -> bool:
         if self._running >= self.total_slots:
             return False
@@ -200,7 +174,7 @@ class QueryScheduler:
         `should_abort` (e.g. QueryToken.check) is polled while queued and
         may raise to abandon the wait — a DELETE on a queued query must
         free the waiter, not let it run later."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = Deadline.after_s(timeout)
         with self._cond:
             if not self._waiters and self._admissible(lane):
                 self._admit(lane)
@@ -214,7 +188,12 @@ class QueryScheduler:
             self._wake_admissible()
             got_slot = False
             try:
-                while True:
+                # the caller's timeout IS the query's own admitted budget
+                # (context timeoutMs, already defaulted/validated at the
+                # edge), not a raw wire value; each park re-arms within
+                # MAX_ADMISSION_POLL_S and the cancel token is polled, so
+                # an unlimited budget still cannot orphan the waiter
+                while True:  # druidlint: disable=unclamped-external-timeout
                     if should_abort is not None:
                         # BEFORE honoring admission: a cancel that raced a
                         # release must win, or the cancelled query runs
@@ -222,16 +201,14 @@ class QueryScheduler:
                     if ev.is_set():
                         got_slot = True
                         return True
-                    remaining = None if deadline is None \
-                        else deadline - time.monotonic()
-                    if remaining is not None and remaining <= 0:
+                    if deadline.expired():
                         return False
                     if should_abort is not None:
                         # no notification on cancel: poll the token
-                        self._cond.wait(0.1 if remaining is None
-                                        else min(0.1, remaining))
+                        self._cond.wait(deadline.clamp(0.1))
                     else:
-                        self._cond.wait(remaining)
+                        self._cond.wait(
+                            deadline.clamp(self.MAX_ADMISSION_POLL_S))
             finally:
                 if entry in self._waiters:
                     self._waiters.remove(entry)

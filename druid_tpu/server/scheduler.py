@@ -430,9 +430,11 @@ class DataNodeScheduler:
                 continue
             # the batching window: give the oldest arrival's batch-mates
             # time to land before flushing (outside the lock; stop() stays
-            # responsive via the post-sleep re-check)
-            hold = self.config.batch_window_ms / 1000.0 \
-                - (time.monotonic() - oldest)
+            # responsive via the post-sleep re-check). The window anchors
+            # at the oldest enqueue, so the hold is its remaining budget.
+            window = Deadline.until(
+                oldest + self.config.batch_window_ms / 1000.0)
+            hold = window.remaining()
             if hold > 0:
                 time.sleep(hold)
             with self._cond:

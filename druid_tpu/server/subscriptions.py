@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 
 from druid_tpu.engine.standing import StandingQuery
 from druid_tpu.query.model import Query
+from druid_tpu.server.deadline import Deadline
 from druid_tpu.utils.emitter import Monitor
 
 log = logging.getLogger(__name__)
@@ -273,7 +274,7 @@ class SubscriptionHub:
         if not (timeout_s > 0):             # NaN/negative -> immediate
             timeout_s = 0.0
         timeout_s = min(timeout_s, self.MAX_POLL_TIMEOUT_S)
-        deadline = time.monotonic() + timeout_s
+        deadline = Deadline.after_s(timeout_s)
         while True:
             with self._cond:
                 sub = self._subs.get(sub_id)
@@ -283,10 +284,9 @@ class SubscriptionHub:
                 prog = sub.program
                 current = prog.standing.etag()
                 if etag is not None and current == etag:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+                    if deadline.expired():
                         return None, current, False
-                    self._cond.wait(min(remaining, 0.25))
+                    self._cond.wait(deadline.clamp(0.25))
                     continue
             # changed (or unconditional): the merge runs OUTSIDE the hub
             # lock; rows/etag are read as one consistent snapshot

@@ -39,6 +39,23 @@ if os.environ.get("DRUID_TPU_LEAK_WITNESS") == "1":
     from tools.druidlint.leakwitness import session_witness as _leak_witness
     _leak_witness(_root)
 
+# Opt-in whole-suite stall witness (DRUID_TPU_STALL_WITNESS=1): the
+# dynamic side of stallguard. Installed BEFORE the first druid_tpu import
+# so `from time import sleep`-style early bindings cannot escape the
+# wrappers — it patches the blocking primitives themselves (Event/
+# Condition.wait, Thread.join, Queue.get, Popen.wait, time.sleep) and
+# times every park issued from a druid_tpu call site. An untimed park
+# outside a shutdown scope fails the session in pytest_unconfigure. Same
+# process-wide singleton rationale as the other witnesses.
+if os.environ.get("DRUID_TPU_STALL_WITNESS") == "1":
+    import sys as _sys
+    from pathlib import Path as _Path
+    _root = str(_Path(__file__).resolve().parent.parent)
+    if _root not in _sys.path:
+        _sys.path.insert(0, _root)
+    from tools.druidlint.stallwitness import session_witness as _stall_witness
+    _stall_witness(_root)
+
 import jax
 
 # The environment's sitecustomize may have force-registered a TPU plugin and
@@ -161,15 +178,34 @@ def pytest_collection_finish(session):
 
 
 def pytest_unconfigure(config):
-    # a lock-witness violation must not skip the leak or key checks (or
-    # leave hooks monkeypatched): run all three even if an earlier raises
+    # a lock-witness violation must not skip the stall/key/leak checks (or
+    # leave hooks monkeypatched): run all four even if an earlier raises
     try:
         _unconfigure_lock_witness()
     finally:
         try:
-            _unconfigure_key_witness()
+            _unconfigure_stall_witness()
         finally:
-            _unconfigure_leak_witness()
+            try:
+                _unconfigure_key_witness()
+            finally:
+                _unconfigure_leak_witness()
+
+
+def _unconfigure_stall_witness():
+    if os.environ.get("DRUID_TPU_STALL_WITNESS") != "1":
+        return
+    from tools.druidlint.stallwitness import end_session_witness
+    w = end_session_witness()
+    if w is None:
+        return
+    print(f"stallwitness: {w.summary()}")
+    for v in w.violations:
+        print(f"stallwitness: UNTIMED PARK {v}")
+    if w.violations:
+        raise pytest.UsageError(
+            "stall witness found untimed non-shutdown parks (see lines "
+            "above)")
 
 
 def _unconfigure_key_witness():

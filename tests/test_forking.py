@@ -190,6 +190,36 @@ def test_task_log_captured(runner):
     assert "attempt 1" in log    # attempts are 1-based
 
 
+def test_monitor_wait_is_bounded_and_escalates_on_shutdown(tmp_path):
+    """The monitor's park on a live peon is a bounded-quantum loop, not a
+    bare proc.wait(): a shutdown observed between quanta must escalate
+    terminate → kill and return promptly even when the peon is wedged —
+    stop() can never hang behind a peon that stopped answering."""
+    import subprocess
+    import sys
+    import time
+
+    md = MetadataStore()
+    r = ForkingTaskRunner(md, deep_storage_dir=str(tmp_path / "deep"))
+    r.PROC_WAIT_POLL_S = 0.05
+    r.PROC_KILL_GRACE_S = 2.0
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(600)"])
+    try:
+        r._shutdown = True
+        t0 = time.monotonic()
+        r._await_proc(proc)
+        elapsed = time.monotonic() - t0
+        # one poll quantum to notice the shutdown + the terminate grace,
+        # never the peon's 600s sleep
+        assert elapsed < 5.0, f"_await_proc parked {elapsed:.1f}s"
+        assert proc.poll() is not None, "wedged peon was not terminated"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        r.shutdown()
+
+
 def test_forked_kill_task(runner):
     md, r = runner
     recs = _records(400, days=1)

@@ -24,8 +24,9 @@ from tools.druidlint.core import split_by_baseline  # noqa: E402
 
 def test_tree_is_clean_and_fast():
     """`python -m tools.druidlint --all --fail-on-new` — the UNIFIED gate:
-    all five analyzer families (druidlint/tracecheck/raceguard/leakguard/
-    keyguard) in one process over the shared program/cache pass — exits 0 on the
+    all six analyzer families (druidlint/tracecheck/raceguard/leakguard/
+    keyguard/stallguard) in one process over the shared program/cache pass
+    — exits 0 on the
     shipped tree under a single wall-clock budget. The first run may be
     cold (fresh checkout: no .druidlint-cache.json — the whole-program
     index alone costs several seconds); the budget is enforced on the
@@ -44,12 +45,12 @@ def test_tree_is_clean_and_fast():
     assert proc.returncode == 0, (
         f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
     assert elapsed < 10.0, (
-        f"unified gate took {elapsed:.1f}s (budget 10s for all five "
+        f"unified gate took {elapsed:.1f}s (budget 10s for all six "
         f"families together)")
     payload = json.loads(proc.stdout)
     assert set(payload["families"]) == {"druidlint", "tracecheck",
                                         "raceguard", "leakguard",
-                                        "keyguard"}
+                                        "keyguard", "stallguard"}
     for name, info in payload["families"].items():
         assert info["rules"] > 0, f"family {name} registered no rules"
         assert info["findings"] == 0
@@ -64,6 +65,33 @@ def test_all_rejects_only():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 2
     assert "--only" in proc.stderr
+
+
+def test_changed_mode_is_guarded_and_clean():
+    """--changed (the pre-commit gate) exits clean on the shipped tree,
+    and refuses the combinations that would under-scan: rewriting the
+    baseline from a diff-scoped scan would drop every grandfathered
+    finding the diff didn't re-find, and explicit paths contradict a
+    git-derived scope."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--changed",
+         "--update-baseline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "--changed" in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--changed",
+         "druid_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "explicit paths" in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.druidlint", "--changed",
+         "--fail-on-new"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"--changed found new violations:\n{proc.stdout}{proc.stderr}")
+    assert "--changed" in proc.stdout
 
 
 def test_baseline_is_near_empty():
@@ -343,6 +371,45 @@ VIOLATIONS = {
         "import os\n"
         "def plan(col):\n"
         "    return os.environ.get('DRUID_TPU_NO_SUCH_FLAG') == '1'\n"),
+    # ---- stallguard rules (request-path classification in the synthetic
+    # root comes from the built-in HTTP-handler heuristic)
+    "unbounded-blocking-call": (
+        "druid_tpu/server/parky.py",
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        self.server.ready.wait()\n"),
+    "deadline-not-propagated": (
+        "druid_tpu/server/droppy.py",
+        "def fetch(ev, timeout):\n"
+        "    ev.wait()\n"),
+    "unclamped-external-timeout": (
+        "druid_tpu/server/clampy.py",
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        self._poll(float(self.headers['x-t']))\n"
+        "    def _poll(self, timeout_s):\n"
+        "        self.cond.wait(timeout_s)\n"),
+    "sleep-on-request-path": (
+        "druid_tpu/server/sleepy.py",
+        "import time\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        time.sleep(1.0)\n"),
+    "stop-signal-coverage": (
+        "druid_tpu/server/spinny.py",
+        "import threading\n"
+        "class Pump:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._loop)\n"
+        "        self._t.start()\n"
+        "    def _loop(self):\n"
+        "        while True:\n"
+        "            self._step()\n"
+        "    def _step(self):\n"
+        "        pass\n"),
 }
 
 
@@ -372,8 +439,8 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 def test_rule_registry_is_complete():
     """All project rules (nine control-plane incl. metric-name,
     wire-decoded-rows and flag-name + seven tracecheck + four raceguard
-    + five leakguard + three keyguard) plus the unused-suppression audit
-    are registered with severities."""
+    + five leakguard + three keyguard + five stallguard) plus the
+    unused-suppression audit are registered with severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

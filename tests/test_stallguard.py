@@ -1,0 +1,518 @@
+"""stallguard unit battery: each deadline-discipline rule must fire on
+its positive shape, stay quiet on the bounded/propagated/clamped shapes,
+honor per-line suppressions, and the dynamic stall witness must catch
+(and excuse) real parks correctly.
+
+Pattern mirrors tests/test_leakguard.py: check_source with a root-less
+config analyzes each snippet standalone through the real rule registry,
+so suppression/baseline behavior is exactly the shipped one. Request-path
+classification in these fixtures comes from the built-in HTTP-handler
+heuristic (a BaseHTTPRequestHandler subclass) — the shipped pyproject
+additionally seeds broker/scheduler/hub roots via
+stallguard-request-roots, which test_request_roots_config covers.
+"""
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.druidlint.core import LintConfig, check_source  # noqa: E402
+
+
+def cfg(*rules) -> LintConfig:
+    c = LintConfig(rules=list(rules) if rules else [])
+    c.root = "/nonexistent-stallguard-root"
+    return c
+
+
+def findings_of(source: str, rule: str, path: str = "druid_tpu/mod.py",
+                config: LintConfig = None):
+    return [f for f in check_source(source, path, config or cfg(rule))
+            if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# unbounded-blocking-call
+# ---------------------------------------------------------------------------
+
+def test_handler_park_without_timeout_fires():
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.server.ready.wait()
+"""
+    got = findings_of(src, "unbounded-blocking-call")
+    assert len(got) == 1
+    assert "no timeout" in got[0].message
+
+
+def test_handler_park_reached_through_helper_fires():
+    # the rule is whole-program: the park sits two call edges below the
+    # handler and must still be attributed to the request path
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self._serve()
+
+    def _serve(self):
+        self._gather()
+
+    def _gather(self):
+        self.server.done_q.get()
+"""
+    got = findings_of(src, "unbounded-blocking-call")
+    assert len(got) == 1
+    assert "HTTP handler" in got[0].message
+
+
+def test_handler_park_with_timeout_is_quiet():
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.server.ready.wait(5.0)
+        self.server.done_q.get(True, 2.0)
+        self.server.worker.join(timeout=1.0)
+"""
+    assert findings_of(src, "unbounded-blocking-call") == []
+
+
+def test_park_off_request_path_is_quiet():
+    # same park, no handler anywhere: duty-thread code answers to
+    # stop-signal-coverage, not to the request-path rule
+    src = """\
+class Pump:
+    def drain(self):
+        self.ready.wait()
+"""
+    assert findings_of(src, "unbounded-blocking-call") == []
+
+
+def test_str_join_is_not_a_park():
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = ", ".join(self.parts)
+        sep = "-"
+        key = sep.join(body)
+        self.wfile.write(key.encode())
+"""
+    assert findings_of(src, "unbounded-blocking-call") == []
+
+
+def test_request_roots_config():
+    # no handler class: the entry point runs on a request thread only
+    # because config says so, and the park it reaches must then fire
+    src = """\
+class Hub:
+    def poll(self):
+        self._cond.wait()
+"""
+    c = cfg("unbounded-blocking-call")
+    c.stallguard_request_roots = ["druid_tpu/*::Hub.poll"]
+    assert len(findings_of(src, "unbounded-blocking-call",
+                           config=c)) == 1
+    assert findings_of(src, "unbounded-blocking-call") == []
+
+
+def test_unbounded_park_suppression():
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.server.ready.wait()  # druidlint: disable=unbounded-blocking-call
+"""
+    assert findings_of(src, "unbounded-blocking-call") == []
+
+
+# ---------------------------------------------------------------------------
+# deadline-not-propagated
+# ---------------------------------------------------------------------------
+
+def test_budget_param_ignored_by_park_fires():
+    src = """\
+def fetch(ev, timeout):
+    ev.wait()
+"""
+    got = findings_of(src, "deadline-not-propagated")
+    assert len(got) == 1
+    assert "timeout" in got[0].message
+
+
+def test_budget_threaded_into_park_is_quiet():
+    src = """\
+def fetch(ev, timeout):
+    ev.wait(timeout)
+"""
+    assert findings_of(src, "deadline-not-propagated") == []
+
+
+def test_budget_derived_value_counts_as_propagated():
+    # remaining = f(deadline) flows through a local before the park
+    src = """\
+def fetch(cond, deadline):
+    remaining = deadline.remaining()
+    cond.wait(remaining)
+"""
+    assert findings_of(src, "deadline-not-propagated") == []
+
+
+def test_poll_loop_consulting_deadline_is_quiet():
+    # the scheduler's _await idiom: fixed-quantum park, budget re-checked
+    # every iteration — the budget is honored by the LOOP, not the park
+    src = """\
+def await_done(ev, deadline):
+    while True:
+        if ev.wait(0.05):
+            return True
+        deadline.check()
+"""
+    assert findings_of(src, "deadline-not-propagated") == []
+
+
+def test_deadline_typed_param_without_budget_name_fires():
+    # the shared Deadline type marks the param as a budget even when its
+    # name says nothing — the satellite type is the analyzer's anchor
+    src = """\
+def gather(ev, budget: "Deadline"):
+    ev.wait()
+"""
+    assert len(findings_of(src, "deadline-not-propagated")) == 1
+
+
+def test_deadline_not_propagated_suppression():
+    src = """\
+def fetch(ev, timeout):
+    ev.wait()  # druidlint: disable=deadline-not-propagated
+"""
+    assert findings_of(src, "deadline-not-propagated") == []
+
+
+# ---------------------------------------------------------------------------
+# unclamped-external-timeout
+# ---------------------------------------------------------------------------
+
+_POLL_TMPL = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self._poll(float(self.headers["x-timeout"]))
+
+    def _poll(self, timeout_s):
+{body}
+"""
+
+
+def test_wire_timeout_reaching_park_unclamped_fires():
+    src = _POLL_TMPL.format(body="        self.cond.wait(timeout_s)")
+    got = findings_of(src, "unclamped-external-timeout")
+    assert len(got) == 1
+    assert "unclamped" in got[0].message
+
+
+def test_wire_timeout_clamped_by_min_is_quiet():
+    src = _POLL_TMPL.format(
+        body="        timeout_s = min(timeout_s, 60.0)\n"
+             "        self.cond.wait(timeout_s)")
+    assert findings_of(src, "unclamped-external-timeout") == []
+
+
+def test_wire_timeout_bounding_a_park_loop_fires():
+    # the PR 14 shape: per-park quantum is clamped, but the LOOP runs
+    # until a deadline built from the raw wire value — the handler is
+    # still parked for as long as the wire asked
+    src = _POLL_TMPL.format(
+        body="        deadline = Deadline.after_s(timeout_s)\n"
+             "        while True:\n"
+             "            if deadline.expired():\n"
+             "                return None\n"
+             "            self.cond.wait(0.25)")
+    got = findings_of(src, "unclamped-external-timeout")
+    assert len(got) == 1
+    assert "loop" in got[0].message
+
+
+def test_clamped_deadline_bounding_a_park_loop_is_quiet():
+    src = _POLL_TMPL.format(
+        body="        timeout_s = min(timeout_s, 60.0)\n"
+             "        deadline = Deadline.after_s(timeout_s)\n"
+             "        while True:\n"
+             "            if deadline.expired():\n"
+             "                return None\n"
+             "            self.cond.wait(0.25)")
+    assert findings_of(src, "unclamped-external-timeout") == []
+
+
+def test_unclamped_timeout_suppression():
+    src = _POLL_TMPL.format(
+        body="        self.cond.wait(timeout_s)"
+             "  # druidlint: disable=unclamped-external-timeout")
+    assert findings_of(src, "unclamped-external-timeout") == []
+
+
+# ---------------------------------------------------------------------------
+# sleep-on-request-path
+# ---------------------------------------------------------------------------
+
+def test_fixed_sleep_on_handler_path_fires():
+    src = """\
+import time
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        time.sleep(1.0)
+"""
+    got = findings_of(src, "sleep-on-request-path")
+    assert len(got) == 1
+    assert "jitter" in got[0].message
+
+
+def test_jittered_deadline_guarded_sleep_is_quiet():
+    # the remote client's 429 back-off shape: pause from
+    # decorrelated_jitter, guarded by the remaining deadline
+    src = """\
+import time
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        deadline = Deadline.after_s(5.0)
+        sleep_s = decorrelated_jitter(0.05, 1.0, self.prev)
+        if sleep_s < deadline.remaining():
+            time.sleep(sleep_s)
+"""
+    assert findings_of(src, "sleep-on-request-path") == []
+
+
+def test_sleep_off_request_path_is_quiet():
+    src = """\
+import time
+
+def backoff():
+    time.sleep(1.0)
+"""
+    assert findings_of(src, "sleep-on-request-path") == []
+
+
+def test_sleep_suppression():
+    src = """\
+import time
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        time.sleep(1.0)  # druidlint: disable=sleep-on-request-path
+"""
+    assert findings_of(src, "sleep-on-request-path") == []
+
+
+# ---------------------------------------------------------------------------
+# stop-signal-coverage
+# ---------------------------------------------------------------------------
+
+_THREAD_TMPL = """\
+import threading
+
+class Pump:
+    def start(self):
+        self._stopping = False
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def _loop(self):
+{body}
+
+    def _step(self):
+        pass
+"""
+
+
+def test_thread_loop_without_stop_consult_fires():
+    src = _THREAD_TMPL.format(body="        while True:\n"
+                                   "            self._step()")
+    got = findings_of(src, "stop-signal-coverage")
+    assert len(got) == 1
+    assert "stop signal" in got[0].message
+
+
+def test_thread_loop_checking_stop_flag_is_quiet():
+    src = _THREAD_TMPL.format(body="        while True:\n"
+                                   "            if self._stopping:\n"
+                                   "                return\n"
+                                   "            self._step()")
+    assert findings_of(src, "stop-signal-coverage") == []
+
+
+def test_thread_loop_waiting_on_stop_event_is_quiet():
+    # latch.py's idiom: the loop condition IS the stop event
+    src = _THREAD_TMPL.format(body="        while True:\n"
+                                   "            if self._stop_event"
+                                   ".wait(0.5):\n"
+                                   "                return\n"
+                                   "            self._step()")
+    assert findings_of(src, "stop-signal-coverage") == []
+
+
+def test_bounded_loop_in_thread_root_is_quiet():
+    src = """\
+import threading
+
+class Pump:
+    def start(self):
+        self._t = threading.Thread(target=self._drain)
+        self._t.start()
+
+    def _drain(self):
+        for item in self.items:
+            self._step(item)
+
+    def _step(self, item):
+        pass
+"""
+    assert findings_of(src, "stop-signal-coverage") == []
+
+
+def test_stop_coverage_suppression():
+    src = _THREAD_TMPL.format(
+        body="        while True:"
+             "  # druidlint: disable=stop-signal-coverage\n"
+             "            self._step()")
+    assert findings_of(src, "stop-signal-coverage") == []
+
+
+# ---------------------------------------------------------------------------
+# module scoping: stallguard rides the raceguard member set
+# ---------------------------------------------------------------------------
+
+def test_non_member_module_is_ignored():
+    src = """\
+from http.server import BaseHTTPRequestHandler
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.server.ready.wait()
+"""
+    assert findings_of(src, "unbounded-blocking-call",
+                       path="scripts/helper.py") == []
+
+
+# ---------------------------------------------------------------------------
+# dynamic stall witness
+# ---------------------------------------------------------------------------
+
+def _witness(tmp_path):
+    """A witness rooted at a temp tree with one fake druid_tpu module, so
+    eligibility sees 'project' call sites without touching the real
+    session singleton."""
+    from tools.druidlint.stallwitness import StallWitness
+    pkg = tmp_path / "druid_tpu"
+    pkg.mkdir()
+    return StallWitness(str(tmp_path)), pkg
+
+
+def _run_site(pkg, body: str):
+    """Compile `body` as a druid_tpu-resident function and run it — the
+    witness's caller-frame eligibility keys on the code object's
+    filename."""
+    site = pkg / "parksite.py"
+    site.write_text(body)
+    code = compile(body, str(site), "exec")
+    ns = {}
+    exec(code, ns)
+    return ns["park"]()
+
+
+def test_witness_flags_untimed_park(tmp_path):
+    w, pkg = _witness(tmp_path)
+    with w:
+        _run_site(pkg, """\
+import threading
+
+def park():
+    ev = threading.Event()
+    ev.set()
+    ev.wait()
+""")
+    assert len(w.violations) == 1
+    assert "untimed event-wait" in w.violations[0]
+
+
+def test_witness_passes_timed_park(tmp_path):
+    w, pkg = _witness(tmp_path)
+    with w:
+        _run_site(pkg, """\
+import threading
+
+def park():
+    ev = threading.Event()
+    ev.wait(0.01)
+""")
+    assert w.violations == []
+    ((site, stats),) = w.sites.items()
+    assert site[2] == "event-wait"
+    assert stats["count"] == 1
+    assert stats["max_s"] >= 0.01
+
+
+def test_witness_excuses_shutdown_scoped_park(tmp_path):
+    w, pkg = _witness(tmp_path)
+    with w:
+        _run_site(pkg, """\
+import threading
+
+def _drain_forever(ev):
+    ev.wait()
+
+def stop(ev):
+    _drain_forever(ev)
+
+def park():
+    ev = threading.Event()
+    ev.set()
+    stop(ev)
+""")
+    # recorded as untimed, but excused: a stop() frame is on the stack
+    assert w.violations == []
+    assert sum(int(s["untimed"]) for s in w.sites.values()) == 1
+
+
+def test_witness_ignores_foreign_call_sites(tmp_path):
+    w, _pkg = _witness(tmp_path)
+    with w:
+        ev = threading.Event()
+        ev.set()
+        ev.wait()                 # this file is not under tmp_path
+        time.sleep(0.001)
+    assert w.sites == {}
+    assert w.violations == []
+
+
+def test_witness_uninstall_restores_primitives(tmp_path):
+    import queue
+    import subprocess
+    originals = (threading.Event.wait, threading.Condition.wait,
+                 threading.Thread.join, queue.Queue.get,
+                 subprocess.Popen.wait, time.sleep)
+    w, _pkg = _witness(tmp_path)
+    w.install()
+    try:
+        assert threading.Event.wait is not originals[0]
+    finally:
+        w.uninstall()
+    assert (threading.Event.wait, threading.Condition.wait,
+            threading.Thread.join, queue.Queue.get,
+            subprocess.Popen.wait, time.sleep) == originals

@@ -6,11 +6,14 @@
     python -m tools.druidlint --update-baseline  # grandfather current state
     python -m tools.druidlint --list-rules
     python -m tools.druidlint druid_tpu/engine   # restrict scan paths
+    python -m tools.druidlint --changed          # pre-commit: scan only
+                                                 # git-modified modules
 """
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -19,11 +22,54 @@ from tools.druidlint.core import (family_of, lint_paths, load_baseline,
                                   load_config, registered_rules,
                                   save_baseline, split_by_baseline)
 
-#: the five analyzer families --all asserts are all registered and runs in
+#: the six analyzer families --all asserts are all registered and runs in
 #: ONE process over ONE shared program/cache pass (tier-1 used to pay the
 #: whole-program index once per analyzer CLI invocation)
 _ALL_FAMILIES = ("druidlint", "tracecheck", "raceguard", "leakguard",
-                 "keyguard")
+                 "keyguard", "stallguard")
+
+
+def _changed_paths(root: Path):
+    """Repo-relative paths touched since HEAD (worktree modifications plus
+    untracked files), or None when git cannot answer — the caller falls
+    back to a full scan rather than silently under-scanning."""
+    out = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    return sorted(set(out))
+
+
+def _scan_scope_for_changed(root: Path, config) -> object:
+    """Scan scope for --changed: a list of changed member .py files, or
+    None meaning FULL scan. Full scan happens when git is unavailable or
+    when the cache's meta signature went stale (analyzer sources, config,
+    or any program-set module changed): whole-program families can grow
+    findings in UNCHANGED modules then, so a diff-scoped scan would lie."""
+    changed = _changed_paths(root)
+    if changed is None:
+        return None
+    from tools.druidlint.core import _cache_meta_sig
+    cache_file = root / ".druidlint-cache.json"
+    try:
+        meta = json.loads(cache_file.read_text()).get("meta")
+    except (OSError, ValueError):
+        meta = None
+    if meta != _cache_meta_sig(root, config):
+        return None
+    include = [p.rstrip("/") for p in config.include]
+    scope = [p for p in changed
+             if p.endswith(".py") and (root / p).exists()
+             and any(p == inc or p.startswith(inc + "/")
+                     for inc in include)]
+    return scope
 
 
 def main(argv=None) -> int:
@@ -50,12 +96,18 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", action="store_true",
                     help="print the raceguard lock-order graph as graphviz "
                          "DOT (cycle members red) and exit")
+    ap.add_argument("--changed", action="store_true",
+                    help="pre-commit mode: scan only modules touched since "
+                         "HEAD (git diff + untracked). Falls back to a "
+                         "FULL scan when git is unavailable or the shared "
+                         "program index changed (whole-program findings "
+                         "can move across modules then)")
     ap.add_argument("--all", action="store_true", dest="all_families",
-                    help="unified gate: assert all five analyzer families "
+                    help="unified gate: assert all six analyzer families "
                          "(druidlint/tracecheck/raceguard/leakguard/"
-                         "keyguard) are registered, run them in one process "
-                         "over the shared caches, and report findings per "
-                         "family")
+                         "keyguard/stallguard) are registered, run them in "
+                         "one process over the shared caches, and report "
+                         "findings per family")
     args = ap.parse_args(argv)
 
     if args.all_families and args.only:
@@ -63,11 +115,17 @@ def main(argv=None) -> int:
               "with --only", file=sys.stderr)
         return 2
 
-    if args.update_baseline and (args.paths or args.only):
+    if args.update_baseline and (args.paths or args.only or args.changed):
         # a partial scan (by path OR by rule subset) would overwrite — and
         # so silently drop — every grandfathered finding it didn't re-find
         print("druidlint: --update-baseline requires a full scan — do not "
-              "pass explicit paths or --only with it", file=sys.stderr)
+              "pass explicit paths, --only, or --changed with it",
+              file=sys.stderr)
+        return 2
+
+    if args.changed and args.paths:
+        print("druidlint: --changed derives its own scan scope from git; "
+              "it cannot be combined with explicit paths", file=sys.stderr)
         return 2
 
     if args.list_rules:
@@ -108,14 +166,31 @@ def main(argv=None) -> int:
         else root / config.baseline
     cache_path = None if args.no_cache else root / ".druidlint-cache.json"
 
+    scan_paths = args.paths or None
+    changed_scope = None
+    if args.changed:
+        changed_scope = _scan_scope_for_changed(root, config)
+        if changed_scope is not None:
+            scan_paths = changed_scope
+
     t0 = time.monotonic()
-    try:
-        findings = lint_paths(root, config, args.paths or None,
-                              cache_path=cache_path)
-    except ValueError as e:
-        print(f"druidlint: {e}", file=sys.stderr)
-        return 2
+    if changed_scope == []:
+        findings = []                 # nothing touched: nothing to scan
+    else:
+        try:
+            findings = lint_paths(root, config, scan_paths,
+                                  cache_path=cache_path)
+        except ValueError as e:
+            print(f"druidlint: {e}", file=sys.stderr)
+            return 2
     elapsed = time.monotonic() - t0
+    if args.changed and not args.as_json:
+        if changed_scope is None:
+            print("druidlint: --changed: full scan (git unavailable or "
+                  "the shared program index changed)")
+        else:
+            print(f"druidlint: --changed: {len(changed_scope)} touched "
+                  f"module(s) in scope")
 
     if args.update_baseline:
         save_baseline(baseline_path, findings)

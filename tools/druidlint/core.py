@@ -81,6 +81,7 @@ def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import raceguard as _raceguard  # noqa: F401
     from tools.druidlint import leakguard as _leakguard  # noqa: F401
     from tools.druidlint import keyguard as _keyguard  # noqa: F401
+    from tools.druidlint import stallguard as _stallguard  # noqa: F401
     return dict(_RULES)
 
 
@@ -88,7 +89,7 @@ def registered_rules() -> Dict[str, Rule]:
 #: unified `--all` runner groups findings and timings by this
 _FAMILIES = {"rules": "druidlint", "tracecheck": "tracecheck",
              "raceguard": "raceguard", "leakguard": "leakguard",
-             "keyguard": "keyguard"}
+             "keyguard": "keyguard", "stallguard": "stallguard"}
 
 
 def family_of(r: Rule) -> str:
@@ -176,6 +177,12 @@ _DEFAULT_CONFIG = {
                              "druid_tpu/data/cascade.py::run_domain_probe",
                              "druid_tpu/data/packed.py::plan_columns",
                              "druid_tpu/cluster/view.py::*.fusable"],
+    # stallguard: request-path entry points the handler heuristic cannot
+    # see, as "path-glob::qual-glob" — functions that run ON a request
+    # thread (the long-poll hub entry, the scheduler admission gate);
+    # everything they reach through the call graph inherits the
+    # request-path park rules
+    "stallguard-request-roots": [],
     # unused-suppression audit (CLI --report-unused-suppressions)
     "report-unused-suppressions": False,
 }
@@ -227,6 +234,9 @@ class LintConfig:
     keyguard_eligibility: List[str] = field(
         default_factory=lambda: list(
             _DEFAULT_CONFIG["keyguard-eligibility"]))
+    stallguard_request_roots: List[str] = field(
+        default_factory=lambda: list(
+            _DEFAULT_CONFIG["stallguard-request-roots"]))
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
     #: (set by load_config/lint_paths, not a pyproject key)

@@ -457,7 +457,40 @@ _RETRYABLE_ERRORS = {"ConnectionError", "ConnectionResetError",
 
 #: a call with one of these attrs on a receiver named like a deadline
 #: counts as consulting the bound
-_DEADLINE_CONSULTS = {"check", "expired", "remaining_ms", "remaining"}
+_DEADLINE_CONSULTS = {"check", "expired", "remaining_ms", "remaining",
+                      "clamp"}
+
+
+def _deadline_names(tree: ast.AST) -> Set[str]:
+    """Names bound to the shared Deadline type anywhere in the module:
+    locals assigned from `Deadline(...)` / `Deadline.for_query(...)` /
+    `.after_s(...)` / `.until(...)`, and parameters annotated `Deadline`.
+    A consult through one of these counts even when the receiver is not
+    named "*deadline*" (server/deadline.py is the one carrier type; the
+    name heuristic alone would miss e.g. `window.remaining()`)."""
+    out: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            v = n.value
+            is_dl = isinstance(v, ast.Call) and (
+                _terminal(v.func) == "Deadline"
+                or (isinstance(v.func, ast.Attribute)
+                    and _terminal(v.func.value) == "Deadline"))
+            if not is_dl:
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(n, _FUNC_DEFS):
+            args = n.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                ann = a.annotation
+                if (isinstance(ann, ast.Name) and ann.id == "Deadline") or \
+                        (isinstance(ann, ast.Constant)
+                         and ann.value == "Deadline"):
+                    out.add(a.arg)
+    return out
 
 
 def _same_loop_children(stmts) -> Iterable[ast.AST]:
@@ -496,16 +529,18 @@ def _handler_retries(handler: ast.ExceptHandler) -> bool:
     return not isinstance(last, (ast.Raise, ast.Return, ast.Break))
 
 
-def _consults_deadline(loop: ast.AST) -> bool:
+def _consults_deadline(loop: ast.AST,
+                       dl_names: Set[str] = frozenset()) -> bool:
     for n in ast.walk(loop):
         if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
-                and n.func.attr in _DEADLINE_CONSULTS \
-                and "deadline" in _terminal(n.func.value).lower():
-            return True
+                and n.func.attr in _DEADLINE_CONSULTS:
+            recv = _terminal(n.func.value)
+            if "deadline" in recv.lower() or recv in dl_names:
+                return True
     return False
 
 
-def _loop_bounded(loop) -> bool:
+def _loop_bounded(loop, dl_names: Set[str] = frozenset()) -> bool:
     if isinstance(loop, ast.For):
         it = loop.iter
         if isinstance(it, (ast.Tuple, ast.List, ast.Set)):
@@ -518,7 +553,7 @@ def _loop_bounded(loop) -> bool:
             return True
     elif isinstance(loop.test, ast.Compare):
         return True                        # while attempt < self.max_...
-    return _consults_deadline(loop)
+    return _consults_deadline(loop, dl_names)
 
 
 @rule("unbounded-retry", "error",
@@ -536,13 +571,14 @@ def check_unbounded_retry(ctx: ModuleContext) -> Iterable[Finding]:
     suite's no-hang contract forbids."""
     if not ctx.path_matches(ctx.config.retry_modules):
         return
+    dl_names = _deadline_names(ctx.tree)
     for loop in ast.walk(ctx.tree):
         if not isinstance(loop, (ast.For, ast.While)):
             continue
         handlers = [n for n in _same_loop_children(loop.body)
                     if isinstance(n, ast.ExceptHandler)
                     and _catches_retryable(n) and _handler_retries(n)]
-        if not handlers or _loop_bounded(loop):
+        if not handlers or _loop_bounded(loop, dl_names):
             continue
         for h in handlers:
             yield ctx.finding(

@@ -1,0 +1,243 @@
+"""stallwitness: a dynamic witness for stallguard's deadline discipline.
+
+stallguard proves statically that every request-path park carries a
+bound — but a static bound is a claim about ARGUMENTS, not about time:
+a park can receive a "timeout" that is None at runtime, or a bound so
+large it is indistinguishable from forever. The witness closes that loop
+by observing reality: it wraps the blocking primitives the analyzer
+models (`threading.Event.wait`, `threading.Condition.wait`,
+`threading.Thread.join`, `queue.Queue.get`, `subprocess.Popen.wait`,
+`time.sleep`) and, for every park issued FROM a druid_tpu source site,
+records the site, whether a timeout was actually supplied, and the
+longest observed park duration. An UNTIMED park on any thread that is
+not inside a shutdown scope is a violation — exactly the
+parked-forever handler the static rules exist to prevent, caught in
+vivo.
+
+Mechanics:
+  * install() swaps the class/module attributes for recording wrappers
+    (keywitness's `_saved` restore-in-reverse idiom). Eligibility is
+    lockwitness's caller-frame rule: the immediate caller's file must be
+    repo-relative under a configured prefix, so stdlib-internal parks
+    (Event.wait delegating to Condition.wait inside threading.py) are
+    neither double-counted nor misattributed, and test code parks free.
+  * An untimed park is excused only in a SHUTDOWN SCOPE: some frame on
+    the current stack is a recognized teardown entry (stop/close/
+    shutdown/__exit__/cleanup/terminate/...). Joining a worker forever
+    from stop() is a policy choice; parking a request thread forever is
+    a bug.
+  * `threading.Lock.acquire` is a C slot on an extension type and cannot
+    be patched; lock parks are lockwitness's domain (its WitnessLock
+    wrapper already times acquisition). Socket/HTTP parks are bounded at
+    the urlopen(timeout=...) layer, which stallguard checks statically.
+  * time.sleep is recorded (max-duration ledger) but always counts as
+    timed — its bound IS its argument; the static sleep-on-request-path
+    rule owns the policy question.
+
+Session mode mirrors lock/leak/keywitness: DRUID_TPU_STALL_WITNESS=1
+installs a process-wide singleton from tests/conftest.py (BEFORE
+druid_tpu imports, so `from time import sleep`-style early bindings
+cannot escape it) and fails the run on any untimed non-shutdown park in
+pytest_unconfigure. The chaos harness's dead/slow/hang scenarios are
+the stress leg: a wedged peer must produce bounded, timed parks only.
+
+Test-only: nothing in druid_tpu imports this module.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: process-wide session witness (see session_witness)
+_SESSION: Optional["StallWitness"] = None
+
+
+def session_witness(root: Optional[str] = None,
+                    prefixes: Sequence[str] = ("druid_tpu",)) \
+        -> Optional["StallWitness"]:
+    """Install (once) and return the process-wide witness; with root=None
+    just return whatever is installed."""
+    global _SESSION
+    if _SESSION is None and root is not None:
+        _SESSION = StallWitness(root, prefixes).install()
+    return _SESSION
+
+
+def end_session_witness() -> Optional["StallWitness"]:
+    """Uninstall and return the session witness (None if never armed)."""
+    global _SESSION
+    w, _SESSION = _SESSION, None
+    if w is not None:
+        w.uninstall()
+    return w
+
+
+#: a frame with one of these co_names anywhere up-stack marks the park as
+#: shutdown-scoped: an untimed park is a deliberate drain, not a stall
+_SHUTDOWN_SCOPES = frozenset({
+    "stop", "close", "shutdown", "terminate", "cleanup", "uninstall",
+    "__exit__", "__del__", "atexit_handler", "_await_proc",
+    "stop_server", "join_all", "drain", "pytest_unconfigure",
+    "end_session_witness",
+})
+
+#: how far up the stack the shutdown-scope probe walks; teardown entries
+#: sit near the top of test/fixture stacks, but 25 frames covers every
+#: real chain in the suite without paying a full stack unwind per park
+_SCOPE_PROBE_DEPTH = 25
+
+Site = Tuple[str, int, str]              # (rel_path, line, primitive)
+
+
+def _timeout_pos(pos: int):
+    """Timeout extractor for a bound method whose timeout is positional
+    argument `pos` (self included) or the `timeout` keyword."""
+    def of(args, kwargs):
+        t = args[pos] if len(args) > pos else kwargs.get("timeout")
+        return t is not None
+    return of
+
+
+def _queue_get_timed(args, kwargs):
+    block = args[1] if len(args) > 1 else kwargs.get("block", True)
+    if block is False:
+        return True                      # non-blocking get cannot park
+    t = args[2] if len(args) > 2 else kwargs.get("timeout")
+    return t is not None
+
+
+class StallWitness:
+    """Times real parks at druid_tpu call sites; untimed parks outside a
+    shutdown scope are violations."""
+
+    def __init__(self, root: str, prefixes: Sequence[str] = ("druid_tpu",)):
+        self.root = os.path.abspath(root)
+        self.prefixes = tuple(prefixes)
+        self._lock = threading.Lock()
+        #: site -> {"count", "untimed", "max_s"}
+        self.sites: Dict[Site, Dict[str, float]] = {}
+        self.violations: List[str] = []
+        self._saved: List[Tuple[object, str, object]] = []
+        self._installed = False
+
+    # -- eligibility (lockwitness's one rule) ------------------------------
+
+    def _rel_under_prefixes(self, path: str) -> Optional[str]:
+        path = os.path.abspath(path)
+        if not path.startswith(self.root + os.sep):
+            return None
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        if not any(rel.startswith(p.rstrip("/") + "/") or rel == p
+                   for p in self.prefixes):
+            return None
+        return rel
+
+    @staticmethod
+    def _shutdown_scoped(frame) -> bool:
+        f, depth = frame, 0
+        while f is not None and depth < _SCOPE_PROBE_DEPTH:
+            if f.f_code.co_name in _SHUTDOWN_SCOPES:
+                return True
+            f = f.f_back
+            depth += 1
+        return False
+
+    # -- ledger ------------------------------------------------------------
+
+    def _record(self, site: Site, timed: bool, dur_s: float,
+                shutdown: bool, thread_name: str) -> None:
+        with self._lock:
+            st = self.sites.setdefault(
+                site, {"count": 0, "untimed": 0, "max_s": 0.0})
+            st["count"] += 1
+            st["max_s"] = max(st["max_s"], dur_s)
+            if not timed:
+                st["untimed"] += 1
+                if not shutdown:
+                    self.violations.append(
+                        f"{site[0]}:{site[1]}: untimed {site[2]} park on "
+                        f"thread {thread_name!r} outside any shutdown "
+                        f"scope (parked {dur_s:.3f}s this time; nothing "
+                        f"bounds the next one)")
+
+    # -- install/uninstall -------------------------------------------------
+
+    def install(self) -> "StallWitness":
+        if self._installed:
+            return self
+        witness = self
+
+        def wrap(owner, attr, kind, timed_of):
+            real = getattr(owner, attr)
+
+            def wrapped(*args, **kwargs):
+                f = sys._getframe(1)
+                rel = witness._rel_under_prefixes(f.f_code.co_filename)
+                if rel is None:
+                    return real(*args, **kwargs)
+                site = (rel, f.f_lineno, kind)
+                timed = timed_of(args, kwargs)
+                shutdown = witness._shutdown_scoped(f)
+                t0 = time.monotonic()
+                try:
+                    return real(*args, **kwargs)
+                finally:
+                    witness._record(site, timed,
+                                    time.monotonic() - t0, shutdown,
+                                    threading.current_thread().name)
+
+            wrapped.__name__ = getattr(real, "__name__", attr)
+            witness._saved.append((owner, attr, real))
+            setattr(owner, attr, wrapped)
+
+        always = lambda args, kwargs: True  # noqa: E731
+        wrap(threading.Event, "wait", "event-wait", _timeout_pos(1))
+        wrap(threading.Condition, "wait", "cond-wait", _timeout_pos(1))
+        wrap(threading.Thread, "join", "thread-join", _timeout_pos(1))
+        wrap(queue.Queue, "get", "queue-get", _queue_get_timed)
+        wrap(subprocess.Popen, "wait", "proc-wait", _timeout_pos(1))
+        wrap(time, "sleep", "sleep", always)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for obj, attr, original in reversed(self._saved):
+            setattr(obj, attr, original)
+        self._saved.clear()
+        self._installed = False
+
+    def __enter__(self) -> "StallWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- reporting ---------------------------------------------------------
+
+    def max_park_s(self) -> float:
+        with self._lock:
+            return max((st["max_s"] for st in self.sites.values()),
+                       default=0.0)
+
+    def summary(self) -> str:
+        with self._lock:
+            n_sites = len(self.sites)
+            n_parks = sum(int(st["count"]) for st in self.sites.values())
+            untimed = sum(int(st["untimed"]) for st in self.sites.values())
+            longest = max(self.sites.items(),
+                          key=lambda kv: kv[1]["max_s"], default=None)
+        out = (f"stall witness: {n_parks} park(s) at {n_sites} site(s), "
+               f"{untimed} untimed (shutdown-scoped or flagged), "
+               f"{len(self.violations)} violation(s)")
+        if longest is not None:
+            (rel, line, kind), st = longest
+            out += (f"; longest {st['max_s']:.3f}s "
+                    f"({kind} at {rel}:{line})")
+        return out
