@@ -2,14 +2,18 @@
 batched-vs-per-segment dispatch-amortization comparison.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
-"per_segment_rate", "batched_rate", "batch_speedup", "packed_rate",
+"per_segment_rate", "batched_rate", "batch_speedup",
+"sharded_decoded_rate", "sharded_packed_rate", "sharded_merge_host_ms",
+"sharded_merge_device_ms", "packed_rate",
 "filter_host_rate", "filter_device_rate", "filter_cache_hit_rate",
 "decoded_rate", "pack_ratio", "fused_rate", "staged_rate",
 "dispatch_count_fused", "dispatch_count_staged", "donated_tick_rate",
 "rle_rate", "packed_only_rate", "cascade_ratio", "code_domain_rate",
 "v1_load_rate", "v2_load_rate", "disk_ratio", "wire_bytes_v1",
 "wire_bytes_v2", "hll_log2m12_rate",
-"untraced_rate", "traced_rate", "trace_overhead"} — packed_* compare
+"untraced_rate", "traced_rate", "trace_overhead"} — sharded_* compare
+compressed-resident vs decoded cold-stack mesh execution plus the warm
+device-merged vs host-merged tail; packed_* compare
 compressed-domain vs decoded staging on the cold-miss H2D path; fused_*
 compare the one-dispatch megakernel path vs the staged fill-wave path on
 cold queries (dispatch_count_fused must be exactly 1); traced_* track
@@ -257,6 +261,95 @@ def _bench_batching(iters: int):
         "batch_speedup": round(rates["batched"] / rates["per_segment"], 2),
         "batch_segments": n_segments,
         "batch_fill_ratio": round(fill, 3),
+    }
+
+
+def _bench_sharded(iters: int):
+    """Pod-scale mesh comparison over the batch-shape segments: the
+    compressed-resident sharded path (one shard_map dispatch, partials
+    merged in-program with collectives) on whatever mesh the backend
+    offers. The rate pair is COLD-STACK: the stacked block is released
+    before every timed iteration so each run pays the full stack-build +
+    H2D tax — once compressed-resident (packed words + cascade
+    descriptors ride the mesh and decode in-program) and once decoded.
+    The merge pair is WARM and times the two tail disciplines over
+    identical segments: the meshless path (per-segment/batched dispatch,
+    partials merged on the host — the broker tail the sharded path
+    replaced) vs the single sharded dispatch."""
+    import jax
+
+    from druid_tpu.data import cascade as cascade_mod
+    from druid_tpu.data import packed as packed_mod
+    from druid_tpu.data.devicepool import device_pool
+    from druid_tpu.engine.executor import QueryExecutor
+    from druid_tpu.parallel import distributed, make_mesh, use_mesh
+
+    n_dev = len(jax.devices())
+    n_segments = int(os.environ.get("DRUID_TPU_BENCH_BATCH_SEGMENTS", 16))
+    rows_per_seg = int(os.environ.get("DRUID_TPU_BENCH_BATCH_ROWS", 4096))
+    segments = headline_segments(rows_per_seg * n_segments, n_segments)
+    total_rows = sum(s.n_rows for s in segments)
+    query = batch_groupby()
+    executor = QueryExecutor(segments)
+    mesh = make_mesh()
+    before = distributed.sharded_stats().snapshot()
+
+    def timed_sharded(label, cold_stack):
+        with use_mesh(mesh):
+            t = time.time()
+            executor.run(query)
+            log(f"sharded-bench warmup {label}: {time.time() - t:.2f}s")
+            times = []
+            for _ in range(max(iters, 3)):
+                if cold_stack:
+                    distributed.clear_stack_cache()
+                t = time.time()
+                executor.run(query)
+                times.append(time.time() - t)
+        return min(times)
+
+    rates = {}
+    for label, on in (("packed", True), ("decoded", False)):
+        prev_p = packed_mod.set_enabled(on)
+        prev_c = cascade_mod.set_enabled(on)
+        try:
+            distributed.clear_stack_cache()
+            best = timed_sharded(label, cold_stack=True)
+        finally:
+            packed_mod.set_enabled(prev_p)
+            cascade_mod.set_enabled(prev_c)
+        rates[label] = total_rows / best
+        log(f"sharded-bench {label}: best {best * 1e3:.1f}ms cold-stack "
+            f"over {n_dev} device(s) -> {rates[label] / 1e6:.1f}M rows/s")
+
+    # merge tails, warm: device = one sharded dispatch (collective merge
+    # in-program, the host only converts representations); host = the
+    # meshless path over the same segments (partials host-merged)
+    t_dev = timed_sharded("merge-device", cold_stack=False)
+    t = time.time()
+    executor.run(query)
+    log(f"sharded-bench warmup merge-host: {time.time() - t:.2f}s")
+    host_times = []
+    for _ in range(max(iters, 3)):
+        t = time.time()
+        executor.run(query)
+        host_times.append(time.time() - t)
+    t_host = min(host_times)
+    log(f"sharded-bench merge tails: device {t_dev * 1e3:.1f}ms vs "
+        f"host {t_host * 1e3:.1f}ms warm")
+
+    after = distributed.sharded_stats().snapshot()
+    if after[0] <= before[0]:
+        raise RuntimeError("sharded path never dispatched — fell back to "
+                           "the host-merged path")
+    snap = device_pool().snapshot()
+    return {
+        "sharded_decoded_rate": round(rates["decoded"], 0),
+        "sharded_packed_rate": round(rates["packed"], 0),
+        "sharded_merge_host_ms": round(t_host * 1e3, 2),
+        "sharded_merge_device_ms": round(t_dev * 1e3, 2),
+        "sharded_devices": n_dev,
+        "sharded_stack_ratio": round(snap.stacked_ratio, 3),
     }
 
 
@@ -1081,6 +1174,11 @@ def main():
         log(f"batch-bench failed: {type(e).__name__}: {e}")
         batch = {"batch_error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        sharded = _bench_sharded(iters)
+    except Exception as e:  # druidlint: disable=swallowed-exception
+        log(f"sharded-bench failed: {type(e).__name__}: {e}")
+        sharded = {"sharded_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         packed_cmp = _bench_packed(iters)
     except Exception as e:  # druidlint: disable=swallowed-exception
         log(f"packed-bench failed: {type(e).__name__}: {e}")
@@ -1142,6 +1240,7 @@ def main():
         "p95_ms": round(p95, 1),
     }
     out.update(batch)
+    out.update(sharded)
     out.update(packed_cmp)
     out.update(filt)
     out.update(fused)

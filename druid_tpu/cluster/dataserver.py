@@ -305,13 +305,14 @@ class DataNodeServer:
         from druid_tpu.engine.filters import FilterBitmapMonitor
         from druid_tpu.engine.megakernel import MegakernelMonitor
         from druid_tpu.obs.dispatch import DispatchMonitor
+        from druid_tpu.parallel.distributed import ShardedMonitor
         from druid_tpu.utils.emitter import MonitorScheduler
         from druid_tpu.storage.format_v2 import SegmentLoadMonitor
         monitors = [DevicePoolMonitor(), BatchMetricsMonitor(),
                     FilterBitmapMonitor(), MegakernelMonitor(),
                     CodeDomainMonitor(), DispatchMonitor(),
-                    wire.WireStatsMonitor(), SegmentLoadMonitor(),
-                    self._query_counts]
+                    ShardedMonitor(), wire.WireStatsMonitor(),
+                    SegmentLoadMonitor(), self._query_counts]
         if self._scheduler_config is not None:
             self.scheduler = DataNodeScheduler(
                 node, self._scheduler_config, emitter=emitter)

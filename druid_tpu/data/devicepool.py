@@ -29,6 +29,11 @@ from typing import Callable, Dict, Optional, Set, Tuple
 from druid_tpu.obs.trace import span as trace_span
 from druid_tpu.utils.emitter import Monitor
 
+#: key[0] marker for stacked sharded-execution blocks
+#: (parallel/distributed.py stack owner) — entries so marked feed the
+#: PoolStats.stacked_* accounting alongside the shared byte budget
+STACKED_KIND = "shardStack"
+
 
 def _default_budget() -> int:
     # capacity bound only: the budget sizes the pool and its eviction,
@@ -73,6 +78,22 @@ def _measure_nbytes(v):
     if isinstance(v, (dict, tuple, list)) or hasattr(v, "arrays"):
         return None
     return getattr(v, "nbytes", None)
+
+
+class LogicalBytes:
+    """Accounting-only leaf: contributes `logical_nbytes` to the
+    decoded-equivalent accounting and zero actual bytes. Builders of
+    BATCHED entries ride one in their value: a stacked sharded block's
+    column objects carry per-SEGMENT aux (rows=R — the vmapped decode
+    needs it), so their logical_nbytes describes one segment while their
+    leaves hold K; this leaf restores the missing (K-1) share so
+    packed/stacked ratios stay honest."""
+
+    __slots__ = ("logical_nbytes",)
+    nbytes = 0
+
+    def __init__(self, logical_nbytes: int):
+        self.logical_nbytes = int(logical_nbytes)
 
 
 def entry_bytes(value) -> int:
@@ -124,6 +145,9 @@ class PoolStats:
     logical_bytes: int = 0
     cascade_bytes: int = 0
     cascade_logical_bytes: int = 0
+    stacked_bytes: int = 0
+    stacked_logical_bytes: int = 0
+    stacked_entries: int = 0
     entries: int = 0
     budget_bytes: int = 0
 
@@ -145,6 +169,15 @@ class PoolStats:
         only (1.0 when nothing cascade-encoded is resident)."""
         return self.cascade_logical_bytes / self.cascade_bytes \
             if self.cascade_bytes else 1.0
+
+    @property
+    def stacked_ratio(self) -> float:
+        """Decoded-equivalent / actual bytes over the STACKED sharded
+        blocks only (query/sharded/packedRatio — 1.0 when nothing is
+        stacked): how much HBM the compressed-resident stacking saves a
+        pod versus the old decoded host-stack."""
+        return self.stacked_logical_bytes / self.stacked_bytes \
+            if self.stacked_bytes else 1.0
 
 
 class DeviceSegmentPool:
@@ -169,6 +202,9 @@ class DeviceSegmentPool:
         self._logical = 0
         self._cascade = 0
         self._cascade_logical = 0
+        self._stacked = 0
+        self._stacked_logical = 0
+        self._stacked_entries = 0
         self._hits = 0
         self._misses = 0
         self._evictions = 0
@@ -224,6 +260,21 @@ class DeviceSegmentPool:
             freed += self._purge_locked(owner)
         return freed
 
+    @staticmethod
+    def _is_stacked(full_key: Tuple) -> bool:
+        # full_key = (owner,) + key; stacked blocks lead their key with
+        # STACKED_KIND (the distributed.py stack owner's convention)
+        return len(full_key) > 1 and full_key[1] == STACKED_KIND
+
+    def _forget_stacked(self, full_key: Tuple, entry: Tuple) -> None:
+        """Caller holds the lock and just removed `entry` under
+        `full_key` — every removal path (purge, take, evict, replace)
+        funnels here so the stacked counters cannot drift."""
+        if self._is_stacked(full_key):
+            self._stacked -= entry[1]
+            self._stacked_logical -= entry[2]
+            self._stacked_entries -= 1
+
     def _purge_locked(self, owner: int) -> int:
         freed = 0
         for key in self._owner_keys.pop(owner, ()):
@@ -233,6 +284,7 @@ class DeviceSegmentPool:
                 self._logical -= value[2]
                 self._cascade -= value[3]
                 self._cascade_logical -= value[4]
+                self._forget_stacked(key, value)
         self._resident -= freed
         return freed
 
@@ -294,6 +346,7 @@ class DeviceSegmentPool:
                 self._logical -= old[2]
                 self._cascade -= old[3]
                 self._cascade_logical -= old[4]
+                self._forget_stacked(full_key, old)
             self._entries[full_key] = (value, nbytes, logical, casc,
                                        casc_logical)
             keys.add(full_key)
@@ -301,6 +354,10 @@ class DeviceSegmentPool:
             self._logical += logical
             self._cascade += casc
             self._cascade_logical += casc_logical
+            if self._is_stacked(full_key):
+                self._stacked += nbytes
+                self._stacked_logical += logical
+                self._stacked_entries += 1
             budget = self.budget_bytes
             if budget > 0:
                 self._evict_to(budget, keep=full_key)
@@ -326,6 +383,7 @@ class DeviceSegmentPool:
             self._logical -= entry[2]
             self._cascade -= entry[3]
             self._cascade_logical -= entry[4]
+            self._forget_stacked(full_key, entry)
             return entry[0]
 
     def _evict_to(self, budget: int, keep: Optional[Tuple]) -> None:
@@ -339,13 +397,15 @@ class DeviceSegmentPool:
                     return
                 self._entries.move_to_end(key)
                 continue
-            _, nbytes, logical, casc, casc_logical = self._entries.pop(key)
+            entry = self._entries.pop(key)
+            _, nbytes, logical, casc, casc_logical = entry
             # key[0] is the owner token (get_or_build prefixes it)
             self._owner_keys.get(key[0], set()).discard(key)
             self._resident -= nbytes
             self._logical -= logical
             self._cascade -= casc
             self._cascade_logical -= casc_logical
+            self._forget_stacked(key, entry)
             self._evictions += 1
             self._evicted_bytes += nbytes
 
@@ -360,6 +420,9 @@ class DeviceSegmentPool:
             self._logical = 0
             self._cascade = 0
             self._cascade_logical = 0
+            self._stacked = 0
+            self._stacked_logical = 0
+            self._stacked_entries = 0
 
     # ---- observability --------------------------------------------------
     def snapshot(self) -> PoolStats:
@@ -372,6 +435,9 @@ class DeviceSegmentPool:
                              logical_bytes=self._logical,
                              cascade_bytes=self._cascade,
                              cascade_logical_bytes=self._cascade_logical,
+                             stacked_bytes=self._stacked,
+                             stacked_logical_bytes=self._stacked_logical,
+                             stacked_entries=self._stacked_entries,
                              entries=len(self._entries),
                              budget_bytes=self.budget_bytes)
 

@@ -591,10 +591,12 @@ def plan_filter(flt: Optional[F.DimFilter], segment: Segment,
                 virtual_columns: Sequence = (),
                 device_bitmap: Optional[bool] = None) -> Optional[FilterNode]:
     """device_bitmap: compile bitmap-eligible subtrees to DeviceBitmapNodes
-    (None → the process default). The sharded mesh path passes False —
-    its host-stacking discipline has no word slots. Filtered aggregators
-    follow the process default (kernels.make_kernel), riding resident
-    words / the fused megakernel like the query filter."""
+    (None → the process default). Every execution path — per-segment,
+    batched, and the sharded mesh — keeps resident bitmap words: the
+    sharded stack carries them as per-segment word slots on the mapped
+    axis. Filtered aggregators follow the process default
+    (kernels.make_kernel), riding resident words / the fused megakernel
+    like the query filter."""
     if flt is None:
         return None
     flt = flt.optimize()

@@ -918,9 +918,9 @@ def make_stacked_segment_fn(spec: GroupSpec, kds: Sequence[KeyDim],
     def per_segment(arrays, time0, iv_rel, bucket_off, aux):
         it = iter(aux)
         # same decode-at-top story as _build_device_fn: stacked blocks may
-        # carry bit-packed or cascade-encoded columns (the batched path
-        # stages through the same pool); the sharded path host-stacks
-        # decoded arrays, so this is a no-op there
+        # carry bit-packed or cascade-encoded columns — both the batched
+        # path and the sharded mesh path stack compressed-resident slots
+        # through the device pool and decode them here, in-program
         packed_cols, arrays = cascade_mod.split_resident(arrays)
         t = arrays["__time_offset"]
         mask = arrays["__valid"]

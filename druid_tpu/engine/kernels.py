@@ -864,8 +864,9 @@ def make_kernel(spec: A.AggregatorSpec, segment: Segment,
     """`device_bitmap`: how a FILTERED aggregator's filter plans — None
     follows the process default (filters.device_bitmap_enabled), so
     filtered aggregators ride resident bitmap words / the fused megakernel
-    instead of forcing decoded filter columns; the sharded mesh path
-    passes False (its host-stacking discipline has no word slots)."""
+    instead of forcing decoded filter columns. The sharded mesh path also
+    follows the default: its stack carries the words as per-segment slots
+    on the mapped axis."""
     factory = _EXTENSION_KERNELS.get(type(spec))
     if factory is not None:
         return factory(spec, segment)

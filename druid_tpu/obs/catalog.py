@@ -230,6 +230,28 @@ METRICS = {
         "site": "server/subscriptions.py (SubscriptionMetricsMonitor)",
         "help": "subscription-hub ticks since the last monitor tick "
                 "(each advances every standing program once)"},
+    # ---- sharded mesh execution (parallel/distributed.py) --------------
+    "query/sharded/mergeDevice": {
+        "unit": "count/period", "dims": (),
+        "site": "parallel/distributed.py (ShardedMonitor)",
+        "help": "sharded dispatches whose partial grids were merged "
+                "IN-PROGRAM by the mesh collectives (psum/pmin/pmax/"
+                "all_gather+fold) since the last tick — every sharded "
+                "dispatch, now that the broker-side host merge is gone"},
+    "query/sharded/stackBytes": {
+        "unit": "bytes", "dims": (),
+        "site": "parallel/distributed.py (ShardedMonitor)",
+        "help": "HBM resident in stacked sharded blocks (gauge; the "
+                "device pool's stacked_* accounting — counted against "
+                "DEVICE_POOL_BUDGET_BYTES like every other entry)"},
+    "query/sharded/packedRatio": {
+        "unit": "ratio", "dims": (),
+        "site": "parallel/distributed.py (ShardedMonitor)",
+        "help": "decoded-equivalent / actual bytes over the stacked "
+                "sharded blocks (gauge; 1.0 when nothing is stacked) — "
+                "the HBM multiplier the compressed-resident stacking "
+                "(packed words, cascade run tables, bitmap slots) buys "
+                "a pod"},
     # ---- code-domain aggregation (data/cascade.py) ---------------------
     "query/codeDomain/hits": {
         "unit": "count/period", "dims": (),

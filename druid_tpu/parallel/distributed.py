@@ -9,6 +9,18 @@ Reference analog, inverted for TPU:
   * epinephelinae/ParallelCombiner.java combining tree → the XLA collective
     is the combining tree.
 
+The stacked blocks are COMPRESSED-RESIDENT: each shard carries per-segment
+packed words (data/packed.py tile-planar layout), cascade columns (RLE run
+tables, delta/FOR words — data/cascade.py), and resident filter-bitmap
+words (engine/filters.py DeviceBitmapNode slots), and the program decodes
+at its top through the same `cascade.split_resident` every other path
+calls — one decode/filter story for per-segment, batched and sharded
+execution. Every PartitionSpec comes from parallel/speclayout.py (the
+canonical SpecLayout; lint-enforced single source), and partial grids are
+merged ON DEVICE by the collectives — the broker-side host merge for this
+path is gone; `host_from_device` below only converts the already-merged
+replicated states to their host representation.
+
 Eligibility (else callers fall back to per-segment host-merged execution):
 dense key mode, "all"/"uniform" bucketing, and identical plan constants
 (filter LUTs, kernel aux, dim remaps) across segments — true whenever
@@ -19,12 +31,18 @@ from __future__ import annotations
 
 import collections
 import functools
+import hashlib
 import threading
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from druid_tpu.data import cascade as cascade_mod
+from druid_tpu.data import devicepool
+from druid_tpu.data import packed as packed_mod
 from druid_tpu.data.segment import Segment
+from druid_tpu.engine import filters as filters_mod
 from druid_tpu.engine.filters import ConstNode, plan_filter, simplify_node
 from druid_tpu.engine import grouping
 from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
@@ -36,8 +54,9 @@ from druid_tpu.engine.grouping import (GroupSpec, KeyDim, SegmentPartial,
 from druid_tpu.engine.kernels import AggKernel, make_kernel
 from druid_tpu.obs.trace import span as trace_span
 from druid_tpu.obs.trace import span_when as trace_span_when
-from druid_tpu.parallel import context
+from druid_tpu.parallel import context, speclayout
 from druid_tpu.query.aggregators import AggregatorSpec
+from druid_tpu.utils.emitter import Monitor
 from druid_tpu.utils.granularity import Granularity
 from druid_tpu.utils.intervals import Interval
 
@@ -50,10 +69,42 @@ _FN_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict()
 _FN_CACHE_CAP = 64
 _CACHE_LOCK = threading.Lock()
 
-# Stacked device blocks pin whole segment sets in HBM — bound the cache (LRU)
-# so dropped segment generations / varying column subsets free their memory.
-_STACK_CACHE: "collections.OrderedDict[Tuple, object]" = collections.OrderedDict()
-_STACK_CACHE_CAP = 4
+
+class _StackOwner:
+    """Anchor object owning the stacked-shard entries in the device pool.
+
+    Stacked blocks pin whole segment sets in HBM; instead of a private
+    count-capped LRU they live in the process-wide DeviceSegmentPool under
+    this owner, accounted at actual bytes against DEVICE_POOL_BUDGET_BYTES
+    (satellite of the old `_STACK_CACHE`). The anchor is module-lived, so
+    entries only leave through LRU pressure or clear_stack_cache()."""
+
+
+_STACK_ANCHOR: Optional[_StackOwner] = None
+_STACK_TOKEN: Optional[int] = None
+_STACK_POOL: Optional["weakref.ref"] = None
+
+
+def _stack_owner_token(pool: "devicepool.DeviceSegmentPool") -> int:
+    """Lazily (re-)register the stack owner: purge_owner removes the
+    registry slot, so after clear_stack_cache() the next stacking must
+    register a fresh token or the pool would refuse its inserts. The
+    token is only valid for the pool it was registered on — when the
+    process pool is swapped (tests monkeypatch isolated pools), the old
+    pool's stacked entries are purged and a fresh token registers on the
+    new one, so there is always at most ONE live stack owner."""
+    global _STACK_ANCHOR, _STACK_TOKEN, _STACK_POOL
+    with _CACHE_LOCK:
+        prev = _STACK_POOL() if _STACK_POOL is not None else None
+        if _STACK_TOKEN is None or prev is not pool:
+            if prev is not None and _STACK_TOKEN is not None:
+                # _CACHE_LOCK -> pool lock is the documented order; the
+                # pool never takes _CACHE_LOCK
+                prev.purge_owner(_STACK_TOKEN)
+            _STACK_ANCHOR = _StackOwner()
+            _STACK_TOKEN = pool.register_owner(_STACK_ANCHOR)
+            _STACK_POOL = weakref.ref(pool)
+        return _STACK_TOKEN
 
 
 # plan-constant equality + column planning now live in engine/grouping.py,
@@ -80,7 +131,8 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         # cross-process mesh: the stacked program would need every shard's
         # data process-addressable; host-level combine is the broker's job
         return None
-    axis = mesh.axis_names[0]
+    layout = speclayout.layout_for(mesh)
+    axis = layout.seg_axis
     n_dev = mesh.shape[axis]
 
     kds = list(kds_per_seg[0])
@@ -112,22 +164,29 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         return None
 
     # plan filter + kernels + virtual columns per segment; constants must
-    # agree across segments
-    filter_node = simplify_node(plan_filter(flt, segments[0], virtual_columns,
-                                            device_bitmap=False))
-    kernels = [make_kernel(a, segments[0], device_bitmap=False) for a in aggs]
+    # agree across segments. Device-bitmap compilation follows the process
+    # default (the stacked program reads resident `__fbmpN` word slots,
+    # exactly like _build_device_fn) — slots are assigned per plan BEFORE
+    # signatures are compared, so filtered-aggregator trees cannot collide
+    # with the query filter's slot 0.
+    filter_node = simplify_node(plan_filter(flt, segments[0],
+                                            virtual_columns))
+    kernels = [make_kernel(a, segments[0]) for a in aggs]
+    n_slots = filters_mod.assign_bitmap_slots(filter_node, kernels)
     vc_plans, vc_luts = plan_virtual_columns(segments[0], virtual_columns)
     f_sig = filter_node.signature() if filter_node else "none"
     f_aux = filter_node.aux_arrays() if filter_node else []
     k_aux = [a for k in kernels for a in k.aux_arrays()]
+    seg_filters: List[object] = [filter_node]
+    seg_kernels: List[List[AggKernel]] = [kernels]
     for s in segments[1:]:
-        fn_s = simplify_node(plan_filter(flt, s, virtual_columns,
-                                         device_bitmap=False))
+        fn_s = simplify_node(plan_filter(flt, s, virtual_columns))
+        ks = [make_kernel(a, s) for a in aggs]
+        filters_mod.assign_bitmap_slots(fn_s, ks)
         if (fn_s.signature() if fn_s else "none") != f_sig:
             return None
         if not _aux_equal(fn_s.aux_arrays() if fn_s else [], f_aux):
             return None
-        ks = [make_kernel(a, s, device_bitmap=False) for a in aggs]
         if [k.signature() for k in ks] != [k.signature() for k in kernels]:
             return None
         if not _aux_equal([a for k in ks for a in k.aux_arrays()], k_aux):
@@ -135,6 +194,8 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         vp_s, vl_s = plan_virtual_columns(s, virtual_columns)
         if repr(vp_s) != repr(vc_plans) or not _aux_equal(vl_s, vc_luts):
             return None
+        seg_filters.append(fn_s)
+        seg_kernels.append(ks)
     # only after every segment agreed on the plan is a const-false filter a
     # whole-query zero (a column may exist in some segments only)
     if isinstance(filter_node, ConstNode) and not filter_node.value:
@@ -148,9 +209,13 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
     # segments: the plain path handles per-segment differences (missing
     # aggregates as zero), but one stacked program cannot — fall back rather
     # than KeyError, silently cast, or crash. Complex (2-D) metric columns
-    # also fall back: the stacker allocates [K, R] only.
+    # also fall back: the stacker allocates [K, R] only. Planned
+    # filter/kernel trees are passed so bitmap-compiled subtrees stop
+    # staging their columns (their data rides in the word slots).
     needed, columns = _needed_columns(segments[0], kds, aggs, flt,
-                                      virtual_columns)
+                                      virtual_columns,
+                                      filter_node=filter_node,
+                                      kernels=kernels)
     for c in needed:
         in_dim0 = c in segments[0].dims
         met0 = segments[0].metrics.get(c)
@@ -167,7 +232,16 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                                     or s.staged_dtype(c)
                                     != segments[0].staged_dtype(c)):
                 return None
-    stacked, time0s, R, K = _stack_segments(mesh, axis, segments, columns)
+
+    # compressed slots: the descriptor pair every segment can agree on
+    # (cascade entries + pack entries), plus RLE validity masks — the
+    # descriptors join the stack pool key AND _sharded_sig below, so
+    # chunk-mates agree and the cached program's treedef is pinned
+    valid_rle = cascade_mod.enabled()
+    cascades, packs = _common_descriptors(segments, columns)
+    stacked, time0s, R, K = _stack_segments(mesh, segments, columns,
+                                            cascades, packs, valid_rle,
+                                            seg_filters, seg_kernels, layout)
 
     # reduction strategy must agree across the whole stacked program; the
     # windowed path needs every segment's host span check to pass
@@ -216,15 +290,14 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         if spec0.bucket_mode == "uniform":
             bucket_off[i] = min(max(int(spec0.bucket_starts[0]) - t0,
                                     clip_lo), clip_hi)
-    import jax as _jax
-    from jax.sharding import NamedSharding as _NS, PartitionSpec as _P
-    iv_rel = _jax.device_put(iv_rel, _NS(mesh, _P(axis, None, None)))
-    bucket_off = _jax.device_put(bucket_off, _NS(mesh, _P(axis)))
+    iv_rel = layout.put_interval_bounds(mesh, iv_rel)
+    bucket_off = layout.put_bucket_offsets(mesh, bucket_off)
 
     aux = _assemble_aux(spec0, kds, f_aux, k_aux, granularity, vc_luts)
 
     sig = _sharded_sig(mesh, axis, spec0, kds, filter_node, kernels,
-                       len(intervals), vc_plans, K, R)
+                       len(intervals), vc_plans, K, R, columns, cascades,
+                       packs, n_slots, valid_rle, layout)
     with _CACHE_LOCK:
         fn = _FN_CACHE.get(sig)
         # the miss IS the compile event (shard_map traces/compiles on the
@@ -232,7 +305,7 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
         compiled = fn is None
         if fn is None:
             fn = _build_sharded_fn(mesh, axis, n_dev, spec0, kds, filter_node,
-                                   kernels, vc_plans)
+                                   kernels, vc_plans, layout, stacked)
             _FN_CACHE[sig] = fn
             while len(_FN_CACHE) > _FN_CACHE_CAP:
                 _FN_CACHE.popitem(last=False)
@@ -244,7 +317,12 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                     compile=compiled), \
             trace_span_when(compiled, "engine/compile", kind="sharded"):
         counts, states = fn(stacked, time0s, iv_rel, bucket_off, aux)
+    _SHARDED_STATS.record(len(segments))
 
+    # NOT a host merge: counts/states left the program replicated and
+    # already collective-merged; host_from_device only converts the merged
+    # device representation (HLL registers, first/last packed pairs) to
+    # the host one, exactly like the single-segment path does per segment
     host_states = {k.name: k.host_from_device(st)
                    for k, st in zip(kernels, states)}
     return SegmentPartial(segment=segments[0], spec=spec0,
@@ -252,83 +330,237 @@ def try_sharded(segments: Sequence[Segment], intervals: Sequence[Interval],
                           states=host_states, kernels=kernels)
 
 
-def _stack_segments(mesh, axis: str, segments: Sequence[Segment],
-                    columns: Tuple[str, ...]):
-    """Host-stack segments into [K, R] arrays sharded over the mesh axis.
+def _common_descriptors(segments: Sequence[Segment],
+                        columns: Tuple[str, ...]) -> Tuple[Tuple, Tuple]:
+    """The (cascade, pack) descriptor pair EVERY segment can stage under.
 
-    K pads to a multiple of the axis size with empty (all-invalid) segments;
-    R pads rows to the max padded row count. Cached per (segment set,
-    columns, mesh) — repeat queries reuse HBM-resident shards, the analog of
-    the reference keeping segments mmapped across queries."""
+    Per-segment plans come from the one shared derivation
+    (cascade.plan_pair); a column keeps its encoding only when all
+    segments planned the same (name, kind) with stack-compatible params:
+    RLE run-table lengths normalize to the max (pow2 stays pow2, and
+    encode_column pads per entry[2]), delta/FOR widths+bases must match
+    exactly (word shapes must stack), and `lz4host` drops out (it stages
+    the exact host-roundtripped decoded rows anyway). Everything else
+    falls back to decoded [K, R] slots — never to a fallback PATH."""
+    per_seg = [cascade_mod.plan_pair(s, columns) for s in segments]
+    casc0, packs0 = per_seg[0]
+    cascades: List[Tuple] = []
+    for entry in casc0:
+        name, kind = entry[0], entry[1]
+        if kind == "lz4host":
+            continue
+        mates = []
+        for cs, _ in per_seg:
+            mate = next((e for e in cs if e[0] == name), None)
+            if mate is None or mate[1] != kind:
+                mates = None
+                break
+            mates.append(mate)
+        if mates is None:
+            continue
+        if kind == "rle":
+            # run counts are per-segment data; the stacked run tables pad
+            # to the widest (max of pow2 paddings is one of them)
+            cascades.append((name, kind, max(m[2] for m in mates)))
+        elif all(m == entry for m in mates):
+            cascades.append(entry)
+    claimed = {e[0] for e in cascades}
+    packs = tuple(e for e in packs0
+                  if e[0] not in claimed
+                  and all(e in ps for _, ps in per_seg))
+    return tuple(cascades), packs
+
+
+def _bitmap_nodes(filter_node, kernels: Sequence[AggKernel]) -> List:
+    """Every DeviceBitmapNode of one segment's plan, slot order (the query
+    filter's tree first, then each kernel's filter trees — the same walk
+    assign_bitmap_slots numbers)."""
+    nodes = list(filters_mod.collect_bitmap_nodes(filter_node))
+    for k in kernels:
+        for tree in k.filter_trees():
+            nodes.extend(filters_mod.collect_bitmap_nodes(tree))
+    return nodes
+
+
+def _bitmap_digest(seg_filters: Sequence, seg_kernels: Sequence) -> str:
+    """Content digest of every segment's bitmap-node set for the stack pool
+    key: bitmap LUTs ride the stacked WORDS (per-segment data, aux-free by
+    the DeviceBitmapNode contract), so two plans that differ only in word
+    content must stack under different keys."""
+    h = hashlib.sha1()
+    any_nodes = False
+    for fn_s, ks in zip(seg_filters, seg_kernels):
+        for node in _bitmap_nodes(fn_s, ks):
+            any_nodes = True
+            h.update(node.col.encode())
+            h.update(b"|")
+            h.update(node.structure_sig().encode())
+            h.update(b"|")
+            h.update(node.digest().encode())
+        h.update(b"||")
+    return h.hexdigest()[:16] if any_nodes else ""
+
+
+def _stack_tree(cols: List, K: int):
+    """Stack K per-segment column pytrees (decoded arrays, PackedColumn,
+    RLE/FOR/delta columns) leaf-wise onto a leading segment axis. Padding
+    segments are zeroed copies of the first: RLE zeros decode all-invalid
+    (n_rows=0), packed/FOR zeros decode to the base — every consumer masks
+    them through `__valid`. Descriptor agreement (_common_descriptors)
+    guarantees equal treedefs, so per-segment row counts/firsts ride as
+    stacked [K] scalar leaves, not aux."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    if len(cols) < K:
+        pad = jax.tree.map(lambda leaf: np.zeros_like(np.asarray(leaf)),
+                           cols[0])
+        cols = list(cols) + [pad] * (K - len(cols))
+    return jax.tree.map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves], axis=0),
+        *cols)
 
+
+def _stack_segments(mesh, segments: Sequence[Segment],
+                    columns: Tuple[str, ...], cascades: Tuple, packs: Tuple,
+                    valid_rle: bool, seg_filters: Sequence,
+                    seg_kernels: Sequence,
+                    layout: "speclayout.SpecLayout"):
+    """Stack segments into COMPRESSED-RESIDENT [K, ...] slots sharded over
+    the mesh axis: cascade columns (RLE run tables, delta/FOR words),
+    packed words, resident filter-bitmap words, decoded rows for the rest —
+    the sharded program decodes in-program through cascade.split_resident
+    exactly like _build_device_fn.
+
+    K pads to a multiple of the axis size with empty (all-invalid)
+    segments; R pads rows to the max padded row count (1024-aligned — a
+    multiple of every pack width's tile quantum). Stacks live in the
+    process-wide device pool under the stack owner, accounted at actual
+    bytes against the pool budget (PoolStats.stacked_*) — repeat queries
+    reuse HBM-resident shards, the analog of the reference keeping
+    segments mmapped across queries."""
+    axis = layout.seg_axis
     n_dev = mesh.shape[axis]
+    pool = devicepool.device_pool()
     # keyed by object identity, not segment-id strings: rebuilt segments can
     # legitimately reuse (datasource, interval, version, partition) and must
     # not be served stale stacked data. The cached value pins the segment
-    # objects, so their id()s cannot be recycled while the entry lives.
-    key = (tuple(id(s) for s in segments), columns, n_dev,
-           tuple(d.id for d in mesh.devices.flat))
-    with _CACHE_LOCK:
-        cached = _STACK_CACHE.get(key)
-        if cached is not None:
-            _STACK_CACHE.move_to_end(key)
-            return cached[:4]
+    # objects, so their id()s cannot be recycled while the entry lives. The
+    # descriptors/bitmap digest join the key: latch flips (packed/cascade/
+    # device-bitmap) and filter-word content changes restack.
+    key = (devicepool.STACKED_KIND, tuple(id(s) for s in segments), columns,
+           n_dev, tuple(int(d.id) for d in mesh.devices.flat), cascades,
+           packs, int(valid_rle), _bitmap_digest(seg_filters, seg_kernels))
 
+    def build():
+        return _build_stack(mesh, segments, columns, cascades, packs,
+                            valid_rle, seg_filters, seg_kernels, layout,
+                            n_dev)
+
+    value = pool.get_or_build(_stack_owner_token(pool), key, build)
+    return value[:4]
+
+
+def _build_stack(mesh, segments: Sequence[Segment], columns: Tuple[str, ...],
+                 cascades: Tuple, packs: Tuple, valid_rle: bool,
+                 seg_filters: Sequence, seg_kernels: Sequence,
+                 layout: "speclayout.SpecLayout", n_dev: int):
+    # 1024-aligned rows satisfy pack_padded's tile quantum (128 * values
+    # per word) for every contract width, 4/8/16 alike
     align = 1024
     R = max(align, max(((s.n_rows + align - 1) // align) * align
                        for s in segments))
     K = ((len(segments) + n_dev - 1) // n_dev) * n_dev
+    casc_by_name = {e[0]: e for e in cascades}
+    pack_by_name = {e[0]: (e[1], e[2]) for e in packs}
 
-    def col_array(s: Segment, name: str) -> Tuple[np.ndarray, object]:
-        if name in s.dims:
-            return s.dims[name].ids, np.int32(0)
-        m = s.metrics[name]
-        dt = s.staged_dtype(name)   # int32-narrowed longs stay narrow
-        vals = m.values if m.values.dtype == dt else m.values.astype(dt)
-        return vals, vals.dtype.type(0)
-
-    arrays: Dict[str, np.ndarray] = {}
-    names = ("__time_offset", "__valid") + columns
-    for name in names:
+    def padded_col(s: Segment, name: str) -> np.ndarray:
         if name == "__time_offset":
-            dt, fill = np.int32, 0
-        elif name == "__valid":
-            dt, fill = bool, False
+            off = s.time_ms - s.interval.start
+            if off.size and (off.min() < 0 or off.max() >= 2**31):
+                raise ValueError(f"segment {s.id} outside int32 offset range")
+            a = off.astype(np.int32)
+        elif name in s.dims:
+            a = s.dims[name].ids
         else:
-            a0, fill = col_array(segments[0], name)
-            dt = a0.dtype
-        out = np.full((K, R), fill, dtype=dt)
+            m = s.metrics[name]
+            dt = s.staged_dtype(name)   # int32-narrowed longs stay narrow
+            a = m.values if m.values.dtype == dt else m.values.astype(dt)
+        out = np.zeros(R, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    def encoded_col(s: Segment, name: str):
+        padded = padded_col(s, name)
+        entry = casc_by_name.get(name)
+        if entry is not None:
+            # host identity `put`: device placement happens once for the
+            # whole stack below, with the layout's shardings
+            return cascade_mod.encode_column(s, name, entry, padded,
+                                             lambda x: x)
+        wb = pack_by_name.get(name)
+        if wb is not None:
+            w, base = wb
+            return packed_mod.PackedColumn(
+                packed_mod.pack_padded(padded, w, base), w, base, R,
+                str(padded.dtype))
+        return padded
+
+    arrays: Dict[str, object] = {}
+    for name in ("__time_offset",) + tuple(columns):
+        arrays[name] = _stack_tree([encoded_col(s, name) for s in segments],
+                                   K)
+
+    # validity as an RLE run table (8 int32 pairs/segment instead of R
+    # bools): rows < n_rows decode 1, pads 0 — bit-exact with the dense
+    # mask. Dense [K, R] bools only when cascading is off.
+    if valid_rle:
+        valid_cols = []
+        for s in segments:
+            nr = int(s.n_rows)
+            vals = np.zeros(8, dtype=np.int32)
+            vals[0] = 1 if nr else 0
+            ends = np.full(8, nr, dtype=np.int32)
+            valid_cols.append(cascade_mod.RleColumn(
+                vals, ends, np.asarray(nr, dtype=np.int32), R, "bool"))
+        arrays["__valid"] = _stack_tree(valid_cols, K)
+    else:
+        valid = np.zeros((K, R), dtype=bool)
         for i, s in enumerate(segments):
-            if name == "__time_offset":
-                off = s.time_ms - s.interval.start
-                if off.size and (off.min() < 0 or off.max() >= 2**31):
-                    raise ValueError(f"segment {s.id} outside int32 offset range")
-                out[i, : s.n_rows] = off.astype(np.int32)
-            elif name == "__valid":
-                out[i, : s.n_rows] = True
-            else:
-                a, _ = col_array(s, name)
-                out[i, : a.shape[0]] = a
-        arrays[name] = out
+            valid[i, : s.n_rows] = True
+        arrays["__valid"] = valid
+
+    # resident filter-bitmap words: stage per segment through the pooled
+    # wave path (query/filter/* accounting included), then stack each
+    # `__fbmpN` slot; padding segments keep zero words (no row passes)
+    bitmap_cols: Dict[str, np.ndarray] = {}
+    for i, (s, fn_s, ks) in enumerate(zip(segments, seg_filters,
+                                          seg_kernels)):
+        words = filters_mod.stage_device_bitmaps(s, fn_s, R, kernels=ks)
+        for col, w in words.items():
+            host = np.asarray(w)
+            slot = bitmap_cols.get(col)
+            if slot is None:
+                slot = np.zeros((K,) + host.shape, dtype=host.dtype)
+                bitmap_cols[col] = slot
+            slot[i] = host
+    arrays.update(bitmap_cols)
 
     time0s = np.zeros((K,), dtype=np.int64)
     for i, s in enumerate(segments):
         time0s[i] = s.interval.start
 
-    shard = NamedSharding(mesh, P(axis, None))
-    shard1 = NamedSharding(mesh, P(axis))
-    dev_arrays = {k: jax.device_put(v, shard) for k, v in arrays.items()}
-    dev_time0s = jax.device_put(time0s, shard1)
-    result = (dev_arrays, dev_time0s, R, K)
-    # stacking (device_put of whole segment sets) stays outside the lock;
-    # a concurrent duplicate build wastes work but cannot corrupt the LRU
-    with _CACHE_LOCK:
-        _STACK_CACHE[key] = result + (tuple(segments),)
-        while len(_STACK_CACHE) > _STACK_CACHE_CAP:
-            _STACK_CACHE.popitem(last=False)
-    return result
+    dev_arrays = layout.put_stacked(mesh, arrays)
+    dev_time0s = layout.put_time0s(mesh, time0s)
+    # stacked column objects carry per-SEGMENT aux (the vmapped decode
+    # slices one segment at a time), so their logical_nbytes describes one
+    # segment while their leaves hold K — restore the missing (K-1) share
+    # for the pool's decoded-equivalent accounting
+    corr = sum((K - 1) * int(v.logical_nbytes)
+               for v in dev_arrays.values()
+               if getattr(v, "logical_nbytes", None) is not None)
+    # the trailing segment tuple pins the objects (id()-recycling guard);
+    # Segment carries no nbytes, so it counts 0 in the pool accounting
+    return (dev_arrays, dev_time0s, R, K, tuple(segments),
+            devicepool.LogicalBytes(corr))
 
 
 def clear_stack_cache() -> int:
@@ -336,10 +568,16 @@ def clear_stack_cache() -> int:
     objects each entry deliberately pins). Returns the entry count
     dropped. The ops analog of unloading segments to reclaim HBM without
     a restart — engine.release_device_caches() is the public surface."""
+    global _STACK_TOKEN, _STACK_POOL
     with _CACHE_LOCK:
-        n = len(_STACK_CACHE)
-        _STACK_CACHE.clear()
-        return n
+        token, _STACK_TOKEN = _STACK_TOKEN, None
+        pool = _STACK_POOL() if _STACK_POOL is not None else None
+        _STACK_POOL = None
+    if token is None or pool is None:
+        return 0
+    n = pool.snapshot().stacked_entries
+    pool.purge_owner(token)
+    return n
 
 
 def clear_fn_cache() -> int:
@@ -356,16 +594,22 @@ _assemble_aux = assemble_stacked_aux
 
 
 def _sharded_sig(mesh, axis, spec: GroupSpec, kds, filter_node, kernels,
-                 n_intervals, vc_plans, K, R) -> Tuple:
+                 n_intervals, vc_plans, K, R, columns, cascades, packs,
+                 n_bitmap_slots, valid_rle, layout) -> Tuple:
+    """Cache key of one sharded program. The compressed-slot inputs —
+    staged column set, cascade/pack descriptors, bitmap slot count, RLE
+    validity — pin the stacked pytree's treedef, so two queries share a
+    cached program only when their stacks share a structure."""
     dims_sig = ",".join(
         f"{d.column}:{'remap' if d.remap is not None else 'raw'}" for d in kds)
     vc_sig = ";".join(f"{name}={expr!r}:{out_type}:l{n_luts}"
                       for name, expr, out_type, n_luts in vc_plans)
-    mesh_key = (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
-    return (mesh_key, axis, spec.bucket_mode, dims_sig, n_intervals, vc_sig,
+    return (speclayout.layout_sig(layout, mesh), axis, spec.bucket_mode,
+            dims_sig, n_intervals, vc_sig,
             filter_node.signature() if filter_node else "none",
             ";".join(k.signature() for k in kernels), spec.num_total, K, R,
-            spec.strategy, spec.window)
+            spec.strategy, spec.window, columns, cascades, packs,
+            n_bitmap_slots, int(valid_rle))
 
 
 def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
@@ -429,7 +673,8 @@ def _merge_states(kernel: AggKernel, stacked_state, axis: str, n_dev: int,
 
 def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
                       kds: Sequence[KeyDim], filter_node,
-                      kernels: List[AggKernel], vc_plans: Tuple):
+                      kernels: List[AggKernel], vc_plans: Tuple,
+                      layout: "speclayout.SpecLayout", stacked):
     import jax
     import jax.numpy as jnp
     try:
@@ -438,7 +683,6 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     except ImportError:                    # 0.4.x: experimental home,
         from jax.experimental.shard_map import shard_map
         _check_kw = "check_rep"            # and the old replication-check kw
-    from jax.sharding import PartitionSpec as P
 
     seg_body = make_stacked_segment_fn(spec, kds, filter_node, kernels,
                                        vc_plans)
@@ -466,7 +710,59 @@ def _build_sharded_fn(mesh, axis: str, n_dev: int, spec: GroupSpec,
     # construction — turn the static replication check off for those.
     has_fold = any(k.reduce_kind == "fold" for k in kernels) and n_dev > 1
     f = shard_map(body, mesh=mesh,
-                  in_specs=(P(axis, None), P(axis), P(axis, None, None),
-                            P(axis), P()),
-                  out_specs=(P(), P()), **{_check_kw: not has_fold})
+                  in_specs=layout.in_specs(stacked),
+                  out_specs=layout.out_specs(), **{_check_kw: not has_fold})
     return jax.jit(f)
+
+
+# ---------------------------------------------------------------------------
+# Observability: query/sharded/* metrics
+# ---------------------------------------------------------------------------
+
+class ShardedStats:
+    """merged_device = sharded dispatches whose partials were merged by the
+    in-program collectives (every dispatch since the host-merge tail was
+    removed — the counter exists so its constancy is assertable);
+    segments = segments those dispatches covered."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.merged_device = 0
+        self.segments = 0
+
+    def record(self, n_segments: int) -> None:
+        with self._lock:
+            self.merged_device += 1
+            self.segments += n_segments
+
+    def snapshot(self) -> Tuple[int, int]:
+        with self._lock:
+            return (self.merged_device, self.segments)
+
+
+_SHARDED_STATS = ShardedStats()
+
+
+def sharded_stats() -> ShardedStats:
+    """The process-wide sharded-dispatch stats (tests + ShardedMonitor)."""
+    return _SHARDED_STATS
+
+
+class ShardedMonitor(Monitor):
+    """Emits `query/sharded/*` per tick: device-merged dispatches over the
+    tick window, and the stacked-shard residency gauges from the device
+    pool's stacked accounting."""
+
+    def __init__(self, stats: Optional[ShardedStats] = None,
+                 pool: Optional["devicepool.DeviceSegmentPool"] = None):
+        self.stats = stats or sharded_stats()
+        self.pool = pool or devicepool.device_pool()
+        self._last = (0, 0)
+
+    def do_monitor(self, emitter) -> None:
+        s = self.stats.snapshot()
+        last, self._last = self._last, s
+        emitter.metric("query/sharded/mergeDevice", s[0] - last[0])
+        p = self.pool.snapshot()
+        emitter.metric("query/sharded/stackBytes", p.stacked_bytes)
+        emitter.metric("query/sharded/packedRatio", p.stacked_ratio)

@@ -274,3 +274,68 @@ def test_pool_monitor_emits_packed_ratio(fresh_pool):
     mon.do_monitor(emitter)
     ratios = sink.metrics("segment/devicePool/packedRatio")
     assert ratios and ratios[-1].value == 1.0             # empty pool
+
+
+# ---------------------------------------------------------------------------
+# Stacked sharded blocks: budget-governed pool state, not a private cache
+# ---------------------------------------------------------------------------
+
+def test_stacked_kind_accounting_unit(fresh_pool):
+    """Entries keyed under STACKED_KIND flow into the stacked_* counters on
+    insert / take / eviction, and a LogicalBytes leaf inflates the logical
+    side only (actual bytes stay honest)."""
+    class Anchor:
+        pass
+
+    anchor = Anchor()
+    token = fresh_pool.register_owner(anchor)
+    arr = np.zeros(256, dtype=np.int64)                   # 2048 actual
+    val = (arr, devicepool.LogicalBytes(4096))
+    fresh_pool.get_or_build(
+        token, (devicepool.STACKED_KIND, "k1"), lambda: val)
+    s = fresh_pool.snapshot()
+    assert s.stacked_entries == 1
+    assert s.stacked_bytes == 2048
+    assert s.stacked_logical_bytes == 2048 + 4096
+    assert s.stacked_ratio == pytest.approx(3.0)
+    # non-stacked entries do not touch the stacked counters
+    fresh_pool.get_or_build(token, ("plain", "k2"),
+                            lambda: np.zeros(16, np.int8))
+    assert fresh_pool.snapshot().stacked_bytes == 2048
+    fresh_pool.take(token, (devicepool.STACKED_KIND, "k1"))
+    s2 = fresh_pool.snapshot()
+    assert s2.stacked_entries == 0 and s2.stacked_bytes == 0
+    assert s2.stacked_logical_bytes == 0
+    assert s2.stacked_ratio == 1.0
+
+
+def test_stacked_blocks_evict_under_byte_pressure(fresh_pool):
+    """The sharded stack cache is device-pool state: stacked bytes count
+    against DEVICE_POOL_BUDGET_BYTES, evict LRU under pressure, and
+    restage transparently — the ISSUE's `_STACK_CACHE` replacement."""
+    from druid_tpu.parallel import distributed, make_mesh, use_mesh
+    distributed.clear_stack_cache()   # re-home the owner token on this pool
+    try:
+        segs_a = _segments(8, rows=3000, seed=11)
+        segs_b = DataGenerator(SCHEMA, seed=12).segments(
+            8, 3000, IV, datasource="pool")
+        mesh = make_mesh()
+        with use_mesh(mesh):
+            r1 = QueryExecutor(segs_a).run_json(COUNT_Q)
+            s1 = fresh_pool.snapshot()
+            assert s1.stacked_entries == 1
+            assert 0 < s1.stacked_bytes <= s1.resident_bytes
+            # squeeze: room for ~one stack, so stacking segs_b must evict
+            # the segs_a stack instead of growing without bound
+            budget = s1.resident_bytes + s1.stacked_bytes // 2
+            fresh_pool.configure(budget)
+            QueryExecutor(segs_b).run_json(COUNT_Q)
+            s2 = fresh_pool.snapshot()
+            assert s2.evictions > s1.evictions
+            assert s2.stacked_entries == 1
+            assert s2.resident_bytes <= budget
+            # the evicted stack restages transparently, results unchanged
+            assert QueryExecutor(segs_a).run_json(COUNT_Q) == r1
+            assert fresh_pool.snapshot().stacked_entries == 1
+    finally:
+        distributed.clear_stack_cache()

@@ -205,6 +205,27 @@ def test_real_structure_sig_mutation_is_caught():
                        config=c2) == []
 
 
+def test_real_layout_sig_mutation_is_caught():
+    """PR-17 rider gate, sharded edition: drop the segment axis from the
+    REAL speclayout.layout_sig and keyguard must flag the `layout`
+    parameter as unkeyed — a layout input silently missing from the
+    sharded program's cache key would alias programs across meshes. Stock
+    source stays clean under the same config."""
+    path = "druid_tpu/parallel/speclayout.py"
+    src = (REPO_ROOT / path).read_text()
+    assert "return (layout.seg_axis," in src
+    mutated = src.replace("return (layout.seg_axis,", "return (")
+    c = cfg("unkeyed-trace-input")
+    c.keyguard_key_fns = [f"{path}::layout_sig"]
+    got = findings_of(mutated, "unkeyed-trace-input", path=path, config=c)
+    assert any("'layout'" in f.message and "layout_sig" in f.message
+               for f in got)
+    c2 = cfg("unkeyed-trace-input")
+    c2.keyguard_key_fns = [f"{path}::layout_sig"]
+    assert findings_of(src, "unkeyed-trace-input", path=path,
+                       config=c2) == []
+
+
 # ---------------------------------------------------------------------------
 # impure-eligibility
 # ---------------------------------------------------------------------------

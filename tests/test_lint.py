@@ -208,8 +208,12 @@ VIOLATIONS = {
         "from jax import lax\n"
         "def f(a, b):\n"
         "    return lax.dot_general(a, b, (((1,), (0,)), ((), ())))\n"),
-    "shard-spec": (
+    "spec-literal-outside-layout": (
         "druid_tpu/parallel/distributed.py",
+        "from jax.sharding import PartitionSpec\n"
+        "SPEC = PartitionSpec('seg')\n"),
+    "shard-spec": (
+        "druid_tpu/parallel/speclayout.py",
         "from jax import shard_map\n"
         "from jax.sharding import PartitionSpec as P\n"
         "CACHE = {}\n"

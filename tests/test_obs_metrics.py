@@ -71,6 +71,7 @@ def test_every_monitor_metric_is_cataloged():
     from druid_tpu.data.cascade import CodeDomainMonitor, CodeDomainStats
     from druid_tpu.data.devicepool import DevicePoolMonitor
     from druid_tpu.engine.batching import BatchMetricsMonitor
+    from druid_tpu.parallel.distributed import ShardedMonitor, ShardedStats
     from druid_tpu.utils.emitter import (CacheMonitor, MonitorScheduler,
                                          ProcessMonitor, SysMonitor)
     sink = InMemoryEmitter()
@@ -81,10 +82,12 @@ def test_every_monitor_metric_is_cataloged():
     cache.put("x", "k", 1)
     cds = CodeDomainStats()
     cds.record(100)
+    shs = ShardedStats()
+    shs.record(8)
     sched = MonitorScheduler(
         em, [SysMonitor(), ProcessMonitor(), qc, CacheMonitor(cache),
              DevicePoolMonitor(), BatchMetricsMonitor(),
-             CodeDomainMonitor(cds)], 999)
+             CodeDomainMonitor(cds), ShardedMonitor(stats=shs)], 999)
     sched.tick()
     sched.tick()
     missing = catalog.validate_emitted(e.metric for e in sink.metrics())

@@ -711,7 +711,7 @@ def test_real_tree_scans_clean_with_tracecheck():
 
 # ---- shard-spec -----------------------------------------------------------
 
-SHARD = "druid_tpu/parallel/distributed.py"
+SHARD = "druid_tpu/parallel/speclayout.py"
 
 _SHARD_OK = """
     from jax import shard_map
@@ -832,6 +832,68 @@ def test_shard_spec_defaulted_params_tolerated():
         return f(xs, t0s)
     """
     assert "shard-spec" not in rules_hit(src, SHARD)
+
+
+# ---- spec-literal-outside-layout ------------------------------------------
+
+def test_spec_literal_call_outside_layout_flagged():
+    src = """
+    def place(mesh, axis, arr):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(axis)))
+    """
+    hit = rules_hit(src, "druid_tpu/parallel/distributed.py")
+    assert "spec-literal-outside-layout" in hit
+
+
+def test_spec_literal_alias_outside_layout_flagged():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    def specs(axis):
+        return (P(axis, None), P())
+    """
+    assert "spec-literal-outside-layout" in rules_hit(src, ENGINE)
+
+
+def test_spec_literal_attribute_call_flagged():
+    src = """
+    import jax.sharding
+
+    def spec(axis):
+        return jax.sharding.PartitionSpec(axis)
+    """
+    assert "spec-literal-outside-layout" in rules_hit(src, ENGINE)
+
+
+def test_spec_literal_inside_layout_module_ok():
+    src = """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def column_rows(axis):
+        return PartitionSpec(axis, None)
+
+    def sharding(mesh, spec):
+        return NamedSharding(mesh, spec)
+    """
+    assert "spec-literal-outside-layout" not in rules_hit(src, SHARD)
+
+
+def test_spec_literal_unrelated_module_clean():
+    src = """
+    def harmless(xs):
+        return [x + 1 for x in xs]
+    """
+    assert "spec-literal-outside-layout" not in rules_hit(src, ENGINE)
+
+
+def test_real_tree_spec_literals_only_in_layout():
+    """The stock tree constructs partition specs in speclayout.py ONLY —
+    the sharded rewrite left no stray literals behind."""
+    proc = _run_cli("--fail-on-new", "--no-cache", "--only",
+                    "spec-literal-outside-layout,shard-spec")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 # ---- pallas-accum-dtype: index-map i64 regression (BENCH_r04) -------------

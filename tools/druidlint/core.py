@@ -124,9 +124,11 @@ _DEFAULT_CONFIG = {
                        "druid_tpu/engine/megakernel.py"],
     # tracecheck: modules defining AggKernel subclasses (agg-contract)
     "kernel-modules": ["druid_tpu/engine/kernels.py", "druid_tpu/ext/*"],
-    # tracecheck: modules whose shard_map partition specs are checked
-    # against mesh construction + body arity (shard-spec)
-    "shard-modules": ["druid_tpu/parallel/distributed.py"],
+    # tracecheck: the canonical sharding-layout module(s) — shard_map
+    # partition specs are checked against mesh construction + body arity
+    # (shard-spec) there, and PartitionSpec/NamedSharding literals
+    # anywhere ELSE are findings (spec-literal-outside-layout)
+    "shard-modules": ["druid_tpu/parallel/speclayout.py"],
     # tracecheck: VMEM tile budget in bytes; 0 = contracts.VMEM_BUDGET_BYTES
     "vmem-cap-bytes": 0,
     # unbounded-retry: data-plane modules whose catch-and-retry loops
@@ -165,6 +167,7 @@ _DEFAULT_CONFIG = {
     # ("path::qual"); every parameter must flow into the returned key
     "keyguard-key-fns": ["druid_tpu/engine/grouping.py::_structure_sig",
                          "druid_tpu/parallel/distributed.py::_sharded_sig",
+                         "druid_tpu/parallel/speclayout.py::layout_sig",
                          "druid_tpu/engine/filters.py::bitmap_pool_key",
                          "druid_tpu/cluster/cache.py::query_cache_key",
                          "druid_tpu/cluster/cache.py::result_level_key",

@@ -1062,3 +1062,49 @@ def check_shard_spec(ctx: ModuleContext) -> Iterable[Finding]:
                              f"is not derived from the mesh (bind it from "
                              f"mesh.axis_names[...] or declare it in the "
                              f"Mesh construction)")
+
+
+_SPEC_CTORS = ("PartitionSpec", "NamedSharding")
+
+
+def _sharding_ctor_names(ctx: ModuleContext) -> Set[str]:
+    """Local names PartitionSpec/NamedSharding are importable under
+    (aliases included) — the constructors the layout module monopolizes."""
+    names = set(_SPEC_CTORS)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in _SPEC_CTORS:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+@rule("spec-literal-outside-layout", "error",
+      "PartitionSpec/NamedSharding constructed outside the layout module")
+def check_spec_literal_outside_layout(ctx: ModuleContext) -> Iterable[Finding]:
+    """Everywhere except the canonical layout module (config
+    `shard-modules` — parallel/speclayout.py), constructing a
+    PartitionSpec or NamedSharding (or importing one, which is how the
+    literal would sneak in) is a finding. The SpecLayout is the ONE source
+    of partition specs: a hand-rolled spec at a call site is exactly the
+    per-site drift the layout module exists to make impossible — it would
+    compile, shard wrong (or silently replicate), and only surface in the
+    multichip suite."""
+    if ctx.path_matches(ctx.config.shard_modules):
+        return
+    names = _sharding_ctor_names(ctx)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in _SPEC_CTORS:
+                    yield ctx.finding(
+                        node, f"import of {alias.name} outside the layout "
+                              f"module — ask the canonical SpecLayout "
+                              f"(parallel/speclayout.py) for specs/"
+                              f"shardings instead")
+        elif isinstance(node, ast.Call) and _terminal(node.func) in names:
+            yield ctx.finding(
+                node, f"{_terminal(node.func)}(...) constructed outside "
+                      f"the layout module — every partition spec must come "
+                      f"from the canonical SpecLayout "
+                      f"(parallel/speclayout.py)")
