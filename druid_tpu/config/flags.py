@@ -73,6 +73,22 @@ FLAGS = {
         default="", semantics="live",
         doc="Device segment pool budget override in bytes. Capacity "
             "bound only — never a trace input (data/devicepool.py)."),
+    "DRUID_TPU_DONATE": Flag(
+        default="auto", semantics="live", key_member=True,
+        doc="Carry-buffer donation tri-state: 'on' forces "
+            "donate_argnums (the real-TPU bench lever), 'off' disables "
+            "it, 'auto' detects by backend. Live by design — the "
+            "decision joins the jit program signature's mk= field "
+            "(engine/contracts.py donation_supported, "
+            "engine/grouping.py)."),
+    "DRUID_TPU_DONOR_WITNESS": Flag(
+        default="", semantics="latch",
+        doc="Test-only: 1 arms the suite-wide donation/ownership "
+            "witness (tools/druidlint/donorwitness.py) from "
+            "tests/conftest.py — pool takes, donating dispatches and "
+            "re-parks are tracked by array identity, and a cached-entry "
+            "donation, post-dispatch touch of a donated argument, or "
+            "un-reparked take at teardown fails the session."),
     "DRUID_TPU_LZ4": Flag(
         default="device", semantics="latch",
         doc="LZ4 frame handling: device decode (default) or 'host' "

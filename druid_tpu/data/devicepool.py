@@ -371,7 +371,14 @@ class DeviceSegmentPool:
         buffers, so they must leave the pool before the call. Stats-free
         like peek(): carry probes are handoff mechanics, not staging-cache
         outcomes, and must not skew segment/devicePool hit/miss series.
-        Never counts as an eviction either."""
+        Never counts as an eviction either.
+
+        Ownership contract (donorguard): a successful take POPS ownership
+        to the caller, who owes a re-park (get_or_build/device_cached), a
+        return, or an explicit discard on every path — the static
+        take-without-repark rule and the DRUID_TPU_DONOR_WITNESS=1
+        dynamic witness (tools/druidlint/donorwitness.py) both enforce
+        it, the witness by tracking the popped leaves' identity."""
         full_key = (owner,) + tuple(key)
         with self._lock:
             self._drain_dead_locked()

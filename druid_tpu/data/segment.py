@@ -434,7 +434,10 @@ class Segment:
         Naming the previous snapshot here lets run_grouped_aggregate's
         carry take fall back to the donor's parked grids. ONLY carries may
         bridge — they are content-free HBM allocations the kernel re-inits
-        at grid step 0; staged data never transfers between segments."""
+        at grid step 0; staged data never transfers between segments.
+        This is one of the PARK verbs in donorguard's ownership
+        vocabulary (tools/druidlint/donorguard.py): a popped carry handed
+        to the bridge counts as discharged, same as put/device_cached."""
         import weakref
         self._carry_donor = weakref.ref(donor)
 

@@ -157,6 +157,43 @@ FILTER_WORDS_PER_BLOCK = BLK_SMALL_W // FILTER_WORD_BITS
 #: DRUID_TPU_DEVICE_POOL_BYTES env var or DeviceSegmentPool.configure().
 DEVICE_POOL_BUDGET_BYTES = 4 * 1024 ** 3
 
+# ---- donation platform gate (donated carry buffers) -----------------------
+
+#: backends whose runtimes honor buffer donation. CPU *accepts*
+#: donate_argnums but silently ignores it (with a per-call warning), so
+#: only accelerator backends belong here — forcing donation elsewhere is
+#: the silent-corruption class donorguard's donate-platform-gate guards.
+DONATION_BACKENDS = ("tpu", "gpu")
+
+
+def donation_supported() -> bool:
+    """THE donation platform predicate: every donation-enable decision in
+    the engine must route through this one function (donorguard's
+    `donate-platform-gate` rule pins the inventory to the configured
+    `donorguard-platform-gate` list, which names exactly this).
+
+    Tri-state ``DRUID_TPU_DONATE``: "on"/"1" forces donation (the real-TPU
+    bench lever), "off"/"0" disables it, unset/"auto" detects by backend
+    (DONATION_BACKENDS). Read LIVE by design — the decision joins the jit
+    program signature's mk= field (engine/grouping.py), so a mid-process
+    flip keys a fresh program instead of aliasing a cached one. Imports
+    stay inside the function: this module must remain loadable standalone,
+    without jax, by the linter."""
+    import os
+    mode = os.environ.get("DRUID_TPU_DONATE", "auto").strip().lower() \
+        or "auto"
+    if mode in ("on", "1", "force"):
+        return True
+    if mode in ("off", "0"):
+        return False
+    try:
+        import jax
+        return jax.default_backend() in DONATION_BACKENDS
+    except Exception:  # druidlint: disable=swallowed-exception
+        # availability probe: no backend means no donation, never an error
+        return False
+
+
 # ---- dtype lattice --------------------------------------------------------
 
 DTYPE_BYTES = {

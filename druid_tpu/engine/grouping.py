@@ -1318,13 +1318,29 @@ def run_grouped_aggregate(segment: Segment, intervals: Sequence[Interval],
                         and megakernel.donation_enabled()
                     if carried is None or len(carried) != len(cdefs):
                         carried = megakernel.fresh_carries(cdefs)
-                    counts, states, raw = fn(arrays, aux, tuple(carried))
+                    # byte accounting BEFORE the dispatch: once the call
+                    # returns the carries are donated — invalidated on
+                    # accelerator backends — and must not be read again
+                    # (donorguard read-after-donate)
+                    donated_nbytes = sum(
+                        int(getattr(a, "nbytes", 0))
+                        for a in carried) if donated else 0
+                    try:
+                        counts, states, raw = fn(arrays, aux,
+                                                 tuple(carried))
+                    except BaseException:
+                        # the take popped ownership; a dispatch failure
+                        # (Mosaic compile error below) may have already
+                        # invalidated the donated buffers mid-flight, so
+                        # discharge them explicitly — the pool's resident
+                        # bytes stay truthful and the next tick rebuilds
+                        # fresh zeros (donorguard take-without-repark)
+                        megakernel.discard_carries(carried)
+                        raise
                     segment.device_cached(("megacarry", sig),
                                           lambda: raw)
                     if donated:
-                        megakernel.stats().record_donated(
-                            sum(int(getattr(a, "nbytes", 0))
-                                for a in carried))
+                        megakernel.stats().record_donated(donated_nbytes)
                 elif spec.strategy == "megakernel":
                     # no donation support: parking grids in the budgeted
                     # pool would only evict useful entries — run carryless

@@ -54,7 +54,8 @@ import numpy as np
 
 from druid_tpu.engine import pallas_agg
 from druid_tpu.engine.contracts import (BLK_SMALL_W, MEGA_MASK_ROW_ALIGN,
-                                        MEGA_MASK_VPW, MEGA_MASK_WIDTH)
+                                        MEGA_MASK_VPW, MEGA_MASK_WIDTH,
+                                        donation_supported)
 from druid_tpu.engine.filters import (AndNode, DeviceBitmapNode, FilterNode,
                                       NotNode, OrNode, _leaf_digest,
                                       bitmap_pool_key, collect_bitmap_nodes,
@@ -97,17 +98,14 @@ def set_force_donate(on: Optional[bool]) -> Optional[bool]:
 
 
 def donation_enabled() -> bool:
-    """Whether the fused program donates its carry buffers. Autodetect is
-    backend-based: CPU ignores donation and warns per call, so only
-    accelerator backends donate by default."""
+    """Whether the fused program donates its carry buffers. The platform
+    decision lives in ONE place — contracts.donation_supported (tri-state
+    DRUID_TPU_DONATE, backend autodetect) — so every donation-enable path
+    routes through the shared gate donorguard's donate-platform-gate
+    rule recognizes; this function only layers the test override on top."""
     if _FORCE_DONATE is not None:
         return _FORCE_DONATE
-    try:
-        import jax
-        return jax.default_backend() in ("tpu", "gpu")
-    except Exception:  # druidlint: disable=swallowed-exception
-        # availability probe: no backend means no donation, never an error
-        return False
+    return donation_supported()
 
 
 def set_force_carry(on: Optional[bool]) -> Optional[bool]:
@@ -478,6 +476,28 @@ def fresh_carries(defs: Sequence[Tuple[Tuple[int, int], object]]) -> Tuple:
     never read — the kernel re-initializes every grid at step 0 — so zeros
     vs a prior tick's partials are bit-identical by construction."""
     return tuple(np.zeros(shape, dtype=dt) for shape, dt in defs)
+
+
+def discard_carries(carries: Optional[Sequence]) -> None:
+    """Explicitly release carry grids popped for a dispatch that FAILED:
+    donation may have invalidated their buffers mid-flight, so they can be
+    neither re-parked nor reused — the exception path must discharge the
+    ownership the take popped, or the grids dangle as untracked HBM while
+    the pool's byte accounting (already decremented by take) looks clean.
+    Host placeholder carries (fresh zeros) have no device buffer and are
+    skipped. Both donorguard's take-without-repark rule and the donor
+    witness (tools/druidlint/donorwitness.py) recognize this call as the
+    exception-path ownership discharge."""
+    for a in carries or ():
+        delete = getattr(a, "delete", None)
+        if delete is None:
+            continue
+        try:
+            delete()
+        except Exception:  # druidlint: disable=swallowed-exception
+            # an already-invalidated donated buffer raises on delete; the
+            # goal (buffer gone, accounting truthful) already holds
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,11 @@ def _round_up(x: int, m: int) -> int:
 
 
 def backend_ok() -> bool:
+    """Pallas availability probe — one of the two platform predicates
+    blessed by `donorguard-platform-gate` (the other is
+    contracts.donation_supported): backend comparisons anywhere else in
+    the tree fail the donate-platform-gate rule, so strategy and
+    donation decisions cannot scatter into inline checks."""
     if _FORCE_INTERPRET or os.environ.get("DRUID_TPU_PALLAS") == "interpret":
         return True
     if os.environ.get("DRUID_TPU_PALLAS") == "0" or _BROKEN is not None:

@@ -87,6 +87,24 @@ if os.environ.get("DRUID_TPU_KEY_WITNESS") == "1":
     from tools.druidlint.keywitness import session_witness as _key_witness
     _key_witness(_root)
 
+# Opt-in whole-suite donation/ownership witness (DRUID_TPU_DONOR_WITNESS=1):
+# the dynamic side of donorguard. Like the key witness it patches module
+# globals (the pool take/get_or_build methods, the donating builder, the
+# discard helper), so it installs AFTER the engine import — it tracks
+# array identity across the take→dispatch→re-park cycle, SIMULATES
+# donation invalidation on CPU by deleting donated carry buffers after a
+# successful dispatch, and fails the session on a cached-entry donation
+# or an un-reparked take in pytest_unconfigure. Same process-wide
+# singleton rationale as the other witnesses.
+if os.environ.get("DRUID_TPU_DONOR_WITNESS") == "1":
+    import sys as _sys
+    from pathlib import Path as _Path
+    _root = str(_Path(__file__).resolve().parent.parent)
+    if _root not in _sys.path:
+        _sys.path.insert(0, _root)
+    from tools.druidlint.donorwitness import session_witness as _donor_witness
+    _donor_witness(_root)
+
 DAY = Interval.of("2026-01-01", "2026-01-02")
 WEEK = Interval.of("2026-01-01", "2026-01-08")
 
@@ -178,8 +196,9 @@ def pytest_collection_finish(session):
 
 
 def pytest_unconfigure(config):
-    # a lock-witness violation must not skip the stall/key/leak checks (or
-    # leave hooks monkeypatched): run all four even if an earlier raises
+    # a lock-witness violation must not skip the stall/key/donor/leak
+    # checks (or leave hooks monkeypatched): run all five even if an
+    # earlier raises
     try:
         _unconfigure_lock_witness()
     finally:
@@ -189,7 +208,10 @@ def pytest_unconfigure(config):
             try:
                 _unconfigure_key_witness()
             finally:
-                _unconfigure_leak_witness()
+                try:
+                    _unconfigure_donor_witness()
+                finally:
+                    _unconfigure_leak_witness()
 
 
 def _unconfigure_stall_witness():
@@ -221,6 +243,23 @@ def _unconfigure_key_witness():
     if w.collisions:
         raise pytest.UsageError(
             "key witness found cache-key collisions (see lines above)")
+
+
+def _unconfigure_donor_witness():
+    if os.environ.get("DRUID_TPU_DONOR_WITNESS") != "1":
+        return
+    from tools.druidlint.donorwitness import end_session_witness
+    w = end_session_witness()
+    if w is None:
+        return
+    violations = w.all_violations()
+    print(f"donorwitness: {w.summary()}")
+    for v in violations:
+        print(f"donorwitness: VIOLATION {v}")
+    if violations:
+        raise pytest.UsageError(
+            "donor witness found buffer-ownership violations (see lines "
+            "above)")
 
 
 def _unconfigure_leak_witness():

@@ -24,8 +24,9 @@ from tools.druidlint.core import split_by_baseline  # noqa: E402
 
 def test_tree_is_clean_and_fast():
     """`python -m tools.druidlint --all --fail-on-new` — the UNIFIED gate:
-    all six analyzer families (druidlint/tracecheck/raceguard/leakguard/
-    keyguard/stallguard) in one process over the shared program/cache pass
+    all seven analyzer families (druidlint/tracecheck/raceguard/leakguard/
+    keyguard/stallguard/donorguard) in one process over the shared
+    program/cache pass
     — exits 0 on the
     shipped tree under a single wall-clock budget. The first run may be
     cold (fresh checkout: no .druidlint-cache.json — the whole-program
@@ -45,12 +46,13 @@ def test_tree_is_clean_and_fast():
     assert proc.returncode == 0, (
         f"druidlint found new violations:\n{proc.stdout}{proc.stderr}")
     assert elapsed < 10.0, (
-        f"unified gate took {elapsed:.1f}s (budget 10s for all six "
+        f"unified gate took {elapsed:.1f}s (budget 10s for all seven "
         f"families together)")
     payload = json.loads(proc.stdout)
     assert set(payload["families"]) == {"druidlint", "tracecheck",
                                         "raceguard", "leakguard",
-                                        "keyguard", "stallguard"}
+                                        "keyguard", "stallguard",
+                                        "donorguard"}
     for name, info in payload["families"].items():
         assert info["rules"] > 0, f"family {name} registered no rules"
         assert info["findings"] == 0
@@ -414,6 +416,50 @@ VIOLATIONS = {
         "            self._step()\n"
         "    def _step(self):\n"
         "        pass\n"),
+    # ---- donorguard rules ----
+    "read-after-donate": (
+        "druid_tpu/engine/donatey.py",
+        "import jax\n"
+        "def build():\n"
+        "    def fn(arrays, aux, carries):\n"
+        "        return carries\n"
+        "    return jax.jit(fn, donate_argnums=(2,))\n"
+        "def run(pool, arrays, aux):\n"
+        "    fn = build()\n"
+        "    carried = pool.take('o', ('k',))\n"
+        "    out = fn(arrays, aux, carried)\n"
+        "    return out, sum(a.nbytes for a in carried)\n"),
+    "donate-cached-entry": (
+        "druid_tpu/engine/donatey.py",
+        "import jax\n"
+        "def build():\n"
+        "    def fn(arrays, aux, carries):\n"
+        "        return carries\n"
+        "    return jax.jit(fn, donate_argnums=(2,))\n"
+        "def run(pool, arrays, aux, make):\n"
+        "    fn = build()\n"
+        "    carried = pool.get_or_build('o', ('k',), make)\n"
+        "    return fn(arrays, aux, carried)\n"),
+    "take-without-repark": (
+        "druid_tpu/engine/donatey.py",
+        "def run(pool, log):\n"
+        "    carried = pool.take('o', ('k',))\n"
+        "    log(carried)\n"),
+    "donate-platform-gate": (
+        "druid_tpu/engine/donatey.py",
+        "import jax\n"
+        "def enabled():\n"
+        "    return jax.default_backend() in ('tpu', 'gpu')\n"),
+    "carry-grid-init": (
+        "druid_tpu/engine/donatey.py",
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "def agg(arrays):\n"
+        "    def kernel(ref):\n"
+        "        ref[0] = ref[0] + 1\n"
+        "    return pl.pallas_call(kernel)(arrays)\n"
+        "def build():\n"
+        "    return jax.jit(agg, donate_argnums=(0,))\n"),
 }
 
 
@@ -443,8 +489,9 @@ def test_each_rule_fails_a_synthetic_violation(rule_name, tmp_path):
 def test_rule_registry_is_complete():
     """All project rules (nine control-plane incl. metric-name,
     wire-decoded-rows and flag-name + seven tracecheck + four raceguard
-    + five leakguard + three keyguard + five stallguard) plus the
-    unused-suppression audit are registered with severities."""
+    + five leakguard + three keyguard + five stallguard + five
+    donorguard) plus the unused-suppression audit are registered with
+    severities."""
     rules = registered_rules()
     assert set(VIOLATIONS) <= set(rules)
     assert "unused-suppression" in rules

@@ -278,6 +278,74 @@ def test_mega_carry_ticks_no_pool_growth_and_parity(monkeypatch):
     assert not leftover
 
 
+def test_mega_carry_failed_dispatch_discards_ownership(monkeypatch):
+    """A dispatch failure AFTER the carry take (the Mosaic-compile window)
+    must DISCARD the popped grids, not re-park them — donation may have
+    invalidated the buffers mid-flight — and leave the pool's byte
+    accounting truthful: resident bytes must equal the entries actually
+    held, with no megacarry entry surviving the failure (donorguard
+    take-without-repark, enforced on grouping's exception path)."""
+    import collections
+    segs, q = _proj_setup(monkeypatch)
+    ex = QueryExecutor(segs)
+    prev_c = megakernel.set_force_carry(True)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            first = ex.run_json(q)          # parks one carry per segment
+            assert [k for s in segs for k in s._pool._entries
+                    if "megacarry" in k]
+            discards = []
+            real_discard = megakernel.discard_carries
+
+            def spy_discard(carries):
+                discards.append(len(carries))
+                return real_discard(carries)
+
+            monkeypatch.setattr(megakernel, "discard_carries", spy_discard)
+            # fresh program cache + a builder whose megakernel product
+            # raises: the dispatch fails between the take and the re-park
+            monkeypatch.setattr(grouping, "_JIT_CACHE",
+                                collections.OrderedDict())
+            real_build = grouping._build_device_fn
+
+            def broken_build(spec, *a, **k):
+                fn = real_build(spec, *a, **k)
+                if spec.strategy != "megakernel":
+                    return fn
+
+                def boom(arrays, aux, carries=()):
+                    raise RuntimeError("synthetic Mosaic failure")
+
+                return boom
+
+            monkeypatch.setattr(grouping, "_build_device_fn", broken_build)
+            fallback = ex.run_json(q)       # fails mid-carry, falls back
+        # XLA fallback stays correct (floats to tolerance: the windowed
+        # path accumulates in a different block order than the kernel)
+        assert len(fallback) == len(first)
+        for got, want in zip(fallback, first):
+            assert got["event"].keys() == want["event"].keys()
+            for name, v in got["event"].items():
+                if isinstance(v, float):
+                    assert v == pytest.approx(want["event"][name],
+                                              rel=1e-5)
+                else:
+                    assert v == want["event"][name]
+        assert discards                     # popped grids were discharged
+        pool = device_pool()
+        with pool._lock:
+            leftover = [k for k in pool._entries if "megacarry" in k]
+            drift = pool._resident - sum(v[1]
+                                         for v in pool._entries.values())
+        assert not leftover                 # discarded, NOT re-parked
+        assert drift == 0                   # books match held entries
+    finally:
+        megakernel.set_force_carry(prev_c)
+        pallas_agg._BROKEN = None           # un-latch for later tests
+        device_pool().clear()
+
+
 def test_mega_pallas_packed_columns_parity(monkeypatch, mk_segments):
     """Packed value columns ride the fused kernel as words (the PR 9
     in-kernel unpack) — parity against decoded staging through the same

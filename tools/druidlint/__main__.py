@@ -22,11 +22,11 @@ from tools.druidlint.core import (family_of, lint_paths, load_baseline,
                                   load_config, registered_rules,
                                   save_baseline, split_by_baseline)
 
-#: the six analyzer families --all asserts are all registered and runs in
-#: ONE process over ONE shared program/cache pass (tier-1 used to pay the
-#: whole-program index once per analyzer CLI invocation)
+#: the seven analyzer families --all asserts are all registered and runs
+#: in ONE process over ONE shared program/cache pass (tier-1 used to pay
+#: the whole-program index once per analyzer CLI invocation)
 _ALL_FAMILIES = ("druidlint", "tracecheck", "raceguard", "leakguard",
-                 "keyguard", "stallguard")
+                 "keyguard", "stallguard", "donorguard")
 
 
 def _changed_paths(root: Path):
@@ -103,11 +103,11 @@ def main(argv=None) -> int:
                          "program index changed (whole-program findings "
                          "can move across modules then)")
     ap.add_argument("--all", action="store_true", dest="all_families",
-                    help="unified gate: assert all six analyzer families "
+                    help="unified gate: assert all seven analyzer families "
                          "(druidlint/tracecheck/raceguard/leakguard/"
-                         "keyguard/stallguard) are registered, run them in "
-                         "one process over the shared caches, and report "
-                         "findings per family")
+                         "keyguard/stallguard/donorguard) are registered, "
+                         "run them in one process over the shared caches, "
+                         "and report findings per family")
     args = ap.parse_args(argv)
 
     if args.all_families and args.only:

@@ -82,6 +82,7 @@ def registered_rules() -> Dict[str, Rule]:
     from tools.druidlint import leakguard as _leakguard  # noqa: F401
     from tools.druidlint import keyguard as _keyguard  # noqa: F401
     from tools.druidlint import stallguard as _stallguard  # noqa: F401
+    from tools.druidlint import donorguard as _donorguard  # noqa: F401
     return dict(_RULES)
 
 
@@ -89,7 +90,8 @@ def registered_rules() -> Dict[str, Rule]:
 #: unified `--all` runner groups findings and timings by this
 _FAMILIES = {"rules": "druidlint", "tracecheck": "tracecheck",
              "raceguard": "raceguard", "leakguard": "leakguard",
-             "keyguard": "keyguard", "stallguard": "stallguard"}
+             "keyguard": "keyguard", "stallguard": "stallguard",
+             "donorguard": "donorguard"}
 
 
 def family_of(r: Rule) -> str:
@@ -186,6 +188,13 @@ _DEFAULT_CONFIG = {
     # everything they reach through the call graph inherits the
     # request-path park rules
     "stallguard-request-roots": [],
+    # donorguard donate-platform-gate: the blessed platform predicates
+    # ("path-glob::qual-glob") — the ONE donation gate plus the pallas
+    # availability probe; a backend/platform comparison anywhere else is
+    # a scattered donation-enable decision (the CPU-segfault class)
+    "donorguard-platform-gate": [
+        "druid_tpu/engine/contracts.py::donation_supported",
+        "druid_tpu/engine/pallas_agg.py::backend_ok"],
     # unused-suppression audit (CLI --report-unused-suppressions)
     "report-unused-suppressions": False,
 }
@@ -240,6 +249,9 @@ class LintConfig:
     stallguard_request_roots: List[str] = field(
         default_factory=lambda: list(
             _DEFAULT_CONFIG["stallguard-request-roots"]))
+    donorguard_platform_gate: List[str] = field(
+        default_factory=lambda: list(
+            _DEFAULT_CONFIG["donorguard-platform-gate"]))
     report_unused_suppressions: bool = False
     #: scan root; tracecheck resolves druid_tpu/engine/contracts.py here
     #: (set by load_config/lint_paths, not a pyproject key)

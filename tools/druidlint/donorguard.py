@@ -1,0 +1,746 @@
+"""donorguard: whole-program device-buffer ownership & donation
+soundness — a donated buffer is gone, and the pool's books must agree.
+
+The seventh analyzer family, riding raceguard's shared program index
+(same module set, binder, call graph, cache signature). Every path built
+since PR 11 leans on donated accumulator grids
+(`jax.jit(donate_argnums=...)` plus the `DeviceSegmentPool.take`/re-park
+protocol), yet donation has only ever executed DISABLED on CPU: the
+first real-TPU run with donation on is the first time a donated buffer
+is genuinely invalidated, and every ownership sloppiness the parity
+suite cannot see today surfaces there as silent corruption or an HBM
+leak. donorguard discovers every donation site (literal
+``donate_argnums`` in a jit call, and every "donating builder" — a
+function that returns such a jit), every pool ownership transfer
+(`take`/`device_take` pops, `put`/`get_or_build`/`device_cached`/
+`adopt_carries_from` parks), and enforces five rules:
+
+  read-after-donate       a local passed in a donated position is
+                          referenced again after the dispatch — on a
+                          donating backend that buffer no longer exists
+  donate-cached-entry     a `get_or_build`/`device_cached`/`peek` result
+                          flows into a donated argnum without an
+                          intervening ownership-popping take — donating
+                          a buffer the pool still references poisons
+                          every future hit
+  take-without-repark     popped ownership is not re-parked, returned,
+                          or explicitly discarded on every path,
+                          including exception paths — leakguard's
+                          lifecycle discipline extended to device
+                          buffers
+  donate-platform-gate    every backend/platform comparison must live in
+                          a configured shared predicate
+                          (`donorguard-platform-gate`) — a scattered
+                          donation-enable decision is the CPU-segfault
+                          class
+  carry-grid-init         a pallas program reachable from a donating jit
+                          must re-initialize its accumulator grids at
+                          grid step 0 (`@pl.when(i == 0)`), the PR 11
+                          bit-identity discipline; a fresh-init design
+                          declares itself with a rationale suppression
+
+The dynamic peer is tools/druidlint/donorwitness.py: armed suite-wide by
+DRUID_TPU_DONOR_WITNESS=1, it tracks array identity across the
+take → dispatch → re-park cycle and fails the session on a cached-entry
+donation, a post-dispatch touch of a donated argument, or un-reparked
+takes at teardown — so the ownership PROTOCOL is enforced even while
+donation itself stays off on CPU.
+
+Analysis model: lineno-linear within a function (loop back-edges are
+ignored; the dispatch loops in this tree rebind their carries at the
+loop top, so the linear view is the honest one), donation positions are
+literal-only (`donate_argnums=(2,)`), and emission is gated to the
+raceguard module set. Findings are memoized on the Program per config
+key, keyguard-style: the blessed-gate list is config, not program state.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from types import SimpleNamespace
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.druidlint.core import Finding, ModuleContext, rule
+from tools.druidlint.raceguard import (FuncInfo, ModuleInfo, Program, Site,
+                                       _own, _resolve_import)
+from tools.druidlint.rules import _FUNC_DEFS, _terminal
+
+# ---------------------------------------------------------------------------
+# ownership vocabulary
+# ---------------------------------------------------------------------------
+
+#: method terminals that POP pool ownership into the caller
+_TAKE_VERBS = ("take", "device_take")
+
+#: method terminals that PARK ownership back into a pool / registry
+_PARK_VERBS = ("put", "get_or_build", "device_cached", "adopt_carries_from")
+
+#: call terminals whose result is a still-pool-referenced cached entry
+_CACHE_GETTERS = ("get_or_build", "device_cached", "peek")
+
+
+def _discardish(terminal: str) -> bool:
+    """An explicit ownership-discharge verb (megakernel.discard_carries,
+    a drop_* helper): consumes a popped buffer on a failure path."""
+    t = terminal.lower()
+    return "discard" in t or "drop" in t
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (stallguard's shapes)
+# ---------------------------------------------------------------------------
+
+def _match_fid(fid: str, entries: List[str]) -> bool:
+    path, _, qual = fid.partition("::")
+    for e in entries:
+        ep, _, eq = e.partition("::")
+        if fnmatch.fnmatch(path, ep) and fnmatch.fnmatch(qual, eq):
+            return True
+    return False
+
+
+def _own_sorted(fi: FuncInfo) -> List[ast.AST]:
+    return sorted((n for n in _own(fi) if hasattr(n, "lineno")),
+                  key=lambda n: (n.lineno, n.col_offset))
+
+
+def _parents_of(fi: FuncInfo) -> Dict[ast.AST, ast.AST]:
+    """Child → parent over fi's own scope (nested def/class bodies are
+    separate FuncInfos and excluded, mirroring _own)."""
+    out: Dict[ast.AST, ast.AST] = {}
+    stack = [fi.node]
+    while stack:
+        node = stack.pop()
+        if node is not fi.node and isinstance(
+                node, _FUNC_DEFS + (ast.ClassDef,)):
+            continue
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+            stack.append(child)
+    return out
+
+
+def _mentions(node: ast.AST, names: Set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def _call_args_mention(call: ast.Call, names: Set[str]) -> bool:
+    return any(_mentions(a, names) for a in call.args) or \
+        any(_mentions(k.value, names) for k in call.keywords)
+
+
+def _chain(parents: Dict[ast.AST, ast.AST], node: ast.AST) -> List[ast.AST]:
+    out = [node]
+    cur = parents.get(node)
+    while cur is not None:
+        out.append(cur)
+        cur = parents.get(cur)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation-site discovery
+# ---------------------------------------------------------------------------
+
+def _donate_positions(node: ast.AST) -> Optional[FrozenSet[int]]:
+    """Literal donate_argnums positions of a jit(...) call, else None.
+    Non-literal argnums donate *something* but the positions are
+    unknowable statically — those sites are skipped (the tree only uses
+    literal tuples; keeping the analysis literal-only keeps it quiet)."""
+    if not isinstance(node, ast.Call) or _terminal(node.func) != "jit":
+        return None
+    for k in node.keywords:
+        if k.arg != "donate_argnums":
+            continue
+        v = k.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return frozenset((v.value,))
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None
+                out.add(e.value)
+            return frozenset(out)
+        return None
+    return None
+
+
+def _donating_builders(prog: Program) -> Dict[str, FrozenSet[int]]:
+    """func_id → donated positions, for every function that RETURNS a
+    jit-with-donate on some path (grouping._build_device_fn's shape:
+    strategy decides which jit construction is returned; the union of
+    the donated positions over all return sites is the may-set)."""
+    out: Dict[str, FrozenSet[int]] = {}
+    for fid, fi in prog.funcs.items():
+        if not isinstance(fi.node, _FUNC_DEFS):
+            continue
+        pos: Set[int] = set()
+        for node in _own(fi):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                p = _donate_positions(sub)
+                if p:
+                    pos |= p
+        if pos:
+            out[fid] = frozenset(pos)
+    return out
+
+
+def _resolve_name_func(prog: Program, mod: Optional[ModuleInfo],
+                       fi: Optional[FuncInfo],
+                       name: str) -> Optional[str]:
+    """A bare Name in fi's scope → program func_id: nested def,
+    module-level function, or imported symbol (re-export chains via
+    raceguard's resolver)."""
+    if fi is not None:
+        cand = f"{fi.path}::{fi.qual}.<locals>.{name}"
+        if cand in prog.funcs:
+            return cand
+    if mod is None:
+        return None
+    got = mod.globals.get(name)
+    if got is not None and got[0] == "func":
+        return got[1]
+    imp = mod.imports.get(name)
+    if imp is not None:
+        r = _resolve_import(prog, ("import",) + imp)
+        if r is not None and r[0] == "func":
+            return r[1]
+    return None
+
+
+def _callee_fid(prog: Program, mod: Optional[ModuleInfo], fi: FuncInfo,
+                call: ast.Call) -> Optional[str]:
+    """Resolve a call's callee to a program func_id (Name or
+    one-level module-attribute form), else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return _resolve_name_func(prog, mod, fi, f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and mod is not None:
+        imp = mod.imports.get(f.value.id)
+        if imp is not None:
+            r = _resolve_import(prog, ("import",) + imp)
+            if r is not None and r[0] == "module" and r[1] is not None:
+                cand = f"{r[1]}::{f.attr}"
+                if cand in prog.funcs:
+                    return cand
+    return None
+
+
+def _module_donating(prog: Program, mod: ModuleInfo,
+                     builders: Dict[str, FrozenSet[int]]) \
+        -> Dict[str, FrozenSet[int]]:
+    """Module-level name → donated positions, for globals assigned from a
+    direct jit-with-donate or a donating-builder call."""
+    out: Dict[str, FrozenSet[int]] = {}
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        p = _donate_positions(v)
+        if p is None and isinstance(v, ast.Call):
+            callee = _callee_fid(prog, mod, None, v) \
+                if not isinstance(v.func, ast.Name) else \
+                _resolve_name_func(prog, mod, None, v.func.id)
+            if callee in builders:
+                p = builders[callee]
+        if p:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = out.get(t.id, frozenset()) | p
+    return out
+
+
+def _donating_names(prog: Program, mod: Optional[ModuleInfo], fi: FuncInfo,
+                    builders: Dict[str, FrozenSet[int]],
+                    mod_donating: Dict[str, FrozenSet[int]]) \
+        -> Dict[str, FrozenSet[int]]:
+    """Local (and visible module-global) name → donated positions, from
+    ANY assignment whose value is a direct jit-with-donate or a call to
+    a donating builder. May-analysis: the grouping dispatch loop binds
+    `fn` from the jit cache OR the builder; either binding donating
+    makes every `fn(...)` call a donating dispatch."""
+    out: Dict[str, FrozenSet[int]] = dict(mod_donating)
+    for node in _own(fi):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        p = _donate_positions(v)
+        if p is None and isinstance(v, ast.Call):
+            callee = _callee_fid(prog, mod, fi, v)
+            if callee in builders:
+                p = builders[callee]
+        if p:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = out.get(t.id, frozenset()) | p
+    return out
+
+
+def _dispatches(fi: FuncInfo,
+                donating: Dict[str, FrozenSet[int]]) \
+        -> List[Tuple[ast.Call, Set[str]]]:
+    """Donating dispatch calls in fi's own scope, each with the set of
+    local names mentioned in its donated positional arguments."""
+    out: List[Tuple[ast.Call, Set[str]]] = []
+    for node in _own(fi):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)):
+            continue
+        pos = donating.get(node.func.id)
+        if not pos:
+            continue
+        names: Set[str] = set()
+        for i in sorted(pos):
+            if i < len(node.args):
+                names |= {n.id for n in ast.walk(node.args[i])
+                          if isinstance(n, ast.Name)}
+        out.append((node, names))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the five checks
+# ---------------------------------------------------------------------------
+
+def _in_discard(parents: Dict[ast.AST, ast.AST], node: ast.AST) -> bool:
+    for anc in _chain(parents, node)[1:]:
+        if isinstance(anc, ast.Call) and _discardish(_terminal(anc.func)):
+            return True
+    return False
+
+
+def _check_read_after_donate(prog: Program, config, add,
+                             builders, mod_donating) -> None:
+    for fid, fi in prog.funcs.items():
+        if not isinstance(fi.node, _FUNC_DEFS):
+            continue
+        mod = prog.modules.get(fi.path)
+        donating = _donating_names(prog, mod, fi, builders,
+                                   mod_donating.get(fi.path, {}))
+        if not donating:
+            continue
+        dispatches = _dispatches(fi, donating)
+        if not dispatches:
+            continue
+        parents = _parents_of(fi)
+        own = _own_sorted(fi)
+        for dnode, names in dispatches:
+            end = getattr(dnode, "end_lineno", None) or dnode.lineno
+            live = set(names)
+            reported: Set[str] = set()
+            for node in own:
+                if node.lineno <= end or not live:
+                    continue
+                if isinstance(node, ast.Name) and node.id in live:
+                    if isinstance(node.ctx, ast.Store):
+                        live.discard(node.id)   # rebound; later reads fine
+                    elif isinstance(node.ctx, ast.Load) \
+                            and node.id not in reported \
+                            and not _in_discard(parents, node):
+                        reported.add(node.id)
+                        add("read-after-donate",
+                            Site(fi.path, node.lineno, node.col_offset),
+                            f"`{node.id}` was passed in a donated position "
+                            f"at line {dnode.lineno} — on a donating "
+                            f"backend its buffer no longer exists; compute "
+                            f"from it before the dispatch, rebind it, or "
+                            f"discard it explicitly")
+
+
+_CTRL = (ast.If, ast.While, ast.For, ast.Try, ast.ExceptHandler)
+
+
+def _ctrl_of(parents: Dict[ast.AST, ast.AST],
+             node: ast.AST) -> FrozenSet[int]:
+    """Identity set of the node's control-region ancestors. A clears B's
+    taint only when ctrl(A) ⊆ ctrl(B): every path to B then passes
+    through A's block — the dominance proxy that keeps the cached-entry
+    state a MAY-set across branches (a fallback assignment inside an
+    `if carried is None` must not launder a cached entry taken on the
+    other branch)."""
+    return frozenset(id(a) for a in _chain(parents, node)[1:]
+                     if isinstance(a, _CTRL))
+
+
+def _check_cached_entry(prog: Program, config, add,
+                        builders, mod_donating) -> None:
+    for fid, fi in prog.funcs.items():
+        if not isinstance(fi.node, _FUNC_DEFS):
+            continue
+        mod = prog.modules.get(fi.path)
+        donating = _donating_names(prog, mod, fi, builders,
+                                   mod_donating.get(fi.path, {}))
+        if not donating:
+            continue
+        dispatches = _dispatches(fi, donating)
+        if not dispatches:
+            continue
+        parents = _parents_of(fi)
+        own = _own_sorted(fi)
+        for dnode, _names in dispatches:
+            dctrl = _ctrl_of(parents, dnode)
+            cached: Set[str] = set()
+            for node in own:
+                if node.lineno >= dnode.lineno:
+                    break
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                names = {t.id for t in targets if isinstance(t, ast.Name)}
+                v = node.value
+                if not names or v is None:
+                    continue
+                if any(isinstance(sub, ast.Call)
+                       and _terminal(sub.func) in _CACHE_GETTERS
+                       for sub in ast.walk(v)):
+                    cached |= names         # pool still references this
+                elif _mentions(v, cached):
+                    cached |= names         # derived from a cached entry
+                elif _ctrl_of(parents, node) <= dctrl:
+                    # ownership-popping take or clean rebind — clears the
+                    # taint only when it dominates the dispatch; a branch
+                    # the dispatch can skip does not launder the entry
+                    cached -= names
+            pos = donating.get(dnode.func.id) or frozenset()
+            for i in sorted(pos):
+                if i < len(dnode.args) \
+                        and _mentions(dnode.args[i], cached):
+                    add("donate-cached-entry",
+                        Site(fi.path, dnode.args[i].lineno,
+                             dnode.args[i].col_offset),
+                        f"donated argument {i} of `{dnode.func.id}` "
+                        f"derives from a cached pool entry "
+                        f"(get_or_build/device_cached/peek) with no "
+                        f"ownership-popping take in between — the "
+                        f"pool's next hit returns an invalidated "
+                        f"buffer; pop it with take()/device_take() "
+                        f"first")
+                    break
+
+
+def _check_take_repark(prog: Program, config, add,
+                       builders, mod_donating) -> None:
+    for fid, fi in prog.funcs.items():
+        if not isinstance(fi.node, _FUNC_DEFS):
+            continue
+        takes: List[Tuple[str, ast.Assign]] = []
+        for node in _own(fi):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and _terminal(node.value.func) in _TAKE_VERBS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        takes.append((t.id, node))
+        if not takes:
+            continue
+        mod = prog.modules.get(fi.path)
+        donating = _donating_names(prog, mod, fi, builders,
+                                   mod_donating.get(fi.path, {}))
+        parents = _parents_of(fi)
+        for name, tnode in takes:
+            consumes: List[Tuple[ast.AST, bool]] = []   # (node, can_raise)
+            for node in _own(fi):
+                if isinstance(node, ast.Call):
+                    t = _terminal(node.func)
+                    is_dispatch = isinstance(node.func, ast.Name) \
+                        and bool(donating.get(node.func.id))
+                    if (t in _PARK_VERBS or _discardish(t) or is_dispatch) \
+                            and _call_args_mention(node, {name}):
+                        consumes.append((node, is_dispatch))
+                elif isinstance(node, ast.Return) and node.value is not None \
+                        and _mentions(node.value, {name}):
+                    consumes.append((node, False))
+                elif isinstance(node, ast.Delete) and any(
+                        isinstance(d, ast.Name) and d.id == name
+                        for d in node.targets):
+                    consumes.append((node, False))
+            if not consumes:
+                add("take-without-repark",
+                    Site(fi.path, tnode.lineno, tnode.col_offset),
+                    f"take pops `{name}` from the pool but no path "
+                    f"re-parks, returns, or discards it — the popped "
+                    f"buffer dangles as untracked device memory; park it "
+                    f"back (put/device_cached) or discard it explicitly")
+                continue
+            # exception-path coverage: a consume that can raise mid-donation
+            # (the donating dispatch) must have SOME enclosing try whose
+            # handler/finalbody also consumes the popped name — otherwise
+            # the exception path drops ownership silently
+            consume_chains = [(_chain(parents, n), n) for n, _ in consumes]
+            for cnode, can_raise in consumes:
+                if not can_raise:
+                    continue
+                covered = False
+                unprotected = True
+                for anc in _chain(parents, cnode)[1:]:
+                    if not isinstance(anc, ast.Try):
+                        continue
+                    unprotected = False
+                    for ch, other in consume_chains:
+                        if other is cnode or anc not in ch:
+                            continue
+                        child = ch[ch.index(anc) - 1]
+                        if isinstance(child, ast.ExceptHandler) or \
+                                any(child is x for x in anc.finalbody):
+                            covered = True
+                            break
+                    if covered:
+                        break
+                if not covered and not unprotected:
+                    add("take-without-repark",
+                        Site(fi.path, tnode.lineno, tnode.col_offset),
+                        f"take pops `{name}` but the donating dispatch at "
+                        f"line {cnode.lineno} sits in a try whose handlers "
+                        f"never re-park or discard it — a dispatch failure "
+                        f"drops the popped buffer and the pool's byte "
+                        f"accounting drifts; discard it in an except/"
+                        f"finally (megakernel.discard_carries)")
+
+
+def _platform_probe(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and _terminal(sub.func) == "default_backend":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "platform" \
+                and _terminal(sub.value) != "sys":
+            return True
+    return False
+
+
+def _check_platform_gate(prog: Program, config, add) -> None:
+    allowed = list(getattr(config, "donorguard_platform_gate", []) or [])
+    for path, mod in prog.modules.items():
+        stack: List[Tuple[ast.AST, str, str]] = [(mod.tree, "", "module")]
+        while stack:
+            node, qual, kind = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_DEFS):
+                    sep = ".<locals>." if kind == "func" else \
+                        "." if qual else ""
+                    stack.append((child, f"{qual}{sep}{child.name}",
+                                  "func"))
+                elif isinstance(child, ast.ClassDef):
+                    cq = f"{qual}.{child.name}" if qual else child.name
+                    stack.append((child, cq, "class"))
+                elif isinstance(child, ast.Compare):
+                    if _platform_probe(child):
+                        fid = f"{path}::{qual or '<module>'}"
+                        if not _match_fid(fid, allowed):
+                            add("donate-platform-gate",
+                                Site(path, child.lineno, child.col_offset),
+                                f"backend/platform comparison outside the "
+                                f"shared gate ({qual or '<module>'}) — "
+                                f"every donation-enable decision must "
+                                f"route through contracts."
+                                f"donation_supported (or be declared in "
+                                f"`donorguard-platform-gate`)")
+                else:
+                    stack.append((child, qual, kind))
+
+
+def _is_zero(e: ast.AST) -> bool:
+    """0, or a one-argument cast of 0 (jnp.int32(0))."""
+    if isinstance(e, ast.Constant):
+        return e.value == 0 and not isinstance(e.value, bool)
+    if isinstance(e, ast.Call) and len(e.args) == 1 and not e.keywords:
+        return _is_zero(e.args[0])
+    return False
+
+
+def _has_step0_init(prog: Program, host: FuncInfo) -> bool:
+    """Some def nested under `host` carries a `@pl.when(i == 0)`-shaped
+    decorator (either comparand a literal/cast zero) — the grid-step-0
+    re-initialization that makes donated reuse bit-identical to fresh
+    zeros."""
+    pref = host.qual + "."
+    for fid, fi in prog.funcs.items():
+        if fi.path != host.path or not fi.qual.startswith(pref):
+            continue
+        for dec in getattr(fi.node, "decorator_list", ()):
+            if not (isinstance(dec, ast.Call)
+                    and _terminal(dec.func) == "when" and dec.args):
+                continue
+            cmp = dec.args[0]
+            if isinstance(cmp, ast.Compare) and len(cmp.ops) == 1 \
+                    and isinstance(cmp.ops[0], ast.Eq) \
+                    and (_is_zero(cmp.left)
+                         or _is_zero(cmp.comparators[0])):
+                return True
+    return False
+
+
+def _check_carry_init(prog: Program, config, add, builders) -> None:
+    seen: Set[str] = set()
+    for fid, fi in prog.funcs.items():
+        if not isinstance(fi.node, _FUNC_DEFS):
+            continue
+        mod = prog.modules.get(fi.path)
+        for node in _own(fi):
+            if _donate_positions(node) is None:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            entry = _resolve_name_func(prog, mod, fi, node.args[0].id)
+            if entry is None:
+                continue
+            # everything the donated program reaches, over the binder's
+            # call edges
+            reach = {entry}
+            work = [entry]
+            while work:
+                f = prog.funcs.get(work.pop())
+                if f is None:
+                    continue
+                for callee, _held, _site, _recv in f.calls:
+                    if callee not in reach and callee in prog.funcs:
+                        reach.add(callee)
+                        work.append(callee)
+            for rfid in sorted(reach):
+                if rfid in seen:
+                    continue
+                host = prog.funcs[rfid]
+                pc = next((n for n in _own(host)
+                           if isinstance(n, ast.Call)
+                           and _terminal(n.func) == "pallas_call"), None)
+                if pc is None:
+                    continue
+                seen.add(rfid)
+                if not _has_step0_init(prog, host):
+                    add("carry-grid-init",
+                        Site(host.path, pc.lineno, pc.col_offset),
+                        f"{host.qual} is reachable from a donating jit "
+                        f"(donate_argnums at {fi.path}:{node.lineno}) but "
+                        f"its kernel never re-initializes the accumulator "
+                        f"grids at grid step 0 (`@pl.when(i == 0)`) — "
+                        f"donated reuse replays the previous execution's "
+                        f"state; add the step-0 init or declare fresh-init "
+                        f"with a rationale suppression")
+
+
+# ---------------------------------------------------------------------------
+# findings assembly + rule shims (stallguard's structure, keyguard's
+# config-keyed memo: the blessed-gate list is config, not program state)
+# ---------------------------------------------------------------------------
+
+def _config_key(config) -> tuple:
+    return (tuple(getattr(config, "donorguard_platform_gate", []) or []),
+            tuple(config.raceguard_modules))
+
+
+def donor_findings(prog: Program, config) \
+        -> Dict[str, Dict[str, List[Tuple]]]:
+    key = _config_key(config)
+    got = getattr(prog, "_donor_findings", None)
+    if got is not None and got[0] == key:
+        return got[1]
+    findings: Dict[str, Dict[str, List[Tuple]]] = {}
+
+    def add(rule_name: str, site: Site, message: str) -> None:
+        findings.setdefault(rule_name, {}).setdefault(
+            site.path, []).append((site.line, site.col, message))
+
+    builders = _donating_builders(prog)
+    mod_donating = {path: _module_donating(prog, mod, builders)
+                    for path, mod in prog.modules.items()}
+    _check_read_after_donate(prog, config, add, builders, mod_donating)
+    _check_cached_entry(prog, config, add, builders, mod_donating)
+    _check_take_repark(prog, config, add, builders, mod_donating)
+    _check_platform_gate(prog, config, add)
+    _check_carry_init(prog, config, add, builders)
+    prog._donor_findings = (key, findings)
+    return findings
+
+
+def _program_for(ctx: ModuleContext) -> Program:
+    from tools.druidlint.raceguard import _program_for as rg_program
+    return rg_program(ctx)
+
+
+def _emit(ctx: ModuleContext, rule_name: str) -> Iterable[Finding]:
+    if not ctx.path_matches(ctx.config.raceguard_modules):
+        return
+    prog = _program_for(ctx)
+    data = donor_findings(prog, ctx.config)
+    for line, col, message in sorted(
+            data.get(rule_name, {}).get(ctx.path, ())):
+        yield ctx.finding(SimpleNamespace(lineno=line, col_offset=col),
+                          message)
+
+
+@rule("read-after-donate", "error",
+      "donated argument referenced again after the dispatch")
+def check_read_after_donate(ctx: ModuleContext) -> Iterable[Finding]:
+    """A local passed in a donated position (`donate_argnums`) is
+    referenced again after the donating dispatch. On CPU, where donation
+    is silently ignored, the read returns stale-but-valid data and every
+    parity test passes; on TPU the buffer was invalidated at dispatch
+    and the same read is garbage or a crash — the exact class the owed
+    real-TPU bench would be first to hit. Compute what you need from the
+    buffer BEFORE the dispatch (the grouping loop's donated_nbytes
+    shape), rebind the name, or route the reference through an explicit
+    discard helper."""
+    yield from _emit(ctx, "read-after-donate")
+
+
+@rule("donate-cached-entry", "error",
+      "cached pool entry flows into a donated argnum without a take")
+def check_donate_cached_entry(ctx: ModuleContext) -> Iterable[Finding]:
+    """A `get_or_build`/`device_cached`/`peek` result — a buffer the
+    DeviceSegmentPool still references — flows into a donated position
+    with no ownership-popping `take`/`device_take` in between. Donation
+    invalidates the buffer but the pool entry survives, so every future
+    cache hit returns poison. The take→dispatch→re-park cycle exists
+    precisely to pop the entry first; the dynamic donorwitness enforces
+    the same invariant on real pool objects at test time."""
+    yield from _emit(ctx, "donate-cached-entry")
+
+
+@rule("take-without-repark", "error",
+      "popped pool ownership not re-parked on every path")
+def check_take_without_repark(ctx: ModuleContext) -> Iterable[Finding]:
+    """A `take`/`device_take` pops a buffer from the pool (the pool's
+    byte accounting is decremented at pop), but some path — including
+    the exception path out of a donating dispatch — neither re-parks
+    (put/device_cached), returns, nor explicitly discards it
+    (megakernel.discard_carries). The buffer dangles as untracked device
+    memory while the books claim the bytes were freed: leakguard's
+    lifecycle discipline extended to device buffers. Discharge ownership
+    in an except/finally on the dispatch."""
+    yield from _emit(ctx, "take-without-repark")
+
+
+@rule("donate-platform-gate", "error",
+      "backend/platform comparison outside the shared donation gate")
+def check_donate_platform_gate(ctx: ModuleContext) -> Iterable[Finding]:
+    """Every backend/platform comparison (`jax.default_backend() == ...`,
+    `device.platform == ...`) must live in a predicate named by
+    `donorguard-platform-gate` — by default the ONE donation gate
+    (contracts.donation_supported, which also owns the tri-state
+    DRUID_TPU_DONATE flag) and the pallas availability probe
+    (pallas_agg.backend_ok). A scattered inline check is how one call
+    site ends up donating on a backend the rest of the engine thinks is
+    non-donating: the CPU-segfault class."""
+    yield from _emit(ctx, "donate-platform-gate")
+
+
+@rule("carry-grid-init", "error",
+      "donated-accumulator program lacks a grid-step-0 re-init")
+def check_carry_grid_init(ctx: ModuleContext) -> Iterable[Finding]:
+    """A pallas program reachable from a donating jit construction must
+    re-initialize its accumulator grids at grid step 0
+    (`@pl.when(i == 0)` on the kernel's init block) — PR 11's
+    bit-identity discipline: donated reuse of last execution's grids
+    must be indistinguishable from fresh zeros. Without the step-0 init
+    the donated buffers replay stale partial aggregates. A kernel whose
+    design genuinely allocates fresh grids per dispatch declares it with
+    a rationale suppression on the pallas_call."""
+    yield from _emit(ctx, "carry-grid-init")
